@@ -1,0 +1,88 @@
+"""Checkpoint engine (ref: deepspeed/runtime/checkpoint_engine/,
+deepspeed/checkpoint/ universal checkpoint, deepspeed/utils/zero_to_fp32.py).
+
+Orbax-backed save/restore of the sharded :class:`TrainState`.  The saved
+layout is topology-independent ("universal" in reference terms): orbax
+records global array shapes + the save-time shardings, and restore maps
+them onto the *current* mesh's shardings — so a checkpoint written under
+one ZeRO stage / mesh shape loads under another (the reference needs the
+ds_to_universal conversion step for this; here it is the native format).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _ckpt_dir(save_dir: str, tag: str) -> str:
+    return os.path.join(os.path.abspath(save_dir), tag)
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[dict] = None) -> str:
+    """ref: DeepSpeedEngine.save_checkpoint(save_dir, tag, client_state)."""
+    import orbax.checkpoint as ocp
+
+    tag = tag or f"global_step{engine.global_steps}"
+    path = _ckpt_dir(save_dir, tag)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "state"), engine.state, force=True)
+    ckptr.wait_until_finished()
+    meta = {
+        "global_steps": engine.global_steps,
+        "skipped_steps": engine.skipped_steps,
+        "client_state": client_state or {},
+        "config": engine.config.raw,
+    }
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
+            f.write(tag)
+    logger.info("saved checkpoint %s", path)
+    return path
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None):
+    """ref: DeepSpeedEngine.load_checkpoint — returns (path, client_state).
+
+    Restores onto the engine's CURRENT shardings, so mesh/stage may differ
+    from save time (universal-checkpoint semantics).
+    """
+    import orbax.checkpoint as ocp
+
+    if tag is None:
+        latest = os.path.join(os.path.abspath(load_dir), "latest")
+        if not os.path.exists(latest):
+            return None, {}
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = _ckpt_dir(load_dir, tag)
+    ckptr = ocp.StandardCheckpointer()
+    target = jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        engine.state, engine.state_shardings)
+    engine.state = ckptr.restore(os.path.join(path, "state"), target)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    engine.global_steps = int(meta.get("global_steps", 0))
+    engine.skipped_steps = int(meta.get("skipped_steps", 0))
+    logger.info("loaded checkpoint %s", path)
+    return path, meta.get("client_state", {})
+
+
+def consolidate_to_fp32(engine):
+    """Gather a replicated float32 param pytree (ref: zero_to_fp32.py)."""
+    from deepspeed_tpu import zero
+
+    params = zero.unshard_params(engine.state.params, engine.mesh)
+    return jax.tree.map(lambda p: np.asarray(p, np.float32)
+                        if np.issubdtype(np.asarray(p).dtype, np.floating)
+                        else np.asarray(p), params)

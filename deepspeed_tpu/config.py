@@ -1,0 +1,288 @@
+"""Config system accepting DeepSpeed-style JSON (ref: deepspeed/runtime/config.py).
+
+The reference parses a JSON dict (``train_batch_size``,
+``zero_optimization``, ``fp16``/``bf16``, ``optimizer``, ``scheduler``,
+``gradient_clipping`` …) into a ``DeepSpeedConfig`` object with validation
+of the batch-size arithmetic.  We keep the same keys and arithmetic so an
+existing config file works unchanged, and add a ``mesh`` block describing
+the TPU device-mesh topology (there is no NCCL analogue — parallelism
+degrees ARE the config here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional
+
+# Defaults mirror the reference's constants
+# (ref: deepspeed/runtime/constants.py, deepspeed/runtime/zero/config.py).
+TRAIN_BATCH_SIZE = "train_batch_size"
+MICRO_BATCH = "train_micro_batch_size_per_gpu"
+GRAD_ACCUM = "gradient_accumulation_steps"
+
+
+@dataclasses.dataclass
+class ZeroConfig:
+    """ref: deepspeed/runtime/zero/config.py (DeepSpeedZeroConfig)."""
+
+    stage: int = 0
+    # On TPU the partition granularity is the GSPMD sharding; these knobs
+    # are accepted for compatibility and used as hints.
+    reduce_scatter: bool = True
+    overlap_comm: bool = True
+    contiguous_gradients: bool = True
+    offload_param: Optional[Dict[str, Any]] = None      # {"device": "cpu"|"nvme", ...}
+    offload_optimizer: Optional[Dict[str, Any]] = None
+    zeropp_quantized_gradients: bool = False            # ZeRO++ qgZ
+    zeropp_quantized_weights: bool = False
+    sub_group_size: int = 0
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ZeroConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in d.items() if k in known}
+        z = cls(**kwargs)
+        if not 0 <= z.stage <= 3:
+            raise ValueError(f"zero_optimization.stage must be 0..3, got {z.stage}")
+        return z
+
+
+@dataclasses.dataclass
+class PrecisionConfig:
+    """ref: deepspeed/runtime/fp16/loss_scaler.py + config fp16/bf16 blocks."""
+
+    dtype: str = "bfloat16"              # compute dtype: float32|bfloat16|float16
+    master_dtype: str = "float32"        # master-weight / optimizer dtype
+    # fp16 dynamic loss scaling (parity with ref; bf16 needs none)
+    loss_scale: float = 0.0              # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+    @property
+    def is_fp16(self) -> bool:
+        return self.dtype == "float16"
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """TPU topology block (no reference analogue: replaces process groups).
+
+    Axis sizes; -1 on ``data`` means "all remaining devices".
+    """
+
+    pipe: int = 1
+    data: int = -1
+    expert: int = 1
+    seq: int = 1
+    model: int = 1
+
+    def axis_sizes(self, n_devices: int) -> Dict[str, int]:
+        sizes = {"pipe": self.pipe, "data": self.data, "expert": self.expert,
+                 "seq": self.seq, "model": self.model}
+        fixed = 1
+        for k, v in sizes.items():
+            if v != -1:
+                if v < 1:
+                    raise ValueError(f"mesh.{k} must be >=1 or -1, got {v}")
+                fixed *= v
+        n_auto = sum(1 for v in sizes.values() if v == -1)
+        if n_auto > 1:
+            raise ValueError("only one mesh axis may be -1")
+        if n_auto == 1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"device count {n_devices} not divisible by fixed mesh product {fixed}")
+            auto = n_devices // fixed
+            sizes = {k: (auto if v == -1 else v) for k, v in sizes.items()}
+        total = 1
+        for v in sizes.values():
+            total *= v
+        if total != n_devices:
+            raise ValueError(
+                f"mesh product {total} != device count {n_devices}: {sizes}")
+        return sizes
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    """ref: config ``optimizer`` block (deepspeed/runtime/config.py)."""
+
+    type: str = "adamw"
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    """ref: config ``scheduler`` block → deepspeed/runtime/lr_schedules.py."""
+
+    type: Optional[str] = None
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ActivationCheckpointingConfig:
+    """ref: deepspeed/runtime/activation_checkpointing/config.py."""
+
+    policy: str = "none"   # none | full | save_dots | save_attn
+    partition_activations: bool = False  # accepted; GSPMD shards activations
+
+
+@dataclasses.dataclass
+class PipelineConfig:
+    """ref: deepspeed/runtime/pipe/config — schedule + microbatching."""
+
+    stages: int = 1
+    schedule: str = "1f1b"   # gpipe | 1f1b
+    # layer→stage assignment; "uniform" splits the layer stack evenly
+    partition_method: str = "uniform"
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    """ref: deepspeed/moe/layer.py constructor args."""
+
+    enabled: bool = False
+    num_experts: int = 1
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    aux_loss_weight: float = 0.01
+    z_loss_weight: float = 0.001
+
+
+@dataclasses.dataclass
+class Config:
+    """Top-level parsed config (ref: deepspeed/runtime/config.py
+
+    ``DeepSpeedConfig``).  ``Config.from_dict`` accepts the reference's JSON
+    schema; batch arithmetic validation matches the reference's
+    ``_batch_assertion``: train_batch == micro_batch * grad_accum * dp_world.
+    """
+
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+    gradient_clipping: float = 0.0
+    steps_per_print: int = 10
+    seed: int = 42
+    zero: ZeroConfig = dataclasses.field(default_factory=ZeroConfig)
+    precision: PrecisionConfig = dataclasses.field(default_factory=PrecisionConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = dataclasses.field(
+        default_factory=ActivationCheckpointingConfig)
+    pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
+    moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
+    raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    # ---------------------------------------------------------------- parse
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Config":
+        c = cls(raw=dict(d))
+        c.train_batch_size = d.get(TRAIN_BATCH_SIZE)
+        c.train_micro_batch_size_per_gpu = d.get(MICRO_BATCH)
+        c.gradient_accumulation_steps = d.get(GRAD_ACCUM)
+        c.gradient_clipping = float(d.get("gradient_clipping", 0.0))
+        c.steps_per_print = int(d.get("steps_per_print", 10))
+        c.seed = int(d.get("seed", 42))
+
+        if "zero_optimization" in d:
+            c.zero = ZeroConfig.from_dict(d["zero_optimization"])
+
+        fp16 = d.get("fp16", {})
+        bf16 = d.get("bf16", d.get("bfloat16", {}))
+        if fp16.get("enabled"):
+            c.precision = PrecisionConfig(
+                dtype="float16",
+                loss_scale=float(fp16.get("loss_scale", 0.0)),
+                initial_scale_power=int(fp16.get("initial_scale_power", 16)),
+                loss_scale_window=int(fp16.get("loss_scale_window", 1000)),
+                hysteresis=int(fp16.get("hysteresis", 2)),
+                min_loss_scale=float(fp16.get("min_loss_scale", 1.0)),
+            )
+        elif bf16.get("enabled", True):
+            # bf16 is the TPU-native default (MXU-friendly).
+            c.precision = PrecisionConfig(dtype="bfloat16")
+        else:
+            c.precision = PrecisionConfig(dtype="float32")
+
+        if "mesh" in d:
+            known = {f.name for f in dataclasses.fields(MeshConfig)}
+            c.mesh = MeshConfig(**{k: v for k, v in d["mesh"].items() if k in known})
+        if "optimizer" in d:
+            c.optimizer = OptimizerConfig(
+                type=str(d["optimizer"].get("type", "adamw")).lower(),
+                params=dict(d["optimizer"].get("params", {})),
+            )
+        if "scheduler" in d:
+            c.scheduler = SchedulerConfig(
+                type=d["scheduler"].get("type"),
+                params=dict(d["scheduler"].get("params", {})),
+            )
+        if "activation_checkpointing" in d:
+            ac = d["activation_checkpointing"]
+            c.activation_checkpointing = ActivationCheckpointingConfig(
+                policy=ac.get("policy", "full" if ac.get("enabled") else "none"),
+                partition_activations=bool(ac.get("partition_activations", False)),
+            )
+        if "pipeline" in d:
+            known = {f.name for f in dataclasses.fields(PipelineConfig)}
+            c.pipeline = PipelineConfig(
+                **{k: v for k, v in d["pipeline"].items() if k in known})
+        if "moe" in d:
+            known = {f.name for f in dataclasses.fields(MoEConfig)}
+            c.moe = MoEConfig(**{k: v for k, v in d["moe"].items() if k in known})
+            c.moe.enabled = c.moe.enabled or c.moe.num_experts > 1
+        return c
+
+    @classmethod
+    def from_file(cls, path: str) -> "Config":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    # ------------------------------------------------------ batch arithmetic
+    def resolve_batch_sizes(self, dp_world: int) -> None:
+        """Solve train = micro * accum * dp_world (ref: config.py
+
+        ``_configure_train_batch_size``): any two given determine the third;
+        one given assumes the others default; all three must be consistent.
+        """
+        t, m, a = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+                   self.gradient_accumulation_steps)
+        if t is not None and m is not None and a is not None:
+            if t != m * a * dp_world:
+                raise ValueError(
+                    f"batch sizes inconsistent: {t} != {m}*{a}*{dp_world}")
+        elif t is not None and m is not None:
+            if t % (m * dp_world) != 0:
+                raise ValueError(
+                    f"train_batch_size {t} not divisible by micro*dp {m * dp_world}")
+            a = t // (m * dp_world)
+        elif t is not None and a is not None:
+            if t % (a * dp_world) != 0:
+                raise ValueError(
+                    f"train_batch_size {t} not divisible by accum*dp {a * dp_world}")
+            m = t // (a * dp_world)
+        elif m is not None:
+            a = a or 1
+            t = m * a * dp_world
+        elif a is not None:
+            m = 1
+            t = m * a * dp_world
+        elif t is not None:
+            a = 1
+            if t % dp_world != 0:
+                raise ValueError(
+                    f"train_batch_size {t} not divisible by dp world {dp_world}")
+            m = t // dp_world
+        else:
+            m, a = 1, 1
+            t = dp_world
+        self.train_batch_size = t
+        self.train_micro_batch_size_per_gpu = m
+        self.gradient_accumulation_steps = a

@@ -1,0 +1,3 @@
+"""Data pipeline (ref: deepspeed/runtime/dataloader.py, data_pipeline/)."""
+
+from deepspeed_tpu.data.loader import DataLoader

@@ -1,0 +1,339 @@
+"""Training engine (ref: deepspeed/runtime/engine.py DeepSpeedEngine +
+deepspeed/__init__.py initialize).
+
+The reference engine wraps a torch module and orchestrates an imperative
+loop: forward → backward (hooked for ZeRO reduce) → step (optimizer with
+loss-scale checks), with micro-batch accumulation counted by host-side
+bookkeeping.  The TPU-native engine compiles ONE SPMD program per train
+step: grad accumulation is a ``lax.scan`` over microbatches, ZeRO is a set
+of shardings (:mod:`deepspeed_tpu.zero`), loss scaling and clipping run
+inside the jit, and buffers are donated so params/optimizer state update
+in place in HBM.
+
+DeepSpeed's three-call idiom is preserved::
+
+    loss = engine(batch)        # computes the whole step, defers commit
+    engine.backward(loss)       # no-op (bwd already fused into the step)
+    engine.step()               # commits the new state
+
+alongside the native ``loss = engine.train_batch(batch)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu import lr_schedules, precision, zero
+from deepspeed_tpu.config import Config
+from deepspeed_tpu.ops.optim import Optimizer, from_config as opt_from_config
+from deepspeed_tpu.topology import MeshSpec, default_mesh
+from deepspeed_tpu.utils.logging import logger
+
+
+class TrainState(NamedTuple):
+    """Replicated-control training state; leaf shardings carry ZeRO."""
+
+    step: jnp.ndarray          # i32
+    params: Any                # master params (master_dtype)
+    opt_state: Any
+    scaler: precision.ScalerState
+
+
+def global_norm(tree: Any) -> jnp.ndarray:
+    leaves = [jnp.sum(jnp.square(l.astype(jnp.float32)))
+              for l in jax.tree.leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+def clip_by_global_norm(tree: Any, max_norm: float) -> Tuple[Any, jnp.ndarray]:
+    """ref: deepspeed/runtime/utils.py clip_grad_norm_."""
+    norm = global_norm(tree)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: g * factor, tree), norm
+
+
+class TrainingEngine:
+    """One jitted SPMD train step + host-side bookkeeping.
+
+    Parameters
+    ----------
+    loss_fn: ``(params, batch) -> loss`` or ``(params, batch) -> (loss, aux)``.
+        ``params`` arrive cast to the compute dtype (bf16 by default).
+    params: initial master parameter pytree (will be cast to master dtype
+        and placed according to the ZeRO stage's shardings).
+    config: parsed :class:`~deepspeed_tpu.config.Config`.
+    mesh: :class:`~deepspeed_tpu.topology.MeshSpec`; default built from
+        ``config.mesh`` over all devices.
+    base_spec_fn: optional ``leaf -> PartitionSpec`` giving model-parallel
+        (TP) shardings that ZeRO layers the data axis on top of.
+    """
+
+    def __init__(self, loss_fn: Callable, params: Any, config: Config,
+                 mesh: Optional[MeshSpec] = None,
+                 optimizer: Optional[Optimizer] = None,
+                 lr_scheduler=None,
+                 base_spec_fn: Optional[Callable] = None,
+                 has_aux: bool = False):
+        self.config = config
+        self.mesh = mesh or MeshSpec.build(
+            config.mesh.axis_sizes(jax.device_count()))
+        config.resolve_batch_sizes(self.mesh.dp_world)
+        self.loss_fn = loss_fn
+        self.has_aux = has_aux
+        self.base_spec_fn = base_spec_fn
+        stage = config.zero.stage
+
+        # ---- optimizer + schedule (ref: engine._configure_optimizer)
+        from deepspeed_tpu.ops.optim import default_lr
+
+        opt_lr = float(config.optimizer.params.get(
+            "lr", default_lr(config.optimizer.type)))
+        self.lr_schedule = (
+            lr_scheduler if callable(lr_scheduler)
+            else lr_schedules.from_config(config.scheduler.type,
+                                          config.scheduler.params,
+                                          fallback_lr=opt_lr))
+        if optimizer is None:
+            oparams = dict(config.optimizer.params)
+            oparams["lr"] = self.lr_schedule
+            optimizer = opt_from_config(config.optimizer.type, oparams)
+        self.optimizer = optimizer
+
+        # ---- state layout: ZeRO shardings
+        mdt = precision.master_dtype(config.precision)
+        params = jax.tree.map(
+            lambda p: jnp.asarray(p, mdt)
+            if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
+            params)
+        self.param_shardings = zero.param_shardings(
+            params, self.mesh, stage, base_spec_fn)
+        opt_state_shape = jax.eval_shape(self.optimizer.init, params)
+        self.opt_shardings = zero.optstate_shardings(
+            opt_state_shape, self.mesh, stage, base_spec_fn)
+        repl = self.mesh.replicated()
+        self.state_shardings = TrainState(
+            step=repl, params=self.param_shardings,
+            opt_state=self.opt_shardings,
+            scaler=precision.ScalerState(repl, repl))
+
+        init_fn = jax.jit(
+            lambda p: TrainState(
+                step=jnp.zeros([], jnp.int32),
+                params=p,
+                opt_state=self.optimizer.init(p),
+                scaler=precision.scaler_init(config.precision)),
+            out_shardings=self.state_shardings)
+        self.state = init_fn(params)
+
+        # ---- the compiled step.  The batch sharding (a pytree prefix — one
+        # NamedSharding broadcast to every leaf) splits the batch dim over
+        # the data axes so each chip receives only its slice.
+        batch_sharding = self.mesh.sharding(self.mesh.batch_spec())
+        self._step_fn = jax.jit(
+            self._train_step,
+            in_shardings=(self.state_shardings, batch_sharding),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,))
+        self._eval_fn = jax.jit(self._eval_step,
+                                in_shardings=(self.state_shardings, batch_sharding))
+
+        # host bookkeeping (ref: engine.global_steps / skipped_steps)
+        self.global_steps = 0
+        self.skipped_steps = 0
+        self._pending: Optional[dict] = None
+        self._last_metrics = {}
+        logger.info(
+            "TrainingEngine: zero=%d mesh=%s micro=%d accum=%d global=%d dtype=%s",
+            stage, self.mesh.sizes, config.train_micro_batch_size_per_gpu,
+            config.gradient_accumulation_steps, config.train_batch_size,
+            config.precision.dtype)
+
+    # ------------------------------------------------------------------ step
+    def _loss_for(self, params, batch):
+        cparams = precision.cast_for_compute(params, self.config.precision)
+        out = self.loss_fn(cparams, batch)
+        if self.has_aux:
+            loss, aux = out
+        else:
+            loss, aux = out, None
+        return loss.astype(jnp.float32), aux
+
+    def _train_step(self, state: TrainState, batch):
+        cfg = self.config
+        accum = cfg.gradient_accumulation_steps
+        stage = cfg.zero.stage
+
+        def scaled_loss(params, mb):
+            loss, aux = self._loss_for(params, mb)
+            return precision.scale_loss(loss, state.scaler, cfg.precision), (loss, aux)
+
+        grad_fn = jax.grad(scaled_loss, has_aux=True)
+
+        def micro(carry, mb):
+            gacc, lacc = carry
+            g, (loss, _aux) = grad_fn(state.params, mb)
+            g = zero.grad_constraint(g, self.mesh, stage, self.base_spec_fn)
+            gacc = jax.tree.map(lambda a, b: a + b.astype(jnp.float32), gacc, g)
+            return (gacc, lacc + loss), None
+
+        if accum > 1:
+            # [global_batch, ...] -> [accum, micro_global, ...]
+            mbatch = jax.tree.map(
+                lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
+                batch)
+            zeros = jax.tree.map(
+                lambda p: zero.grad_constraint(
+                    jnp.zeros(p.shape, jnp.float32), self.mesh, stage,
+                    self.base_spec_fn) if stage >= 2 else jnp.zeros(p.shape, jnp.float32),
+                state.params)
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro, (zeros, jnp.float32(0.0)), mbatch)
+            grads = jax.tree.map(lambda g: g / accum, grads)
+            loss = loss_sum / accum
+        else:
+            grads, (loss, _aux) = grad_fn(state.params, batch)
+            grads = zero.grad_constraint(grads, self.mesh, stage, self.base_spec_fn)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+
+        grads, ok, new_scaler = precision.unscale_and_check(
+            grads, state.scaler, cfg.precision)
+
+        if cfg.gradient_clipping > 0:
+            grads, gnorm = clip_by_global_norm(grads, cfg.gradient_clipping)
+        else:
+            gnorm = global_norm(grads)
+
+        updates, new_opt = self.optimizer.update(grads, state.opt_state, state.params)
+        new_params = jax.tree.map(lambda p, u: p + u.astype(p.dtype),
+                                  state.params, updates)
+        # overflow → skip the update, keep old state (ref: fused_optimizer.step)
+        keep = lambda new, old: jax.tree.map(
+            lambda n, o: jnp.where(ok, n, o), new, old)
+        new_state = TrainState(
+            step=state.step + jnp.where(ok, 1, 0).astype(jnp.int32),
+            params=keep(new_params, state.params),
+            opt_state=keep(new_opt, state.opt_state),
+            scaler=new_scaler)
+        metrics = {"loss": loss, "grad_norm": gnorm,
+                   "overflow": (~ok).astype(jnp.int32),
+                   "lr": self.lr_schedule(state.step + 1),
+                   "loss_scale": new_scaler.scale}
+        return new_state, metrics
+
+    def _eval_step(self, state: TrainState, batch):
+        loss, aux = self._loss_for(state.params, batch)
+        return loss if aux is None else (loss, aux)
+
+    # ----------------------------------------------------------- public API
+    def train_batch(self, batch) -> jnp.ndarray:
+        """Run one full optimizer step on a global batch; returns the loss.
+
+        (ref: PipelineEngine.train_batch — one call per global step.)
+        """
+        self.state, metrics = self._step_fn(self.state, batch)
+        self.global_steps += 1
+        self._last_metrics = metrics
+        return metrics["loss"]
+
+    def eval_batch(self, batch):
+        return self._eval_fn(self.state, batch)
+
+    # torch-idiom compatibility shims (ref: engine.__call__/backward/step)
+    def __call__(self, batch):
+        # State is committed immediately — the step donates the old buffers,
+        # so holding them in a "pending" slot would leave self.state pointing
+        # at deleted arrays.  backward()/step() validate call order only.
+        new_state, metrics = self._step_fn(self.state, batch)
+        self.state = new_state
+        self._pending = metrics
+        self._last_metrics = metrics
+        return metrics["loss"]
+
+    def forward(self, batch):
+        return self(batch)
+
+    def backward(self, loss):
+        """No-op: backward is fused into the compiled step."""
+        if self._pending is None:
+            raise RuntimeError("backward() without a preceding engine(batch) call")
+        return loss
+
+    def step(self):
+        """Complete the step started by ``engine(batch)`` (bookkeeping only)."""
+        if self._pending is None:
+            raise RuntimeError("step() without a preceding engine(batch) call")
+        self._pending = None
+        self.global_steps += 1
+
+    # ------------------------------------------------------------ inspection
+    @property
+    def metrics(self):
+        return self._last_metrics
+
+    def get_lr(self):
+        return [float(self.lr_schedule(self.state.step))]
+
+    def get_global_grad_norm(self) -> float:
+        m = self._last_metrics.get("grad_norm")
+        return float(m) if m is not None else 0.0
+
+    @property
+    def train_batch_size(self):
+        return self.config.train_batch_size
+
+    @property
+    def gradient_accumulation_steps(self):
+        return self.config.gradient_accumulation_steps
+
+    def module_params(self):
+        """Replicated (gathered) view of params for export."""
+        return zero.unshard_params(self.state.params, self.mesh)
+
+    # ---------------------------------------------------------- checkpointing
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[dict] = None):
+        from deepspeed_tpu.checkpoint import save_checkpoint as _save
+
+        return _save(self, save_dir, tag=tag, client_state=client_state)
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
+        from deepspeed_tpu.checkpoint import load_checkpoint as _load
+
+        return _load(self, load_dir, tag=tag)
+
+
+def initialize(args=None, *, loss_fn: Callable, params: Any,
+               config: Any = None, mesh: Optional[MeshSpec] = None,
+               optimizer: Optional[Optimizer] = None,
+               lr_scheduler=None, base_spec_fn: Optional[Callable] = None,
+               training_data=None, has_aux: bool = False,
+               dist_init_required: Optional[bool] = None):
+    """ref: deepspeed.initialize — returns (engine, optimizer, dataloader,
+    lr_scheduler).  ``config`` may be a dict, a path, or a Config."""
+    from deepspeed_tpu import comm
+
+    if dist_init_required is None or dist_init_required:
+        comm.init_distributed()
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None)
+    if isinstance(config, str):
+        config = Config.from_file(config)
+    elif isinstance(config, dict):
+        config = Config.from_dict(config)
+    elif config is None:
+        config = Config()
+
+    engine = TrainingEngine(loss_fn, params, config, mesh=mesh,
+                            optimizer=optimizer, lr_scheduler=lr_scheduler,
+                            base_spec_fn=base_spec_fn, has_aux=has_aux)
+    dataloader = None
+    if training_data is not None:
+        from deepspeed_tpu.data.loader import DataLoader
+
+        dataloader = DataLoader(training_data,
+                                batch_size=config.train_batch_size,
+                                seed=config.seed)
+    return engine, engine.optimizer, dataloader, engine.lr_schedule

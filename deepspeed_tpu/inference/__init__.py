@@ -1,0 +1,3 @@
+"""Inference subsystem (ref: deepspeed/inference/)."""
+
+from deepspeed_tpu.inference.engine import InferenceEngine, init_inference

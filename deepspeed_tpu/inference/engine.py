@@ -1,0 +1,69 @@
+"""Inference engine (ref: deepspeed/inference/engine.py InferenceEngine).
+
+The reference wraps a torch module, injects fused kernels
+(module_inject) and shards weights across GPUs (``mp_size``).  Here the
+engine jits the model's apply function over the mesh with TP shardings;
+generation (KV cache, prefill/decode split, sampling) lands with the
+model families — this core provides the forward path and the
+``init_inference`` entrypoint contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from deepspeed_tpu import precision
+from deepspeed_tpu.config import Config, PrecisionConfig
+from deepspeed_tpu.topology import MeshSpec, default_mesh
+from deepspeed_tpu.zero import param_shardings
+
+
+class InferenceEngine:
+    """Jitted forward over sharded params.
+
+    ``apply_fn(params, *inputs)`` is the model's pure forward function.
+    """
+
+    def __init__(self, apply_fn: Callable, params: Any,
+                 mesh: Optional[MeshSpec] = None,
+                 base_spec_fn: Optional[Callable] = None,
+                 dtype: str = "bfloat16"):
+        self.mesh = mesh or default_mesh()
+        self.apply_fn = apply_fn
+        pcfg = PrecisionConfig(dtype=dtype)
+        params = precision.cast_for_compute(params, pcfg)
+        shardings = param_shardings(params, self.mesh, stage=0,
+                                    base_spec_fn=base_spec_fn)
+        self.params = jax.jit(lambda p: p, out_shardings=shardings)(params)
+        self._fwd = jax.jit(apply_fn)
+
+    def __call__(self, *inputs):
+        return self._fwd(self.params, *inputs)
+
+    def forward(self, *inputs):
+        return self(*inputs)
+
+
+def init_inference(model: Any = None, *, apply_fn: Optional[Callable] = None,
+                   params: Any = None, config: Any = None,
+                   mesh: Optional[MeshSpec] = None,
+                   base_spec_fn: Optional[Callable] = None,
+                   dtype: str = "bfloat16", **_compat) -> InferenceEngine:
+    """ref: deepspeed.init_inference(model, config…) → engine.
+
+    ``model`` may be an object with ``.apply``/``.params`` (flax-style) or
+    pass ``apply_fn`` + ``params`` explicitly.
+    """
+    if isinstance(config, dict):
+        config = Config.from_dict(config)
+    if apply_fn is None:
+        if model is None or not hasattr(model, "apply"):
+            raise ValueError("provide apply_fn+params or a model with .apply")
+        apply_fn = model.apply
+        params = params if params is not None else getattr(model, "params", None)
+    if params is None:
+        raise ValueError("init_inference requires params")
+    return InferenceEngine(apply_fn, params, mesh=mesh,
+                           base_spec_fn=base_spec_fn, dtype=dtype)

@@ -1,0 +1,132 @@
+"""LR schedules (ref: deepspeed/runtime/lr_schedules.py).
+
+The reference implements WarmupLR, WarmupDecayLR, WarmupCosineLR, OneCycle
+and LRRangeTest as stateful torch schedulers.  Here each is a pure function
+``step -> lr`` (jnp-traceable, so the schedule evaluates inside the jitted
+train step with no host sync).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def constant(lr: float) -> Schedule:
+    return lambda step: jnp.float32(lr)
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 1e-3,
+              warmup_num_steps: int = 1000, warmup_type: str = "log") -> Schedule:
+    """ref: WarmupLR — warm up then hold at max."""
+    lo, hi, n = jnp.float32(warmup_min_lr), jnp.float32(warmup_max_lr), warmup_num_steps
+
+    def f(step):
+        s = jnp.minimum(step.astype(jnp.float32), float(n))
+        if warmup_type == "log":
+            # matches ref: lr scales with log(step)/log(n)
+            frac = jnp.log1p(s) / jnp.log1p(float(n))
+        else:
+            frac = s / float(max(n, 1))
+        return lo + (hi - lo) * frac
+
+    return f
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 1e-3, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log") -> Schedule:
+    """ref: WarmupDecayLR — warmup then linear decay to 0 at total steps."""
+    wu = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def f(step):
+        s = step.astype(jnp.float32)
+        decay = jnp.clip(
+            (total_num_steps - s) / float(max(total_num_steps - warmup_num_steps, 1)),
+            0.0, 1.0)
+        return jnp.where(s < warmup_num_steps, wu(step),
+                         jnp.float32(warmup_max_lr) * decay)
+
+    return f
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 1e-4,
+                     warmup_max_lr: float = 1e-3) -> Schedule:
+    """ref: WarmupCosineLR — linear warmup then cosine decay."""
+    hi = jnp.float32(warmup_max_lr)
+
+    def f(step):
+        s = step.astype(jnp.float32)
+        wu_frac = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.clip(
+            s / float(max(warmup_num_steps, 1)), 0.0, 1.0)
+        prog = jnp.clip((s - warmup_num_steps)
+                        / float(max(total_num_steps - warmup_num_steps, 1)), 0.0, 1.0)
+        cos = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(jnp.pi * prog))
+        return hi * jnp.where(s < warmup_num_steps, wu_frac, cos)
+
+    return f
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: Optional[int] = None,
+              decay_step_size: int = 0, decay_lr_rate: float = 0.0) -> Schedule:
+    """ref: OneCycle — ramp up, ramp down, then optional decay."""
+    second = cycle_second_step_size or cycle_first_step_size
+    lo, hi = jnp.float32(cycle_min_lr), jnp.float32(cycle_max_lr)
+
+    def f(step):
+        s = step.astype(jnp.float32)
+        up = lo + (hi - lo) * jnp.clip(s / float(cycle_first_step_size), 0.0, 1.0)
+        down = hi - (hi - lo) * jnp.clip(
+            (s - cycle_first_step_size) / float(second), 0.0, 1.0)
+        in_cycle = jnp.where(s < cycle_first_step_size, up, down)
+        total = cycle_first_step_size + second
+        if decay_step_size > 0:
+            dec = lo * jnp.maximum(
+                1.0 - decay_lr_rate * (s - total) / float(decay_step_size), 0.0)
+            return jnp.where(s <= total, in_cycle, dec)
+        return jnp.where(s <= total, in_cycle, lo)
+
+    return f
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-6,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False) -> Schedule:
+    """ref: LRRangeTest — linearly growing LR probe."""
+    lo = jnp.float32(lr_range_test_min_lr)
+
+    def f(step):
+        s = step.astype(jnp.float32)
+        interval = jnp.floor(s / lr_range_test_step_size) if lr_range_test_staircase \
+            else s / lr_range_test_step_size
+        return lo * (1 + interval * lr_range_test_step_rate)
+
+    return f
+
+
+_REGISTRY = {
+    "warmuplr": warmup_lr,
+    "warmupdecaylr": warmup_decay_lr,
+    "warmupcosinelr": warmup_cosine_lr,
+    "onecycle": one_cycle,
+    "lrrangetest": lr_range_test,
+    "constant": lambda lr=1e-3, **_: constant(lr),
+}
+
+
+def from_config(name: Optional[str], params: dict,
+                fallback_lr: float = 1e-3) -> Schedule:
+    """Build from the config ``scheduler`` block; None → constant(optimizer lr)."""
+    if name is None:
+        return constant(fallback_lr)
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"unknown scheduler {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key](**params)

@@ -1,0 +1,12 @@
+"""TPU-native op library (ref: deepspeed/ops/*).
+
+CUDA extensions in the reference become Pallas kernels or XLA-fused jnp
+here.  Optimizers live in :mod:`deepspeed_tpu.ops.optim`; attention in
+:mod:`deepspeed_tpu.ops.attention`; fused norms/activations in
+:mod:`deepspeed_tpu.ops.fused_ops`; quantization in
+:mod:`deepspeed_tpu.ops.quant`.
+"""
+
+from deepspeed_tpu.ops.optim import (
+    Optimizer, adam, adamw, lamb, lion, adagrad, sgd, from_config,
+)

@@ -1,0 +1,93 @@
+"""Mixed precision + loss scaling (ref: deepspeed/runtime/fp16/loss_scaler.py,
+deepspeed/runtime/bf16_optimizer.py, deepspeed/runtime/fp16/fused_optimizer.py).
+
+TPU-native policy: master params live in float32 (sharded per ZeRO stage),
+compute runs in bfloat16 on the MXU.  The fp16 path keeps the reference's
+DynamicLossScaler semantics (scale up after a window of good steps, back
+off on inf/nan, skip the update on overflow) — implemented functionally so
+the whole thing stays inside the jitted step with no host round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.config import PrecisionConfig
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+class ScalerState(NamedTuple):
+    """ref: DynamicLossScaler attributes (cur_scale, cur_iter, last_overflow_iter)."""
+
+    scale: jnp.ndarray       # f32 scalar
+    good_steps: jnp.ndarray  # i32 scalar — consecutive overflow-free steps
+
+
+def compute_dtype(cfg: PrecisionConfig):
+    return _DTYPES[cfg.dtype]
+
+
+def master_dtype(cfg: PrecisionConfig):
+    return _DTYPES[cfg.master_dtype]
+
+
+def cast_for_compute(params: Any, cfg: PrecisionConfig) -> Any:
+    dt = compute_dtype(cfg)
+
+    def one(p):
+        if p.dtype in (jnp.float32, jnp.bfloat16, jnp.float16):
+            return p.astype(dt)
+        return p
+
+    return jax.tree.map(one, params)
+
+
+def scaler_init(cfg: PrecisionConfig) -> ScalerState:
+    if cfg.is_fp16:
+        init = cfg.loss_scale if cfg.loss_scale > 0 else float(2 ** cfg.initial_scale_power)
+    else:
+        init = 1.0
+    return ScalerState(jnp.float32(init), jnp.zeros([], jnp.int32))
+
+
+def scale_loss(loss, state: ScalerState, cfg: PrecisionConfig):
+    return loss * state.scale if cfg.is_fp16 else loss
+
+
+def finite_all(tree: Any) -> jnp.ndarray:
+    leaves = jax.tree.leaves(tree)
+    ok = jnp.bool_(True)
+    for l in leaves:
+        if jnp.issubdtype(l.dtype, jnp.floating):
+            ok = ok & jnp.all(jnp.isfinite(l))
+    return ok
+
+
+def unscale_and_check(grads: Any, state: ScalerState, cfg: PrecisionConfig):
+    """Unscale grads; return (grads, is_finite, new_scaler_state).
+
+    Mirrors DynamicLossScaler.update_scale: on overflow divide the scale by
+    ``2`` (after ``hysteresis`` strikes in the ref — we fold hysteresis into
+    the backoff factor), after ``loss_scale_window`` clean steps double it.
+    """
+    if not cfg.is_fp16:
+        return grads, finite_all(grads), state
+    inv = 1.0 / state.scale
+    grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+    ok = finite_all(grads)
+    dynamic = cfg.loss_scale <= 0
+    if not dynamic:
+        return grads, ok, state
+    new_scale = jnp.where(
+        ok,
+        jnp.where(state.good_steps + 1 >= cfg.loss_scale_window,
+                  state.scale * 2.0, state.scale),
+        jnp.maximum(state.scale / 2.0, cfg.min_loss_scale))
+    new_good = jnp.where(
+        ok, jnp.where(state.good_steps + 1 >= cfg.loss_scale_window,
+                      0, state.good_steps + 1), 0)
+    return grads, ok, ScalerState(new_scale, new_good)

@@ -1,0 +1,3 @@
+"""Utility subpackage (ref: deepspeed/utils/)."""
+
+from deepspeed_tpu.utils.logging import logger, log_dist

@@ -1,0 +1,24 @@
+"""Rank-aware logging (ref: deepspeed/utils/logging.py)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+logger = logging.getLogger("deepspeed_tpu")
+if not logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter(
+        "[%(asctime)s] [%(levelname)s] [dstpu] %(message)s", "%H:%M:%S"))
+    logger.addHandler(_h)
+    logger.setLevel(os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper())
+    logger.propagate = False
+
+
+def log_dist(message: str, ranks=(0,), level: int = logging.INFO) -> None:
+    """Log only on the given host ranks (ref: deepspeed.utils.log_dist)."""
+    import jax
+
+    if jax.process_index() in ranks or -1 in ranks:
+        logger.log(level, message)

@@ -1,0 +1,36 @@
+"""Test harness: 8 virtual CPU devices so every sharding/collective path
+(ZeRO, TP, PP, SP, EP) runs as real SPMD without TPU hardware.
+
+Must set XLA flags BEFORE jax initializes (SURVEY.md §4).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The container's sitecustomize pre-imports jax with JAX_PLATFORMS=axon
+# (real TPU); the config update below still wins as long as no backend has
+# been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    d = jax.devices()
+    assert len(d) == 8, f"expected 8 virtual devices, got {len(d)}"
+    return d
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,69 @@
+"""Config parsing + batch arithmetic (ref semantics: runtime/config.py)."""
+
+import pytest
+
+from deepspeed_tpu.config import Config
+
+
+def test_parse_reference_style_json():
+    c = Config.from_dict({
+        "train_batch_size": 32,
+        "gradient_accumulation_steps": 2,
+        "gradient_clipping": 1.0,
+        "fp16": {"enabled": True, "initial_scale_power": 12},
+        "zero_optimization": {"stage": 2, "overlap_comm": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "betas": [0.9, 0.95]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    })
+    assert c.train_batch_size == 32
+    assert c.zero.stage == 2
+    assert c.precision.dtype == "float16"
+    assert c.precision.initial_scale_power == 12
+    assert c.optimizer.type == "adamw"
+    assert c.scheduler.type == "WarmupLR"
+    assert c.gradient_clipping == 1.0
+
+
+def test_bf16_default():
+    c = Config.from_dict({})
+    assert c.precision.dtype == "bfloat16"
+    assert c.zero.stage == 0
+
+
+def test_batch_arithmetic_two_given():
+    c = Config.from_dict({"train_batch_size": 32,
+                          "train_micro_batch_size_per_gpu": 2})
+    c.resolve_batch_sizes(dp_world=4)
+    assert c.gradient_accumulation_steps == 4
+
+
+def test_batch_arithmetic_micro_only():
+    c = Config.from_dict({"train_micro_batch_size_per_gpu": 3})
+    c.resolve_batch_sizes(dp_world=8)
+    assert c.train_batch_size == 24
+    assert c.gradient_accumulation_steps == 1
+
+
+def test_batch_arithmetic_inconsistent():
+    c = Config.from_dict({"train_batch_size": 30,
+                          "train_micro_batch_size_per_gpu": 2,
+                          "gradient_accumulation_steps": 2})
+    with pytest.raises(ValueError):
+        c.resolve_batch_sizes(dp_world=4)
+
+
+def test_bad_zero_stage():
+    with pytest.raises(ValueError):
+        Config.from_dict({"zero_optimization": {"stage": 5}})
+
+
+def test_mesh_auto_axis():
+    c = Config.from_dict({"mesh": {"model": 2, "data": -1}})
+    sizes = c.mesh.axis_sizes(8)
+    assert sizes["data"] == 4 and sizes["model"] == 2
+
+
+def test_mesh_mismatch():
+    c = Config.from_dict({"mesh": {"model": 3, "data": 2}})
+    with pytest.raises(ValueError):
+        c.mesh.axis_sizes(8)

@@ -1,0 +1,64 @@
+"""DataLoader + minimal InferenceEngine behavior."""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.data.loader import DataLoader, RepeatingLoader
+from deepspeed_tpu.inference import init_inference
+
+
+def _dataset(n=64):
+    return [{"x": np.full((4,), i, np.float32), "y": np.int32(i % 3)}
+            for i in range(n)]
+
+
+def test_loader_batches_and_epochs():
+    dl = DataLoader(_dataset(), batch_size=16, shuffle=True, seed=1)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert batches[0]["x"].shape == (16, 4)
+    dl.set_epoch(1)
+    batches2 = list(dl)
+    assert not np.allclose(batches[0]["x"], batches2[0]["x"])
+
+
+def test_loader_abandoned_iterator_no_thread_leak():
+    before = threading.active_count()
+    for _ in range(5):
+        dl = DataLoader(_dataset(), batch_size=4, prefetch=1)
+        it = iter(dl)
+        next(it)
+        del it  # abandon mid-epoch
+    time.sleep(0.5)
+    assert threading.active_count() <= before + 1
+
+
+def test_repeating_loader():
+    dl = DataLoader(_dataset(8), batch_size=4, shuffle=False)
+    rl = RepeatingLoader(dl)
+    got = [next(rl) for _ in range(5)]  # > one epoch
+    assert got[0]["x"].shape == (4, 4)
+
+
+def test_init_inference_forward(devices):
+    params = {"w": jnp.ones((4, 2), jnp.float32)}
+
+    def apply_fn(p, x):
+        return x @ p["w"]
+
+    eng = init_inference(apply_fn=apply_fn, params=params, dtype="float32")
+    out = eng(jnp.ones((3, 4)))
+    np.testing.assert_allclose(np.asarray(out), np.full((3, 2), 4.0))
+
+
+def test_gradient_accum_only_config():
+    from deepspeed_tpu.config import Config
+
+    c = Config.from_dict({"gradient_accumulation_steps": 4})
+    c.resolve_batch_sizes(dp_world=2)
+    assert c.gradient_accumulation_steps == 4
+    assert c.train_batch_size == 8
+    assert c.train_micro_batch_size_per_gpu == 1

@@ -1,0 +1,129 @@
+"""Optimizer math vs optax references; schedule shapes (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepspeed_tpu import lr_schedules
+from deepspeed_tpu.ops import optim
+
+
+def _params(rng):
+    return {"a": jnp.asarray(rng.normal(size=(4, 8)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(8,)), jnp.float32)}
+
+
+def _grads(rng, params):
+    return jax.tree.map(
+        lambda p: jnp.asarray(rng.normal(size=p.shape), jnp.float32), params)
+
+
+def _run(opt, ref_opt, rng_seed=0, steps=5, rtol=1e-5):
+    rng = np.random.default_rng(rng_seed)
+    p_ours = _params(rng)
+    p_ref = jax.tree.map(jnp.copy, p_ours)
+    s_ours = opt.init(p_ours)
+    s_ref = ref_opt.init(p_ref)
+    grng = np.random.default_rng(42)
+    for _ in range(steps):
+        g = _grads(grng, p_ours)
+        u, s_ours = opt.update(g, s_ours, p_ours)
+        p_ours = jax.tree.map(lambda p, d: p + d, p_ours, u)
+        ru, s_ref = ref_opt.update(g, s_ref, p_ref)
+        p_ref = optax.apply_updates(p_ref, ru)
+    for a, b in zip(jax.tree.leaves(p_ours), jax.tree.leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=rtol, atol=1e-5)
+
+
+def test_adamw_matches_optax():
+    _run(optim.adamw(lr=1e-2, weight_decay=0.01),
+         optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01))
+
+
+def test_adam_matches_optax():
+    _run(optim.adam(lr=1e-2, weight_decay=0.0, adamw=True),
+         optax.adam(1e-2))
+
+
+def test_lion_matches_optax():
+    _run(optim.lion(lr=1e-3, weight_decay=0.0),
+         optax.lion(1e-3, weight_decay=0.0))
+
+
+def test_sgd_momentum_matches_optax():
+    _run(optim.sgd(lr=1e-2, momentum=0.9),
+         optax.sgd(1e-2, momentum=0.9))
+
+
+def test_adagrad_decreases_quadratic():
+    opt = optim.adagrad(lr=0.5)
+    p = {"x": jnp.ones((4,), jnp.float32) * 3}
+    s = opt.init(p)
+    for _ in range(50):
+        g = jax.tree.map(lambda v: 2 * v, p)
+        u, s = opt.update(g, s, p)
+        p = jax.tree.map(lambda v, d: v + d, p, u)
+    assert float(jnp.abs(p["x"]).max()) < 1.0
+
+
+def test_lamb_trust_ratio_bounded():
+    opt = optim.lamb(lr=1e-2)
+    rng = np.random.default_rng(0)
+    p = _params(rng)
+    s = opt.init(p)
+    g = _grads(rng, p)
+    u, s = opt.update(g, s, p)
+    for leaf in jax.tree.leaves(u):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_registry_ref_spellings():
+    o = optim.from_config("FusedAdam".lower(), {"lr": 1e-3, "betas": [0.9, 0.99],
+                                                "adam_w_mode": False})
+    assert o.name in ("adam", "adamw")
+    with pytest.raises(ValueError):
+        optim.from_config("nope", {})
+
+
+# ---------------------------------------------------------------- schedules
+def test_warmup_lr():
+    f = lr_schedules.warmup_lr(0.0, 1e-3, 100, warmup_type="linear")
+    assert float(f(jnp.int32(0))) == 0.0
+    assert abs(float(f(jnp.int32(50))) - 5e-4) < 1e-8
+    assert abs(float(f(jnp.int32(1000))) - 1e-3) < 1e-8
+
+
+def test_warmup_decay_lr():
+    f = lr_schedules.warmup_decay_lr(1000, 0.0, 1e-3, 100, "linear")
+    assert abs(float(f(jnp.int32(100))) - 1e-3) < 1e-6
+    assert float(f(jnp.int32(1000))) <= 1e-6
+    assert float(f(jnp.int32(550))) < 1e-3
+
+
+def test_warmup_cosine_endpoints():
+    f = lr_schedules.warmup_cosine_lr(1000, warmup_num_steps=100,
+                                      warmup_max_lr=1e-3)
+    mid = float(f(jnp.int32(550)))
+    assert 0 < mid < 1e-3
+    assert float(f(jnp.int32(1000))) < 1e-4
+
+
+def test_one_cycle():
+    f = lr_schedules.one_cycle(1e-4, 1e-3, 100)
+    assert abs(float(f(jnp.int32(100))) - 1e-3) < 1e-6
+    assert abs(float(f(jnp.int32(200))) - 1e-4) < 1e-6
+
+
+def test_lr_range_test():
+    f = lr_schedules.lr_range_test(1e-6, 100, 1.0)
+    assert float(f(jnp.int32(100))) > float(f(jnp.int32(0)))
+
+
+def test_schedule_registry():
+    f = lr_schedules.from_config("WarmupLR", {"warmup_num_steps": 10})
+    assert callable(f)
+    g = lr_schedules.from_config(None, {}, fallback_lr=5e-4)
+    assert abs(float(g(jnp.int32(7))) - 5e-4) < 1e-9
