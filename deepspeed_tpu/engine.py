@@ -67,15 +67,16 @@ class TrainingEngine:
     config: parsed :class:`~deepspeed_tpu.config.Config`.
     mesh: :class:`~deepspeed_tpu.topology.MeshSpec`; default built from
         ``config.mesh`` over all devices.
-    base_spec_fn: optional ``leaf -> PartitionSpec`` giving model-parallel
-        (TP) shardings that ZeRO layers the data axis on top of.
+    param_specs: optional model-parallel (TP) shardings — a pytree of
+        PartitionSpec matching params, or a callable ``leaf -> spec``;
+        ZeRO layers the data axis on top of these.
     """
 
     def __init__(self, loss_fn: Callable, params: Any, config: Config,
                  mesh: Optional[MeshSpec] = None,
                  optimizer: Optional[Optimizer] = None,
                  lr_scheduler=None,
-                 base_spec_fn: Optional[Callable] = None,
+                 param_specs: "zero.SpecTree" = None,
                  has_aux: bool = False):
         self.config = config
         self.mesh = mesh or MeshSpec.build(
@@ -83,7 +84,7 @@ class TrainingEngine:
         config.resolve_batch_sizes(self.mesh.dp_world)
         self.loss_fn = loss_fn
         self.has_aux = has_aux
-        self.base_spec_fn = base_spec_fn
+        self.param_specs = param_specs
         stage = config.zero.stage
 
         # ---- optimizer + schedule (ref: engine._configure_optimizer)
@@ -109,10 +110,10 @@ class TrainingEngine:
             if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
             params)
         self.param_shardings = zero.param_shardings(
-            params, self.mesh, stage, base_spec_fn)
+            params, self.mesh, stage, param_specs)
         opt_state_shape = jax.eval_shape(self.optimizer.init, params)
         self.opt_shardings = zero.optstate_shardings(
-            opt_state_shape, self.mesh, stage, base_spec_fn)
+            opt_state_shape, params, self.mesh, stage, param_specs)
         repl = self.mesh.replicated()
         self.state_shardings = TrainState(
             step=repl, params=self.param_shardings,
@@ -175,7 +176,7 @@ class TrainingEngine:
         def micro(carry, mb):
             gacc, lacc = carry
             g, (loss, _aux) = grad_fn(state.params, mb)
-            g = zero.grad_constraint(g, self.mesh, stage, self.base_spec_fn)
+            g = zero.grad_constraint(g, self.mesh, stage, self.param_specs)
             gacc = jax.tree.map(lambda a, b: a + b.astype(jnp.float32), gacc, g)
             return (gacc, lacc + loss), None
 
@@ -184,18 +185,17 @@ class TrainingEngine:
             mbatch = jax.tree.map(
                 lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
                 batch)
-            zeros = jax.tree.map(
-                lambda p: zero.grad_constraint(
-                    jnp.zeros(p.shape, jnp.float32), self.mesh, stage,
-                    self.base_spec_fn) if stage >= 2 else jnp.zeros(p.shape, jnp.float32),
-                state.params)
+            zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                 state.params)
+            zeros = zero.grad_constraint(zeros, self.mesh, stage,
+                                         self.param_specs)
             (grads, loss_sum), _ = jax.lax.scan(
                 micro, (zeros, jnp.float32(0.0)), mbatch)
             grads = jax.tree.map(lambda g: g / accum, grads)
             loss = loss_sum / accum
         else:
             grads, (loss, _aux) = grad_fn(state.params, batch)
-            grads = zero.grad_constraint(grads, self.mesh, stage, self.base_spec_fn)
+            grads = zero.grad_constraint(grads, self.mesh, stage, self.param_specs)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
 
         grads, ok, new_scaler = precision.unscale_and_check(
@@ -308,7 +308,7 @@ class TrainingEngine:
 def initialize(args=None, *, loss_fn: Callable, params: Any,
                config: Any = None, mesh: Optional[MeshSpec] = None,
                optimizer: Optional[Optimizer] = None,
-               lr_scheduler=None, base_spec_fn: Optional[Callable] = None,
+               lr_scheduler=None, param_specs: "zero.SpecTree" = None,
                training_data=None, has_aux: bool = False,
                dist_init_required: Optional[bool] = None):
     """ref: deepspeed.initialize — returns (engine, optimizer, dataloader,
@@ -328,7 +328,7 @@ def initialize(args=None, *, loss_fn: Callable, params: Any,
 
     engine = TrainingEngine(loss_fn, params, config, mesh=mesh,
                             optimizer=optimizer, lr_scheduler=lr_scheduler,
-                            base_spec_fn=base_spec_fn, has_aux=has_aux)
+                            param_specs=param_specs, has_aux=has_aux)
     dataloader = None
     if training_data is not None:
         from deepspeed_tpu.data.loader import DataLoader

@@ -17,7 +17,7 @@ import jax
 from deepspeed_tpu import precision
 from deepspeed_tpu.config import Config, PrecisionConfig
 from deepspeed_tpu.topology import MeshSpec, default_mesh
-from deepspeed_tpu.zero import param_shardings
+from deepspeed_tpu.zero import SpecTree, param_shardings
 
 
 class InferenceEngine:
@@ -28,14 +28,14 @@ class InferenceEngine:
 
     def __init__(self, apply_fn: Callable, params: Any,
                  mesh: Optional[MeshSpec] = None,
-                 base_spec_fn: Optional[Callable] = None,
+                 param_specs: SpecTree = None,
                  dtype: str = "bfloat16"):
         self.mesh = mesh or default_mesh()
         self.apply_fn = apply_fn
         pcfg = PrecisionConfig(dtype=dtype)
         params = precision.cast_for_compute(params, pcfg)
         shardings = param_shardings(params, self.mesh, stage=0,
-                                    base_spec_fn=base_spec_fn)
+                                    param_specs=param_specs)
         self.params = jax.jit(lambda p: p, out_shardings=shardings)(params)
         self._fwd = jax.jit(apply_fn)
 
@@ -49,7 +49,7 @@ class InferenceEngine:
 def init_inference(model: Any = None, *, apply_fn: Optional[Callable] = None,
                    params: Any = None, config: Any = None,
                    mesh: Optional[MeshSpec] = None,
-                   base_spec_fn: Optional[Callable] = None,
+                   param_specs: SpecTree = None,
                    dtype: str = "bfloat16", **_compat) -> InferenceEngine:
     """ref: deepspeed.init_inference(model, config…) → engine.
 
@@ -66,4 +66,4 @@ def init_inference(model: Any = None, *, apply_fn: Optional[Callable] = None,
     if params is None:
         raise ValueError("init_inference requires params")
     return InferenceEngine(apply_fn, params, mesh=mesh,
-                           base_spec_fn=base_spec_fn, dtype=dtype)
+                           param_specs=param_specs, dtype=dtype)

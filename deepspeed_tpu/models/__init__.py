@@ -1,0 +1,4 @@
+"""Model families (SURVEY.md §2 #37): llama (flagship), gpt2, cnn,
+mixtral (MoE), bert."""
+
+from deepspeed_tpu.models import llama, gpt2, cnn

@@ -1,0 +1,67 @@
+"""CIFAR-10 CNN (ref: DeepSpeedExamples/training/cifar — the reference's
+ZeRO-0 smoke benchmark; BASELINE.json config #1).
+
+Small conv net in pure JAX (lax.conv_general_dilated drives the MXU for
+the conv contractions)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class CNNConfig:
+    num_classes: int = 10
+    channels: int = 32
+
+
+def init_params(rng: jax.Array, cfg: CNNConfig = CNNConfig(),
+                dtype=jnp.float32) -> Dict[str, Any]:
+    k = jax.random.split(rng, 4)
+    c = cfg.channels
+
+    def w(key, *sh):
+        fan_in = int(jnp.prod(jnp.array(sh[:-1])))
+        return (jax.random.normal(key, sh) / jnp.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "conv1": w(k[0], 3, 3, 3, c), "b1": jnp.zeros((c,), dtype),
+        "conv2": w(k[1], 3, 3, c, 2 * c), "b2": jnp.zeros((2 * c,), dtype),
+        "fc1": w(k[2], 2 * c * 8 * 8, 128), "fb1": jnp.zeros((128,), dtype),
+        "fc2": w(k[3], 128, cfg.num_classes),
+        "fb2": jnp.zeros((cfg.num_classes,), dtype),
+    }
+
+
+def _conv(x, w, b):
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return y + b
+
+
+def _pool(x):
+    return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                 (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+
+
+def forward(params, images):
+    """images: [B, 32, 32, 3] → logits [B, num_classes]."""
+    images = images.astype(params["conv1"].dtype)  # match compute dtype (bf16)
+    x = jax.nn.relu(_conv(images, params["conv1"], params["b1"]))
+    x = _pool(x)
+    x = jax.nn.relu(_conv(x, params["conv2"], params["b2"]))
+    x = _pool(x)
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"] + params["fb1"])
+    return (x @ params["fc2"] + params["fb2"]).astype(jnp.float32)
+
+
+def loss_fn(params, batch):
+    logits = forward(params, batch["images"])
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, batch["labels"][:, None], 1))

@@ -1,0 +1,132 @@
+"""GPT-2 family (ref: the DeepSpeed Megatron-GPT2 example path; module
+structure per deepspeed/module_inject/containers/gpt2.py).
+
+Same stacked-layer scan design as :mod:`deepspeed_tpu.models.llama`;
+differences: learned positional embeddings, LayerNorm (with bias), fused
+QKV projection, GELU MLP, tied LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.fused_ops import layer_norm
+
+
+@dataclasses.dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    max_seq_len: int = 1024
+    norm_eps: float = 1e-5
+    remat: str = "none"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def gpt2_1_3b(cls, **kw):
+        # "GPT-2 1.3B" config used by the reference's ZeRO-2 benchmark
+        return cls(dim=2048, n_layers=24, n_heads=16, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("dim", 64)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 4)
+        kw.setdefault("max_seq_len", 128)
+        return cls(**kw)
+
+
+def init_params(rng: jax.Array, cfg: GPT2Config, dtype=jnp.float32) -> Dict[str, Any]:
+    k = jax.random.split(rng, 6)
+    d, L = cfg.dim, cfg.n_layers
+    std = 0.02
+
+    def w(key, *sh):
+        return (jax.random.normal(key, sh) * std).astype(dtype)
+
+    return {
+        "wte": w(k[0], cfg.vocab_size, d),
+        "wpe": w(k[1], cfg.max_seq_len, d),
+        "blocks": {
+            "ln1_w": jnp.ones((L, d), dtype), "ln1_b": jnp.zeros((L, d), dtype),
+            "qkv_w": w(k[2], L, d, 3 * d), "qkv_b": jnp.zeros((L, 3 * d), dtype),
+            "proj_w": w(k[3], L, d, d), "proj_b": jnp.zeros((L, d), dtype),
+            "ln2_w": jnp.ones((L, d), dtype), "ln2_b": jnp.zeros((L, d), dtype),
+            "fc_w": w(k[4], L, d, 4 * d), "fc_b": jnp.zeros((L, 4 * d), dtype),
+            "out_w": w(k[5], L, 4 * d, d), "out_b": jnp.zeros((L, d), dtype),
+        },
+        "lnf_w": jnp.ones((d,), dtype), "lnf_b": jnp.zeros((d,), dtype),
+    }
+
+
+def param_specs(cfg: GPT2Config) -> Dict[str, Any]:
+    col, row = P(None, None, "model"), P(None, "model", None)
+    return {
+        "wte": P(None, "model"), "wpe": P(),
+        "blocks": {
+            "ln1_w": P(), "ln1_b": P(),
+            "qkv_w": col, "qkv_b": P(None, "model"),
+            "proj_w": row, "proj_b": P(),
+            "ln2_w": P(), "ln2_b": P(),
+            "fc_w": col, "fc_b": P(None, "model"),
+            "out_w": row, "out_b": P(),
+        },
+        "lnf_w": P(), "lnf_b": P(),
+    }
+
+
+def _block(cfg: GPT2Config, x, lp):
+    B, T, d = x.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+    h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.norm_eps)
+    qkv = h @ lp["qkv_w"] + lp["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, nh, hd)
+    k = k.reshape(B, T, nh, hd)
+    v = v.reshape(B, T, nh, hd)
+    from deepspeed_tpu.ops.attention import flash_attention
+
+    attn = flash_attention(q, k, v, causal=True).reshape(B, T, d)
+    x = x + attn @ lp["proj_w"] + lp["proj_b"]
+    h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.norm_eps)
+    h = jax.nn.gelu(h @ lp["fc_w"] + lp["fc_b"], approximate=True)
+    return x + h @ lp["out_w"] + lp["out_b"]
+
+
+def forward(params, tokens, cfg: GPT2Config):
+    B, T = tokens.shape
+    x = params["wte"][tokens] + params["wpe"][:T][None]
+
+    block = lambda x, lp: (_block(cfg, x, lp), None)
+    if cfg.remat != "none":
+        from deepspeed_tpu.remat import policy as remat_policy
+
+        block = jax.checkpoint(block, policy=remat_policy(cfg.remat))
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+    x = layer_norm(x, params["lnf_w"], params["lnf_b"], cfg.norm_eps)
+    return jnp.einsum("btd,vd->btv", x, params["wte"],
+                      preferred_element_type=jnp.float32)
+
+
+def loss_fn(cfg: GPT2Config):
+    def f(params, batch):
+        tokens = batch["tokens"]
+        logits = forward(params, tokens[:, :-1], cfg)
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    return f

@@ -1,0 +1,272 @@
+"""Llama model family, TPU-first (flagship; SURVEY.md §2 #37).
+
+Reference behavior: the DeepSpeed examples' Megatron-GPT / HF-Llama
+training paths (ref: deepspeed/module_inject/containers/llama.py for the
+module structure the reference injects into).
+
+TPU-first design decisions:
+- **Stacked layers + ``lax.scan``**: all transformer blocks' params are
+  stacked on a leading ``[L, ...]`` axis and the forward is a scan over
+  that axis.  One block gets compiled once (fast XLA compiles at depth),
+  and the stacked layout is exactly what pipeline parallelism shards.
+- **bf16 compute, f32 accumulation**: matmuls carry
+  ``preferred_element_type=float32`` where accuracy matters (logits, att
+  softmax) and bf16 elsewhere, keeping the MXU fed.
+- **TP via spec tree**: ``param_specs()`` returns column-parallel
+  (attn qkv, mlp in) / row-parallel (attn out, mlp out) PartitionSpecs
+  over the ``model`` axis — XLA inserts the psum the Megatron pattern
+  hand-codes.
+- **GQA**: n_kv_heads <= n_heads with head-group broadcast.
+- **Sequence axis ready**: activations carry a ``seq``-shardable layout;
+  ring attention (``parallel/ring_attention.py``) plugs in via
+  ``attn_impl="ring"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    ffn_dim: Optional[int] = None          # default 8/3 * dim rounded to 128
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    remat: str = "none"                    # none | full | save_dots
+    attn_impl: str = "auto"                # auto | flash | reference | ring
+
+    def __post_init__(self):
+        if self.ffn_dim is None:
+            self.ffn_dim = int(np.ceil(self.dim * 8 / 3 / 128) * 128)
+        assert self.n_heads % self.n_kv_heads == 0
+        assert self.dim % self.n_heads == 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def llama3_8b(cls, **kw):
+        return cls(vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=8, ffn_dim=14336, rope_theta=500000.0, **kw)
+
+    @classmethod
+    def llama3_70b(cls, **kw):
+        return cls(vocab_size=128256, dim=8192, n_layers=80, n_heads=64,
+                   n_kv_heads=8, ffn_dim=28672, rope_theta=500000.0, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("dim", 64)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 4)
+        kw.setdefault("n_kv_heads", 2)
+        kw.setdefault("max_seq_len", 128)
+        return cls(**kw)
+
+    def flops_per_token(self) -> float:
+        """Training FLOPs/token (fwd+bwd ≈ 6 * params + attention term)."""
+        n = param_count(self)
+        attn = 12 * self.n_layers * self.dim * self.max_seq_len  # qk^T + av
+        return 6 * n + attn
+
+
+def param_count(cfg: LlamaConfig) -> int:
+    d, f, l = cfg.dim, cfg.ffn_dim, cfg.n_layers
+    kvd = cfg.n_kv_heads * cfg.head_dim
+    per_layer = (d * d) + (d * kvd) * 2 + (d * d) + (d * f) * 3 + 2 * d
+    emb = cfg.vocab_size * d
+    head = 0 if cfg.tie_embeddings else cfg.vocab_size * d
+    return int(l * per_layer + emb + head + d)
+
+
+# ---------------------------------------------------------------------- init
+def init_params(rng: jax.Array, cfg: LlamaConfig,
+                dtype=jnp.float32) -> Dict[str, Any]:
+    k = jax.random.split(rng, 8)
+    d, f, L = cfg.dim, cfg.ffn_dim, cfg.n_layers
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    s = lambda *sh: 1.0 / np.sqrt(sh[-2] if len(sh) > 1 else sh[-1])
+
+    def w(key, *sh):
+        return (jax.random.normal(key, sh) * s(*sh)).astype(dtype)
+
+    params = {
+        "embed": w(k[0], cfg.vocab_size, d),
+        "blocks": {
+            "attn_norm": jnp.ones((L, d), dtype),
+            "wq": w(k[1], L, d, nh * hd),
+            "wk": w(k[2], L, d, nkv * hd),
+            "wv": w(k[3], L, d, nkv * hd),
+            "wo": w(k[4], L, nh * hd, d),
+            "mlp_norm": jnp.ones((L, d), dtype),
+            "w1": w(k[5], L, d, f),   # gate
+            "w3": w(k[6], L, d, f),   # up
+            "w2": w(k[7], L, f, d),   # down
+        },
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(jax.random.fold_in(rng, 99), d, cfg.vocab_size)
+    return params
+
+
+def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Tensor-parallel shardings over the ``model`` axis (Megatron layout:
+    column-parallel into the block, row-parallel out, psum inserted by XLA).
+    Dim 0 of block leaves is the stacked layer axis → the ``pipe`` axis
+    shards it when pipeline parallelism is on."""
+    col, row = P(None, None, "model"), P(None, "model", None)
+    specs = {
+        # feature-dim sharding: token gather stays local (vocab-dim sharding
+        # makes XLA fall back to full rematerialization on the gather)
+        "embed": P(None, "model"),
+        "blocks": {
+            "attn_norm": P(None, None),
+            "wq": col, "wk": col, "wv": col, "wo": row,
+            "mlp_norm": P(None, None),
+            "w1": col, "w3": col, "w2": row,
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "model")
+    return specs
+
+
+# ------------------------------------------------------------------- forward
+def rms_norm(x, weight, eps):
+    from deepspeed_tpu.ops.fused_ops import rms_norm as _rms
+
+    return _rms(x, weight, eps)
+
+
+def rope_tables(cfg: LlamaConfig, positions: jnp.ndarray):
+    """positions: [T] int32 → (cos, sin) [T, head_dim/2] in f32."""
+    half = cfg.head_dim // 2
+    freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, T, H, Dh]; rotate pairs (x1, x2) = (x[..., :half], x[..., half:])."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def _attention(q, k, v, cfg: LlamaConfig, segment_ids=None):
+    """q: [B,T,H,Dh], k/v: [B,T,KV,Dh] → [B,T,H,Dh]."""
+    impl = cfg.attn_impl
+    if impl in ("auto", "flash"):
+        try:
+            from deepspeed_tpu.ops.attention import flash_attention
+
+            return flash_attention(q, k, v, causal=True,
+                                   segment_ids=segment_ids)
+        except Exception:
+            if impl == "flash":
+                raise
+    if impl == "ring":
+        from deepspeed_tpu.parallel.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, axis_name="seq", causal=True)
+    return reference_attention(q, k, v, causal=True, segment_ids=segment_ids)
+
+
+def reference_attention(q, k, v, causal=True, segment_ids=None):
+    """Plain jnp attention (numeric ground truth for the pallas kernels)."""
+    B, T, H, Dh = q.shape
+    KV = k.shape[2]
+    if KV != H:  # GQA: broadcast kv heads over query groups
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bthd,bshd->bhts", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(Dh)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    if segment_ids is not None:
+        same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        scores = jnp.where(same, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def _block(cfg: LlamaConfig, x, layer_params, cos, sin, segment_ids):
+    B, T, d = x.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    lp = layer_params
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, T, nh, hd)
+    k = (h @ lp["wk"]).reshape(B, T, nkv, hd)
+    v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = _attention(q, k, v, cfg, segment_ids).reshape(B, T, nh * hd)
+    x = x + attn @ lp["wo"]
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    from deepspeed_tpu.ops.fused_ops import swiglu
+
+    x = x + swiglu(h, lp["w1"], lp["w3"]) @ lp["w2"]
+    return x
+
+
+def forward(params, tokens, cfg: LlamaConfig, positions=None,
+            segment_ids=None):
+    """tokens: [B, T] int32 → logits [B, T, V] (f32)."""
+    B, T = tokens.shape
+    x = params["embed"][tokens]  # [B, T, d]
+    if positions is None:
+        positions = jnp.arange(T, dtype=jnp.int32)
+    cos, sin = rope_tables(cfg, positions)
+
+    block = lambda x, lp: (_block(cfg, x, lp, cos, sin, segment_ids), None)
+    if cfg.remat != "none":
+        from deepspeed_tpu.remat import policy as remat_policy
+
+        block = jax.checkpoint(block, policy=remat_policy(cfg.remat))
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.einsum("btd,dv->btv", x, head,
+                      preferred_element_type=jnp.float32)
+
+
+def loss_fn(cfg: LlamaConfig):
+    """Causal-LM next-token cross entropy; batch = {tokens, (loss_mask)}."""
+
+    def f(params, batch):
+        tokens = batch["tokens"]
+        logits = forward(params, tokens[:, :-1], cfg,
+                         segment_ids=batch.get("segment_ids"))
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        mask = batch.get("loss_mask")
+        if mask is None:
+            return jnp.mean(nll)
+        mask = mask[:, 1:].astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    return f

@@ -1,0 +1,53 @@
+"""Attention kernels (ref: deepspeed/ops/transformer CUDA attention +
+ops/transformer/inference).
+
+``flash_attention`` is the training entrypoint: a Pallas TPU kernel
+(block-tiled online-softmax, fwd+bwd custom VJP) with a jnp reference
+fallback for CPU/interpret runs.  The kernel lands in
+:mod:`deepspeed_tpu.ops.attention_pallas`; this module owns dispatch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _reference(q, k, v, causal=True, segment_ids=None):
+    B, T, H, Dh = q.shape
+    KV = k.shape[2]
+    if KV != H:
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bthd,bshd->bhts", q, k,
+                        preferred_element_type=jnp.float32) / np.sqrt(Dh)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, k.shape[1]), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    if segment_ids is not None:
+        same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        scores = jnp.where(same, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def flash_attention(q, k, v, causal: bool = True, segment_ids=None):
+    """[B,T,H,Dh] x [B,T,KV,Dh]^2 → [B,T,H,Dh].
+
+    Dispatches to the Pallas TPU kernel when running on TPU with
+    kernel-friendly shapes; otherwise the fused-softmax jnp reference
+    (which XLA still fuses well).
+    """
+    on_tpu = jax.default_backend() == "tpu"
+    T = q.shape[1]
+    if on_tpu and segment_ids is None and T >= 256 and T % 128 == 0 \
+            and q.shape[-1] in (64, 128):
+        try:
+            from deepspeed_tpu.ops.attention_pallas import flash_attention_tpu
+
+            return flash_attention_tpu(q, k, v, causal=causal)
+        except ImportError:
+            pass
+    return _reference(q, k, v, causal=causal, segment_ids=segment_ids)

@@ -1,0 +1,37 @@
+"""Activation checkpointing policies (ref:
+deepspeed/runtime/activation_checkpointing/checkpointing.py).
+
+The reference re-implements torch checkpointing with partitioned/offloaded
+activation storage.  On TPU this is ``jax.checkpoint`` + a rematerialization
+policy: XLA recomputes the block in backward, trading FLOPs for HBM, and
+GSPMD already keeps activations sharded (the reference's
+``partition_activations``).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def policy(name: str):
+    """Map config policy names to jax.checkpoint policies."""
+    if name in ("none", None):
+        return None
+    if name == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    if name == "save_dots":
+        # keep matmul outputs, recompute elementwise — the usual sweet spot
+        return jax.checkpoint_policies.checkpoint_dots
+    if name == "save_dots_no_batch":
+        return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    if name == "save_attn":
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "mlp_out")
+    raise ValueError(f"unknown remat policy {name!r}")
+
+
+def checkpoint_block(fn, name: str = "full"):
+    """Wrap a layer function with the named remat policy."""
+    if name in ("none", None):
+        return fn
+    return jax.checkpoint(fn, policy=policy(name))

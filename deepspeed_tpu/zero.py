@@ -22,16 +22,37 @@ stage     optimizer state         gradients           parameters
 We express each column as a per-leaf ``NamedSharding`` and let XLA insert
 the exact all-gather / reduce-scatter schedule the reference hand-codes —
 overlapped with compute by the XLA latency-hiding scheduler, riding ICI.
+
+Model-parallel (TP) shardings compose: callers pass ``param_specs`` — a
+pytree of ``PartitionSpec`` matching the params pytree (or a callable
+``leaf -> spec``) — and the ZeRO data axis is layered onto the remaining
+unsharded dimension.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Union
 
 import jax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.topology import MeshSpec, ZERO_AXES, shard_leaf_spec
+
+SpecTree = Union[None, Callable, Any]
+
+
+def resolve_specs(params: Any, param_specs: SpecTree) -> Any:
+    """Normalize ``param_specs`` (None | callable | pytree) to a spec pytree.
+
+    In the pytree form, a ``None`` leaf means replicated (the usual JAX
+    convention) and is normalized to ``P()``.
+    """
+    if param_specs is None:
+        return jax.tree.map(lambda _: P(), params)
+    if callable(param_specs):
+        return jax.tree.map(param_specs, params)
+    return jax.tree.map(lambda s: P() if s is None else s, param_specs,
+                        is_leaf=lambda x: x is None or isinstance(x, P))
 
 
 def _zero_axis_size(ms: MeshSpec) -> int:
@@ -41,65 +62,82 @@ def _zero_axis_size(ms: MeshSpec) -> int:
     return n
 
 
-def _leaf_spec(leaf, ms: MeshSpec, base_spec_fn: Optional[Callable] = None) -> P:
-    """Shard one leaf over the ZeRO (data) axis, on top of any model-parallel
-    sharding the model already declared via ``base_spec_fn``."""
+def _zero_spec(leaf, base: P, ms: MeshSpec) -> P:
+    """Layer the data axis onto ``base`` for one leaf."""
     shape = getattr(leaf, "shape", ())
     if len(shape) == 0:
         return P()
-    base = base_spec_fn(leaf) if base_spec_fn else P()
-    taken = list(base) + [None] * (len(shape) - len(base))
+    base = () if base is None else base
+    # truncate: state leaves may have lower rank than the param they mirror
+    # (e.g. factored second moments)
+    taken = list(base)[:len(shape)] + [None] * max(0, len(shape) - len(base))
     return shard_leaf_spec(shape, "data", ms.size("data"), taken=taken)
 
 
 def param_shardings(params: Any, ms: MeshSpec, stage: int,
-                    base_spec_fn: Optional[Callable] = None):
-    """Shardings for the master parameter pytree.
+                    param_specs: SpecTree = None):
+    """Shardings for the master parameter pytree (stage 3 adds data axis)."""
+    specs = resolve_specs(params, param_specs)
 
-    ``base_spec_fn(leaf) -> PartitionSpec`` supplies model-parallel (TP)
-    sharding; ZeRO stage 3 layers the data axis on top of it.
-    """
-    def one(leaf):
-        base = base_spec_fn(leaf) if base_spec_fn else P()
+    def one(leaf, base):
         if stage >= 3 and _zero_axis_size(ms) > 1:
-            return ms.sharding(_leaf_spec(leaf, ms, base_spec_fn))
+            return ms.sharding(_zero_spec(leaf, base, ms))
         return ms.sharding(base)
 
-    return jax.tree.map(one, params)
+    return jax.tree.map(one, params, specs)
 
 
-def optstate_shardings(opt_state: Any, ms: MeshSpec, stage: int,
-                       base_spec_fn: Optional[Callable] = None):
-    """Shardings for optimizer-state pytrees (m, v, master copies …).
+def optstate_shardings(opt_state: Any, params: Any, ms: MeshSpec, stage: int,
+                       param_specs: SpecTree = None):
+    """Shardings for optimizer-state pytrees.
 
-    Stage >=1 shards every non-scalar leaf over the data axis
-    (ref: stage_1_and_2.py partitions fp32 optimizer state).
+    Subtrees that mirror the params structure (moments, master copies) get
+    the params' specs (+ data axis for stage >=1, ref: stage_1_and_2.py
+    partitioning of fp32 optimizer state); stray leaves are replicated.
     """
-    def one(leaf):
-        if stage >= 1 and _zero_axis_size(ms) > 1:
-            return ms.sharding(_leaf_spec(leaf, ms, base_spec_fn))
-        base = base_spec_fn(leaf) if base_spec_fn else P()
+    specs = resolve_specs(params, param_specs)
+    pstruct = jax.tree.structure(params)
+    shard_state = stage >= 1 and _zero_axis_size(ms) > 1
+
+    def spec_for(leaf, base):
+        if shard_state:
+            return ms.sharding(_zero_spec(leaf, base, ms))
         return ms.sharding(base if getattr(leaf, "ndim", 0) else P())
 
-    return jax.tree.map(one, opt_state)
+    def rec(node):
+        if node is None:
+            return None
+        try:
+            if jax.tree.structure(node) == pstruct:
+                return jax.tree.map(spec_for, node, specs)
+        except Exception:
+            pass
+        if jax.tree_util.all_leaves([node]):
+            # stray leaf (step counters etc.): shard if it's a real array,
+            # replicate scalars
+            if shard_state and getattr(node, "ndim", 0) >= 1:
+                return ms.sharding(_zero_spec(node, P(), ms))
+            return ms.replicated()
+        # generic one-level recursion — works for any registered pytree
+        # container (dataclass states, optax NamedTuples, dicts, ...)
+        one_level = jax.tree.structure(node, is_leaf=lambda x: x is not node)
+        children = one_level.flatten_up_to(node)
+        return jax.tree.unflatten(one_level, [rec(c) for c in children])
+
+    return rec(opt_state)
 
 
 def grad_constraint(grads: Any, ms: MeshSpec, stage: int,
-                    base_spec_fn: Optional[Callable] = None):
-    """Apply in-jit sharding constraints to gradients.
-
-    Stage >=2: constrain each grad leaf to the data-sharded layout, which
-    makes XLA produce a reduce-scatter instead of an all-reduce
-    (ref: stage_1_and_2.py ``reduce_scatter_gradients``).
-    """
+                    param_specs: SpecTree = None):
+    """Stage >=2: constrain grads to the data-sharded layout so XLA emits a
+    reduce-scatter instead of an all-reduce (ref: stage_1_and_2.py
+    ``reduce_scatter_gradients``)."""
     if stage < 2 or _zero_axis_size(ms) == 1:
         return grads
-
-    def one(g):
-        return jax.lax.with_sharding_constraint(
-            g, ms.sharding(_leaf_spec(g, ms, base_spec_fn)))
-
-    return jax.tree.map(one, grads)
+    specs = resolve_specs(grads, param_specs)
+    return jax.tree.map(
+        lambda g, base: jax.lax.with_sharding_constraint(
+            g, ms.sharding(_zero_spec(g, base, ms))), grads, specs)
 
 
 def unshard_params(params: Any, ms: MeshSpec):

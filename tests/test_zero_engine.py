@@ -132,7 +132,7 @@ def test_tp_base_spec(devices):
         config={"train_batch_size": 32, "zero_optimization": {"stage": 3},
                 "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
                 "mesh": {"data": 4, "model": 2}},
-        mesh=ms, base_spec_fn=base_spec)
+        mesh=ms, param_specs=base_spec)
     base, _ = _train(0)
     batch = _data(np.random.default_rng(123))
     losses = [float(engine.train_batch(batch)) for _ in range(5)]
