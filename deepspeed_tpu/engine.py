@@ -81,6 +81,10 @@ class TrainingEngine:
         self.config = config
         self.mesh = mesh or MeshSpec.build(
             config.mesh.axis_sizes(jax.device_count()))
+        # publish for model-side sharded ops (ring/ulysses attention, MoE)
+        from deepspeed_tpu import topology as _topo
+
+        _topo.set_current_mesh(self.mesh)
         config.resolve_batch_sizes(self.mesh.dp_world)
         self.loss_fn = loss_fn
         self.has_aux = has_aux
@@ -114,6 +118,12 @@ class TrainingEngine:
         opt_state_shape = jax.eval_shape(self.optimizer.init, params)
         self.opt_shardings = zero.optstate_shardings(
             opt_state_shape, params, self.mesh, stage, param_specs)
+        if config.zero.offload_optimizer or config.zero.offload_param:
+            from deepspeed_tpu.offload import engine_offload_shardings
+
+            self.param_shardings, self.opt_shardings = \
+                engine_offload_shardings(config, self.param_shardings,
+                                         self.opt_shardings)
         repl = self.mesh.replicated()
         self.state_shardings = TrainState(
             step=repl, params=self.param_shardings,
@@ -163,8 +173,18 @@ class TrainingEngine:
         return loss.astype(jnp.float32), aux
 
     def _train_step(self, state: TrainState, batch):
+        # (re)publish the ambient mesh at TRACE time: another engine may
+        # have been constructed since __init__, and model code (ring/
+        # ulysses attention, MoE, pipeline) reads current_mesh() while
+        # tracing this step.
+        from deepspeed_tpu import topology as _topo
+
+        _topo.set_current_mesh(self.mesh)
         cfg = self.config
-        accum = cfg.gradient_accumulation_steps
+        # Pipeline mode: the loss fn consumes the WHOLE batch (microbatching
+        # happens inside the pipelined scan, ref: runtime/pipe/engine.py
+        # train_batch) — no outer accumulation loop.
+        accum = 1 if cfg.pipeline.stages > 1 else cfg.gradient_accumulation_steps
         stage = cfg.zero.stage
 
         def scaled_loss(params, mb):
@@ -224,6 +244,9 @@ class TrainingEngine:
         return new_state, metrics
 
     def _eval_step(self, state: TrainState, batch):
+        from deepspeed_tpu import topology as _topo
+
+        _topo.set_current_mesh(self.mesh)
         loss, aux = self._loss_for(state.params, batch)
         return loss if aux is None else (loss, aux)
 

@@ -37,7 +37,16 @@ class InferenceEngine:
         shardings = param_shardings(params, self.mesh, stage=0,
                                     param_specs=param_specs)
         self.params = jax.jit(lambda p: p, out_shardings=shardings)(params)
-        self._fwd = jax.jit(apply_fn)
+
+        def fwd(p, *inputs):
+            # publish this engine's mesh at trace time (model code may read
+            # current_mesh() for ring/ulysses/MoE sharded ops)
+            from deepspeed_tpu import topology as _topo
+
+            _topo.set_current_mesh(self.mesh)
+            return apply_fn(p, *inputs)
+
+        self._fwd = jax.jit(fwd)
 
     def __call__(self, *inputs):
         return self._fwd(self.params, *inputs)

@@ -1,0 +1,157 @@
+"""Autoregressive generation: KV cache, prefill/decode split, sampling.
+
+Reference behavior: deepspeed/inference/engine.py generate path +
+ops/transformer/inference kernels (decode attention over a KV cache,
+static cache allocation, greedy/temperature sampling).
+
+TPU design: the cache is a static-shape ``[L, B, max_seq, KV, Dh]`` pytree
+(XLA needs static shapes — no dynamic growth); prefill and decode are two
+separately-jitted programs.  Prefill processes the whole prompt at once
+(MXU-friendly big matmuls); decode steps one token with
+``lax.dynamic_update_slice`` cache writes and masked attention up to the
+current length.  Sampling (greedy/temperature/top-k/top-p) runs on-device
+inside the decode jit so generation never round-trips to host per token.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class KVCache(NamedTuple):
+    """Static-shape KV cache; ``length`` = number of valid positions."""
+
+    k: jnp.ndarray          # [L, B, maxT, KV, Dh]
+    v: jnp.ndarray          # [L, B, maxT, KV, Dh]
+    length: jnp.ndarray     # i32 scalar
+
+    @classmethod
+    def alloc(cls, n_layers: int, batch: int, max_seq: int, n_kv: int,
+              head_dim: int, dtype=jnp.bfloat16) -> "KVCache":
+        shape = (n_layers, batch, max_seq, n_kv, head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+
+def cached_attention(q, k_cache, v_cache, new_k, new_v, start_pos,
+                     scale: Optional[float] = None):
+    """Attention of q against cache[:start_pos+T] (ref: the reference's
+    decode-attention kernel contract: softmax(q @ K^T) @ V with the causal
+    frontier at start_pos + local position).
+
+    q: [B, T, H, Dh]; caches [B, maxT, KV, Dh]; new_k/v: [B, T, KV, Dh].
+    Returns (out [B, T, H, Dh], k_cache, v_cache) with new_k/v written at
+    ``start_pos``.
+    """
+    B, T, H, Dh = q.shape
+    maxT, KV = k_cache.shape[1], k_cache.shape[2]
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, new_k.astype(k_cache.dtype), (0, start_pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, new_v.astype(v_cache.dtype), (0, start_pos, 0, 0))
+    if KV != H:
+        rep = H // KV
+        k = jnp.repeat(k_cache, rep, axis=2)
+        v = jnp.repeat(v_cache, rep, axis=2)
+    else:
+        k, v = k_cache, v_cache
+    scale = scale if scale is not None else Dh ** -0.5
+    scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    kpos = jnp.arange(maxT)
+    qpos = start_pos + jnp.arange(T)
+    mask = kpos[None, :] <= qpos[:, None]          # [T, maxT]
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs,
+                     v.astype(jnp.float32)).astype(q.dtype)
+    return out, k_cache, v_cache
+
+
+def sample_logits(logits, rng, temperature: float = 1.0,
+                  top_k: int = 0, top_p: float = 1.0):
+    """logits: [B, V] → token ids [B].  temperature==0 → greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p; cutoff logit value
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+class Generator:
+    """Model-agnostic generation loop over jitted prefill/decode.
+
+    prefill_fn(params, tokens, cache) -> (logits [B,T,V], cache)
+    decode_fn(params, token [B,1], cache) -> (logits [B,1,V], cache)
+    alloc_cache(batch, max_seq) -> KVCache
+    """
+
+    def __init__(self, params, prefill_fn, decode_fn, alloc_cache,
+                 eos_token_id: Optional[int] = None):
+        self.params = params
+        self._prefill = jax.jit(prefill_fn)
+        self._decode = jax.jit(decode_fn)
+        self._alloc = alloc_cache
+        self.eos = eos_token_id
+
+    def generate(self, tokens, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+                 rng: Optional[jax.Array] = None, max_seq: Optional[int] = None):
+        """tokens: [B, T] prompt → [B, T + max_new_tokens] (eos-padded)."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        B, T = tokens.shape
+        total = max_seq or (T + max_new_tokens)
+        cache = self._alloc(B, total)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        logits, cache = self._prefill(self.params, tokens, cache)
+        out = [tokens]
+        rng, step_rng = jax.random.split(rng)
+        next_tok = sample_logits(logits[:, -1], step_rng, temperature,
+                                 top_k, top_p)[:, None]
+        done = jnp.zeros((B,), bool)
+        for _ in range(max_new_tokens - 1):
+            if self.eos is not None:
+                done = done | (next_tok[:, 0] == self.eos)
+            out.append(next_tok)
+            if self.eos is not None and bool(done.all()):
+                break
+            logits, cache = self._decode(self.params, next_tok, cache)
+            rng, step_rng = jax.random.split(rng)
+            nxt = sample_logits(logits[:, -1], step_rng, temperature,
+                                top_k, top_p)[:, None]
+            if self.eos is not None:
+                nxt = jnp.where(done[:, None], jnp.int32(self.eos), nxt)
+            next_tok = nxt
+        out.append(next_tok)
+        return jnp.concatenate(out, axis=1)
+
+
+def llama_generator(params, cfg, eos_token_id: Optional[int] = None,
+                    cache_dtype=jnp.bfloat16) -> Generator:
+    """Build a :class:`Generator` for models/llama.py weights."""
+    from deepspeed_tpu.models import llama
+
+    def alloc(batch, max_seq):
+        return KVCache.alloc(cfg.n_layers, batch, max_seq, cfg.n_kv_heads,
+                             cfg.head_dim, dtype=cache_dtype)
+
+    def step(params, tokens, cache):
+        logits, cache = llama.forward_with_cache(params, tokens, cfg, cache)
+        return logits, cache
+
+    return Generator(params, step, step, alloc, eos_token_id=eos_token_id)

@@ -1,0 +1,147 @@
+"""Kernel/module injection (ref: deepspeed/module_inject/*).
+
+The reference walks a torch module tree and swaps HF layers for fused
+CUDA "DeepSpeedTransformer" blocks, guided by per-architecture policies
+(ref: module_inject/replace_policy.py, containers/llama.py, bert.py …).
+
+TPU design: our models are pure functions, so "injection" is (a) a policy
+registry mapping architecture names → our model family + weight-layout
+converter + TP spec tree, and (b) kernel selection flags (attn_impl →
+pallas flash / ring / ulysses) applied to the model config.  The public
+``inject`` entrypoint takes an HF-style config dict + state dict and
+returns (apply_fn, params, specs) ready for the InferenceEngine — the
+functional equivalent of ``replace_transformer_layer``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class InjectionPolicy:
+    """Per-architecture policy (ref: module_inject/replace_policy.py
+    DSPolicy subclasses)."""
+
+    arch: str
+    build_config: Callable      # hf_config dict -> model config
+    convert_weights: Callable   # (state_dict, cfg) -> params pytree
+    apply_fn: Callable          # (params, tokens, cfg) -> logits
+    param_specs: Callable       # cfg -> TP spec tree
+
+
+_REGISTRY: Dict[str, InjectionPolicy] = {}
+
+
+def register_policy(policy: InjectionPolicy) -> None:
+    _REGISTRY[policy.arch.lower()] = policy
+
+
+def get_policy(arch: str) -> InjectionPolicy:
+    try:
+        return _REGISTRY[arch.lower()]
+    except KeyError:
+        raise ValueError(
+            f"no injection policy for architecture {arch!r}; "
+            f"registered: {sorted(_REGISTRY)}") from None
+
+
+def inject(arch: str, hf_config: Dict[str, Any], state_dict=None,
+           attn_impl: str = "auto", dtype=jnp.bfloat16):
+    """ref: module_inject.replace_module — returns (apply_fn, params, cfg,
+    specs); ``state_dict`` maps HF tensor names → numpy arrays (pass the
+    result of integrations/hf.py load_safetensors)."""
+    pol = get_policy(arch)
+    cfg = pol.build_config(hf_config)
+    if hasattr(cfg, "attn_impl"):
+        cfg.attn_impl = attn_impl
+    params = None
+    if state_dict is not None:
+        params = pol.convert_weights(state_dict, cfg)
+        params = _cast_floating(params, dtype)
+    fn = lambda p, tokens: pol.apply_fn(p, tokens, cfg)
+    return fn, params, cfg, pol.param_specs(cfg)
+
+
+def _cast_floating(tree, dtype):
+    import jax
+
+    return jax.tree.map(
+        lambda x: jnp.asarray(x, dtype)
+        if np.issubdtype(np.asarray(x).dtype, np.floating) else jnp.asarray(x),
+        tree)
+
+
+# ----------------------------------------------------------- llama policy
+def _llama_config(hf: Dict[str, Any]):
+    from deepspeed_tpu.models.llama import LlamaConfig
+
+    return LlamaConfig(
+        vocab_size=hf.get("vocab_size", 32000),
+        dim=hf.get("hidden_size", 4096),
+        n_layers=hf.get("num_hidden_layers", 32),
+        n_heads=hf.get("num_attention_heads", 32),
+        n_kv_heads=hf.get("num_key_value_heads",
+                          hf.get("num_attention_heads", 32)),
+        ffn_dim=hf.get("intermediate_size"),
+        max_seq_len=hf.get("max_position_embeddings", 2048),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        norm_eps=hf.get("rms_norm_eps", 1e-5),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+    )
+
+
+def _llama_weights(sd: Dict[str, np.ndarray], cfg):
+    """HF Llama layout → our stacked pytree (torch Linear stores W^T:
+    HF [out, in] → ours [in, out])."""
+    L = cfg.n_layers
+    t = lambda name: np.asarray(sd[name]).T
+    stack = lambda fmt: np.stack(
+        [t(fmt.format(i)) for i in range(L)])
+    stack_raw = lambda fmt: np.stack(
+        [np.asarray(sd[fmt.format(i)]) for i in range(L)])
+    params = {
+        "embed": np.asarray(sd["model.embed_tokens.weight"]),
+        "blocks": {
+            "attn_norm": stack_raw("model.layers.{}.input_layernorm.weight"),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "mlp_norm": stack_raw(
+                "model.layers.{}.post_attention_layernorm.weight"),
+            "w1": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "w3": stack("model.layers.{}.mlp.up_proj.weight"),
+            "w2": stack("model.layers.{}.mlp.down_proj.weight"),
+        },
+        "final_norm": np.asarray(sd["model.norm.weight"]),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = np.asarray(sd["lm_head.weight"]).T
+    return params
+
+
+def _register_builtin():
+    from deepspeed_tpu.models import llama
+
+    register_policy(InjectionPolicy(
+        arch="llama",
+        build_config=_llama_config,
+        convert_weights=_llama_weights,
+        apply_fn=lambda p, tokens, cfg: llama.forward(p, tokens, cfg),
+        param_specs=lambda cfg: llama.param_specs(cfg),
+    ))
+    register_policy(InjectionPolicy(
+        arch="llamaforcausallm",
+        build_config=_llama_config,
+        convert_weights=_llama_weights,
+        apply_fn=lambda p, tokens, cfg: llama.forward(p, tokens, cfg),
+        param_specs=lambda cfg: llama.param_specs(cfg),
+    ))
+
+
+_register_builtin()

@@ -1,0 +1,1 @@
+"""Framework integrations (ref: DeepSpeed's HF Trainer / accelerate glue)."""

@@ -1,0 +1,123 @@
+"""HuggingFace interop (SURVEY.md §2 #38; ref: the reference's HF Trainer
+integration + module_inject checkpoint loading,
+deepspeed/module_inject/load_checkpoint.py).
+
+Loads HF checkpoints (safetensors or torch .bin shards) into plain numpy
+state dicts, then converts to our pytrees via inference/injection.py
+policies.  Tokenizers pass through untouched (they are host-side).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def load_state_dict(model_dir: str) -> Dict[str, np.ndarray]:
+    """Load all weight shards under ``model_dir`` → {name: np.ndarray}."""
+    sd: Dict[str, np.ndarray] = {}
+    entries = sorted(os.listdir(model_dir))
+    safes = [e for e in entries if e.endswith(".safetensors")]
+    bins = [e for e in entries if e.endswith(".bin") and "pytorch_model" in e]
+    if safes:
+        from safetensors import safe_open
+
+        for fname in safes:
+            with safe_open(os.path.join(model_dir, fname), framework="np") as f:
+                for key in f.keys():
+                    sd[key] = f.get_tensor(key)
+    elif bins:
+        import torch
+
+        for fname in bins:
+            shard = torch.load(os.path.join(model_dir, fname),
+                               map_location="cpu", weights_only=True)
+            for key, val in shard.items():
+                sd[key] = val.float().numpy()
+    else:
+        raise FileNotFoundError(
+            f"no .safetensors or pytorch_model*.bin under {model_dir}")
+    return sd
+
+
+def load_config(model_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(model_dir, "config.json")) as f:
+        return json.load(f)
+
+
+def from_pretrained(model_dir: str, attn_impl: str = "auto",
+                    dtype=None, arch: Optional[str] = None):
+    """Load an HF checkpoint directory into (apply_fn, params, cfg, specs).
+
+    The architecture is taken from config.json ``architectures[0]`` unless
+    overridden.  Equivalent of the reference's
+    ``deepspeed.init_inference(AutoModel.from_pretrained(...))`` flow
+    without materializing a torch module.
+    """
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.injection import inject
+
+    hf_cfg = load_config(model_dir)
+    arch = arch or (hf_cfg.get("architectures") or ["llama"])[0]
+    sd = load_state_dict(model_dir)
+    return inject(arch, hf_cfg, sd, attn_impl=attn_impl,
+                  dtype=dtype or jnp.bfloat16)
+
+
+def save_pretrained(params, cfg, save_dir: str) -> None:
+    """Export our llama pytree back to an HF-layout safetensors checkpoint
+    (inverse of injection's weight converter) so trained weights flow back
+    into the HF ecosystem."""
+    import jax
+
+    os.makedirs(save_dir, exist_ok=True)
+    params = jax.tree.map(lambda x: np.asarray(x, np.float32), params)
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": params["embed"],
+        "model.norm.weight": params["final_norm"],
+    }
+    blocks = params["blocks"]
+    L = blocks["wq"].shape[0]
+    names = {
+        "attn_norm": ("model.layers.{}.input_layernorm.weight", False),
+        "wq": ("model.layers.{}.self_attn.q_proj.weight", True),
+        "wk": ("model.layers.{}.self_attn.k_proj.weight", True),
+        "wv": ("model.layers.{}.self_attn.v_proj.weight", True),
+        "wo": ("model.layers.{}.self_attn.o_proj.weight", True),
+        "mlp_norm": ("model.layers.{}.post_attention_layernorm.weight", False),
+        "w1": ("model.layers.{}.mlp.gate_proj.weight", True),
+        "w3": ("model.layers.{}.mlp.up_proj.weight", True),
+        "w2": ("model.layers.{}.mlp.down_proj.weight", True),
+    }
+    for i in range(L):
+        for ours, (fmt, transpose) in names.items():
+            w = blocks[ours][i]
+            sd[fmt.format(i)] = w.T if transpose else w
+    if "lm_head" in params:
+        sd["lm_head.weight"] = params["lm_head"].T
+    from safetensors.numpy import save_file
+
+    # safetensors serializes the raw buffer — transposed views must be
+    # materialized or the strides are silently dropped
+    sd = {k: np.ascontiguousarray(v) for k, v in sd.items()}
+    save_file(sd, os.path.join(save_dir, "model.safetensors"))
+    hf_cfg = {
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": int(cfg.vocab_size),
+        "hidden_size": int(cfg.dim),
+        "num_hidden_layers": int(cfg.n_layers),
+        "num_attention_heads": int(cfg.n_heads),
+        "num_key_value_heads": int(cfg.n_kv_heads),
+        "intermediate_size": int(cfg.ffn_dim),
+        "max_position_embeddings": int(cfg.max_seq_len),
+        "rope_theta": float(cfg.rope_theta),
+        "rms_norm_eps": float(cfg.norm_eps),
+        "tie_word_embeddings": bool(cfg.tie_embeddings),
+        "model_type": "llama",
+    }
+    with open(os.path.join(save_dir, "config.json"), "w") as f:
+        json.dump(hf_cfg, f, indent=2)

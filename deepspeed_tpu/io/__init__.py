@@ -1,0 +1,1 @@
+"""Native IO runtime (ref: deepspeed/ops/aio)."""

@@ -1,0 +1,154 @@
+"""ctypes bindings for the C++ async-IO pool (csrc/aio.cpp).
+
+Reference behavior: deepspeed/ops/aio's AsyncIOBuilder — an aio_handle
+with ``async_pread``/``async_pwrite``/``wait`` used by ZeRO-Infinity's
+NVMe swapper (deepspeed/runtime/swap_tensor/).  Same contract here:
+submit → overlap with compute → wait; numpy arrays are the host buffers.
+
+The shared library builds lazily on first use (g++ is in the image); if
+compilation fails (no toolchain), a pure-Python thread-pool fallback keeps
+the API working.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "csrc", "aio.cpp")
+_LIB = os.path.join(_REPO, "csrc", "libdstpu_aio.so")
+_build_lock = threading.Lock()
+
+
+def _ensure_lib() -> Optional[ctypes.CDLL]:
+    with _build_lock:
+        if not os.path.exists(_LIB) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC,
+                     "-lpthread"],
+                    check=True, capture_output=True)
+            except (subprocess.CalledProcessError, FileNotFoundError):
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+    lib.dstpu_aio_create.restype = ctypes.c_void_p
+    lib.dstpu_aio_create.argtypes = [ctypes.c_int]
+    lib.dstpu_aio_destroy.argtypes = [ctypes.c_void_p]
+    lib.dstpu_aio_open.restype = ctypes.c_int
+    lib.dstpu_aio_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.dstpu_aio_close.argtypes = [ctypes.c_int]
+    for fn in (lib.dstpu_aio_pread, lib.dstpu_aio_pwrite):
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+                       ctypes.c_int64, ctypes.c_int64]
+    lib.dstpu_aio_wait.restype = ctypes.c_int64
+    lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p]
+    lib.dstpu_aio_pending.restype = ctypes.c_int64
+    lib.dstpu_aio_pending.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class AioHandle:
+    """ref: deepspeed.ops.aio aio_handle(block_size, queue_depth, ...)."""
+
+    def __init__(self, n_threads: int = 8):
+        self._lib = _ensure_lib()
+        self._fds: List[int] = []
+        if self._lib is not None:
+            self._pool = self._lib.dstpu_aio_create(n_threads)
+            self._exec = None
+        else:  # pure-python fallback
+            self._pool = None
+            self._exec = ThreadPoolExecutor(max_workers=n_threads)
+            self._futures = []
+
+    @property
+    def native(self) -> bool:
+        return self._pool is not None
+
+    # ------------------------------------------------------------- file ops
+    def open(self, path: str, write: bool = False) -> int:
+        if self.native:
+            fd = self._lib.dstpu_aio_open(path.encode(), int(write), 0)
+        else:
+            fd = os.open(path, (os.O_WRONLY | os.O_CREAT) if write
+                         else os.O_RDONLY, 0o644)
+        if fd < 0:
+            raise OSError(f"cannot open {path}")
+        self._fds.append(fd)
+        return fd
+
+    def close(self, fd: int) -> None:
+        if self.native:
+            self._lib.dstpu_aio_close(fd)
+        else:
+            os.close(fd)
+        if fd in self._fds:
+            self._fds.remove(fd)
+
+    # ------------------------------------------------------------ async ops
+    def pread(self, fd: int, buf: np.ndarray, offset: int = 0) -> None:
+        """Submit an async read of buf.nbytes at ``offset`` into ``buf``."""
+        assert buf.flags["C_CONTIGUOUS"]
+        if self.native:
+            self._lib.dstpu_aio_pread(
+                self._pool, fd, buf.ctypes.data_as(ctypes.c_void_p),
+                buf.nbytes, offset)
+        else:
+            self._futures.append(self._exec.submit(
+                self._py_rw, fd, buf, offset, False))
+
+    def pwrite(self, fd: int, buf: np.ndarray, offset: int = 0) -> None:
+        assert buf.flags["C_CONTIGUOUS"]
+        if self.native:
+            self._lib.dstpu_aio_pwrite(
+                self._pool, fd, buf.ctypes.data_as(ctypes.c_void_p),
+                buf.nbytes, offset)
+        else:
+            self._futures.append(self._exec.submit(
+                self._py_rw, fd, buf, offset, True))
+
+    @staticmethod
+    def _py_rw(fd: int, buf: np.ndarray, offset: int, write: bool):
+        view = memoryview(buf).cast("B")
+        if write:
+            os.pwrite(fd, view, offset)
+        else:
+            data = os.pread(fd, buf.nbytes, offset)
+            view[:len(data)] = data
+
+    def wait(self) -> int:
+        """Block until all submitted ops complete; returns #errors."""
+        if self.native:
+            return int(self._lib.dstpu_aio_wait(self._pool))
+        errs = 0
+        for f in self._futures:
+            try:
+                f.result()
+            except Exception:
+                errs += 1
+        self._futures = []
+        return errs
+
+    def __del__(self):
+        try:
+            for fd in list(self._fds):
+                self.close(fd)
+            if self.native and self._pool is not None:
+                self._lib.dstpu_aio_destroy(self._pool)
+                self._pool = None
+        except Exception:
+            pass

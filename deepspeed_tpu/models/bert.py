@@ -1,0 +1,189 @@
+"""BERT encoder family (SURVEY.md §2 #37; ref: DeepSpeed's BingBertSquad /
+bert_pretrain examples and deepspeed/ops/transformer's encoder kernels).
+
+TPU design: same stacked-layers + ``lax.scan`` layout as models/llama.py —
+bidirectional attention (no causal mask), learned positional embeddings,
+post-LN blocks with GELU MLP (the classic BERT recipe the reference's
+fused transformer kernel implements), MLM loss with 15% masking handled by
+the caller supplying ``mlm_positions``/``mlm_labels``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    remat: str = "none"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def large(cls, **kw):
+        return cls(dim=1024, n_layers=24, n_heads=16, ffn_dim=4096, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("dim", 64)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 4)
+        kw.setdefault("ffn_dim", 128)
+        kw.setdefault("max_seq_len", 64)
+        return cls(**kw)
+
+
+def init_params(rng: jax.Array, cfg: BertConfig,
+                dtype=jnp.float32) -> Dict[str, Any]:
+    k = jax.random.split(rng, 12)
+    d, f, L = cfg.dim, cfg.ffn_dim, cfg.n_layers
+    w = lambda key, *sh: (jax.random.normal(key, sh) * 0.02).astype(dtype)
+    return {
+        "embed": w(k[0], cfg.vocab_size, d),
+        "pos_embed": w(k[1], cfg.max_seq_len, d),
+        "type_embed": w(k[2], cfg.type_vocab_size, d),
+        "embed_norm": {"scale": jnp.ones((d,), dtype),
+                       "bias": jnp.zeros((d,), dtype)},
+        "blocks": {
+            "wqkv": w(k[3], L, d, 3 * d),
+            "bqkv": jnp.zeros((L, 3 * d), dtype),
+            "wo": w(k[4], L, d, d),
+            "bo": jnp.zeros((L, d), dtype),
+            "attn_norm_scale": jnp.ones((L, d), dtype),
+            "attn_norm_bias": jnp.zeros((L, d), dtype),
+            "w_in": w(k[5], L, d, f),
+            "b_in": jnp.zeros((L, f), dtype),
+            "w_out": w(k[6], L, f, d),
+            "b_out": jnp.zeros((L, d), dtype),
+            "mlp_norm_scale": jnp.ones((L, d), dtype),
+            "mlp_norm_bias": jnp.zeros((L, d), dtype),
+        },
+        "pooler": {"w": w(k[7], d, d), "b": jnp.zeros((d,), dtype)},
+        "mlm_dense": {"w": w(k[8], d, d), "b": jnp.zeros((d,), dtype)},
+        "mlm_norm": {"scale": jnp.ones((d,), dtype),
+                     "bias": jnp.zeros((d,), dtype)},
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), dtype),
+    }
+
+
+def param_specs(cfg: BertConfig) -> Dict[str, Any]:
+    col, row = P(None, None, "model"), P(None, "model", None)
+    rep1, rep2 = P(None), P(None, None)
+    return {
+        "embed": P(None, "model"),
+        "pos_embed": P(None, "model"),
+        "type_embed": P(None, "model"),
+        "embed_norm": {"scale": rep1, "bias": rep1},
+        "blocks": {
+            "wqkv": col, "bqkv": P(None, "model"),
+            "wo": row, "bo": rep2,
+            "attn_norm_scale": rep2, "attn_norm_bias": rep2,
+            "w_in": col, "b_in": P(None, "model"),
+            "w_out": row, "b_out": rep2,
+            "mlp_norm_scale": rep2, "mlp_norm_bias": rep2,
+        },
+        "pooler": {"w": rep2, "b": rep1},
+        "mlm_dense": {"w": rep2, "b": rep1},
+        "mlm_norm": {"scale": rep1, "bias": rep1},
+        "mlm_bias": rep1,
+    }
+
+
+def _layer_norm(x, scale, bias, eps):
+    from deepspeed_tpu.ops.fused_ops import layer_norm
+
+    return layer_norm(x, scale, bias, eps)
+
+
+def _block(cfg: BertConfig, x, lp, attention_mask):
+    from deepspeed_tpu.models.llama import reference_attention
+
+    B, T, d = x.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+    qkv = x @ lp["wqkv"] + lp["bqkv"]
+    q, k, v = jnp.split(qkv.reshape(B, T, 3, nh, hd), 3, axis=2)
+    q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
+    attn = reference_attention(q, k, v, causal=False,
+                               segment_ids=attention_mask)
+    x = _layer_norm(x + attn.reshape(B, T, d) @ lp["wo"] + lp["bo"],
+                    lp["attn_norm_scale"], lp["attn_norm_bias"], cfg.norm_eps)
+    from deepspeed_tpu.ops.fused_ops import gelu_mlp
+
+    h = gelu_mlp(x, lp["w_in"], lp["b_in"], lp["w_out"], lp["b_out"])
+    return _layer_norm(x + h, lp["mlp_norm_scale"], lp["mlp_norm_bias"],
+                       cfg.norm_eps)
+
+
+def forward(params, tokens, cfg: BertConfig, token_type_ids=None,
+            attention_mask=None):
+    """tokens: [B, T] → hidden states [B, T, d]."""
+    B, T = tokens.shape
+    x = params["embed"][tokens] + params["pos_embed"][None, :T]
+    if token_type_ids is not None:
+        x = x + params["type_embed"][token_type_ids]
+    x = _layer_norm(x, params["embed_norm"]["scale"],
+                    params["embed_norm"]["bias"], cfg.norm_eps)
+
+    block = lambda x, lp: (_block(cfg, x, lp, attention_mask), None)
+    if cfg.remat != "none":
+        from deepspeed_tpu.remat import policy as remat_policy
+
+        block = jax.checkpoint(block, policy=remat_policy(cfg.remat))
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+    return x
+
+
+def pooled_output(params, hidden):
+    """[CLS] pooler (ref: BertPooler): tanh(dense(h[:, 0]))."""
+    return jnp.tanh(hidden[:, 0] @ params["pooler"]["w"]
+                    + params["pooler"]["b"])
+
+
+def mlm_logits(params, hidden, cfg: BertConfig):
+    """MLM head: dense+gelu+LN, tied decoder to the embedding matrix."""
+    h = jax.nn.gelu(hidden @ params["mlm_dense"]["w"]
+                    + params["mlm_dense"]["b"])
+    h = _layer_norm(h, params["mlm_norm"]["scale"], params["mlm_norm"]["bias"],
+                    cfg.norm_eps)
+    return jnp.einsum("btd,vd->btv", h, params["embed"],
+                      preferred_element_type=jnp.float32) + params["mlm_bias"]
+
+
+def loss_fn(cfg: BertConfig):
+    """MLM cross-entropy; batch = {tokens, mlm_labels (-100 = unmasked),
+    (token_type_ids, attention_mask)}."""
+
+    def f(params, batch):
+        hidden = forward(params, batch["tokens"], cfg,
+                         token_type_ids=batch.get("token_type_ids"),
+                         attention_mask=batch.get("attention_mask"))
+        logits = mlm_logits(params, hidden, cfg)
+        labels = batch["mlm_labels"]
+        mask = (labels >= 0).astype(jnp.float32)
+        safe = jnp.maximum(labels, 0)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    return f

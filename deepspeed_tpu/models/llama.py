@@ -125,11 +125,11 @@ def init_params(rng: jax.Array, cfg: LlamaConfig,
     return params
 
 
-def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+def param_specs(cfg: LlamaConfig, pipeline: bool = False) -> Dict[str, Any]:
     """Tensor-parallel shardings over the ``model`` axis (Megatron layout:
     column-parallel into the block, row-parallel out, psum inserted by XLA).
-    Dim 0 of block leaves is the stacked layer axis → the ``pipe`` axis
-    shards it when pipeline parallelism is on."""
+    Dim 0 of block leaves is the stacked layer axis → ``pipeline=True``
+    shards it over the ``pipe`` axis (stage partitioning)."""
     col, row = P(None, None, "model"), P(None, "model", None)
     specs = {
         # feature-dim sharding: token gather stays local (vocab-dim sharding
@@ -145,6 +145,12 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
     }
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(None, "model")
+    if pipeline:
+        from deepspeed_tpu.parallel.pipeline import stage_spec
+
+        specs["blocks"] = jax.tree.map(
+            stage_spec, specs["blocks"],
+            is_leaf=lambda x: x is None or isinstance(x, P))
     return specs
 
 
@@ -175,6 +181,25 @@ def apply_rope(x, cos, sin):
 def _attention(q, k, v, cfg: LlamaConfig, segment_ids=None):
     """q: [B,T,H,Dh], k/v: [B,T,KV,Dh] → [B,T,H,Dh]."""
     impl = cfg.attn_impl
+    if impl in ("ring", "ulysses"):
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "packed-sequence segment_ids are not supported on the "
+                "ring/ulysses sequence-parallel attention paths yet")
+        from deepspeed_tpu.topology import current_mesh
+
+        ms = current_mesh()
+        if ms is not None and ms.size("seq") > 1:
+            if impl == "ring":
+                from deepspeed_tpu.parallel.ring_attention import (
+                    ring_attention_sharded)
+
+                return ring_attention_sharded(q, k, v, ms, causal=True)
+            from deepspeed_tpu.parallel.sequence_parallel import (
+                ulysses_attention_sharded)
+
+            return ulysses_attention_sharded(q, k, v, ms, causal=True)
+        impl = "auto"  # no seq axis in scope: plain attention
     if impl in ("auto", "flash"):
         try:
             from deepspeed_tpu.ops.attention import flash_attention
@@ -184,32 +209,15 @@ def _attention(q, k, v, cfg: LlamaConfig, segment_ids=None):
         except Exception:
             if impl == "flash":
                 raise
-    if impl == "ring":
-        from deepspeed_tpu.parallel.ring_attention import ring_attention
-
-        return ring_attention(q, k, v, axis_name="seq", causal=True)
     return reference_attention(q, k, v, causal=True, segment_ids=segment_ids)
 
 
 def reference_attention(q, k, v, causal=True, segment_ids=None):
-    """Plain jnp attention (numeric ground truth for the pallas kernels)."""
-    B, T, H, Dh = q.shape
-    KV = k.shape[2]
-    if KV != H:  # GQA: broadcast kv heads over query groups
-        rep = H // KV
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    scores = jnp.einsum("bthd,bshd->bhts", q, k,
-                        preferred_element_type=jnp.float32)
-    scores = scores / np.sqrt(Dh)
-    if causal:
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-    if segment_ids is not None:
-        same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
-        scores = jnp.where(same, scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhts,bshd->bthd", probs, v)
+    """Plain jnp attention — the single numeric ground truth lives in
+    ops/attention.py; re-exported here for model/test convenience."""
+    from deepspeed_tpu.ops.attention import _reference
+
+    return _reference(q, k, v, causal=causal, segment_ids=segment_ids)
 
 
 def _block(cfg: LlamaConfig, x, layer_params, cos, sin, segment_ids):
@@ -232,8 +240,13 @@ def _block(cfg: LlamaConfig, x, layer_params, cos, sin, segment_ids):
 
 
 def forward(params, tokens, cfg: LlamaConfig, positions=None,
-            segment_ids=None):
-    """tokens: [B, T] int32 → logits [B, T, V] (f32)."""
+            segment_ids=None, n_micro: Optional[int] = None):
+    """tokens: [B, T] int32 → logits [B, T, V] (f32).
+
+    ``n_micro``: with a ``pipe`` axis in the ambient mesh, the block stack
+    runs as a pipeline of n_micro microbatches (parallel/pipeline.py);
+    embed/head stay under plain GSPMD on either side.
+    """
     B, T = tokens.shape
     x = params["embed"][tokens]  # [B, T, d]
     if positions is None:
@@ -241,11 +254,20 @@ def forward(params, tokens, cfg: LlamaConfig, positions=None,
     cos, sin = rope_tables(cfg, positions)
 
     block = lambda x, lp: (_block(cfg, x, lp, cos, sin, segment_ids), None)
-    if cfg.remat != "none":
-        from deepspeed_tpu.remat import policy as remat_policy
+    from deepspeed_tpu.topology import current_mesh
 
-        block = jax.checkpoint(block, policy=remat_policy(cfg.remat))
-    x, _ = jax.lax.scan(block, x, params["blocks"])
+    ms = current_mesh()
+    if n_micro and ms is not None and ms.size("pipe") > 1:
+        from deepspeed_tpu.parallel.pipeline import pipelined_scan
+
+        x = pipelined_scan(block, params["blocks"], x, n_micro, ms,
+                           remat=cfg.remat != "none")
+    else:
+        if cfg.remat != "none":
+            from deepspeed_tpu.remat import policy as remat_policy
+
+            block = jax.checkpoint(block, policy=remat_policy(cfg.remat))
+        x, _ = jax.lax.scan(block, x, params["blocks"])
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
@@ -253,13 +275,62 @@ def forward(params, tokens, cfg: LlamaConfig, positions=None,
                       preferred_element_type=jnp.float32)
 
 
-def loss_fn(cfg: LlamaConfig):
-    """Causal-LM next-token cross entropy; batch = {tokens, (loss_mask)}."""
+def forward_with_cache(params, tokens, cfg: LlamaConfig, cache):
+    """Incremental forward for generation: attends to cache[:len]+tokens,
+    writes new K/V at position ``cache.length`` (ref: the reference's
+    inference transformer kernels' KV-cache contract).
+
+    tokens: [B, T] → (logits [B, T, V] f32, updated cache).
+    """
+    from deepspeed_tpu.inference.generation import cached_attention
+
+    B, T = tokens.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    start = cache.length
+    x = params["embed"][tokens]
+    positions = start + jnp.arange(T, dtype=jnp.int32)
+    cos, sin = rope_tables(cfg, positions)
+
+    def block(x, layer):
+        lp, kc, vc = layer
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, T, nh, hd)
+        k = (h @ lp["wk"]).reshape(B, T, nkv, hd)
+        v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn, kc, vc = cached_attention(q, kc, vc, k, v, start)
+        x = x + attn.reshape(B, T, nh * hd) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        from deepspeed_tpu.ops.fused_ops import swiglu
+
+        x = x + swiglu(h, lp["w1"], lp["w3"]) @ lp["w2"]
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(block, x,
+                                     (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x, head,
+                        preferred_element_type=jnp.float32)
+    cache = cache._replace(k=new_k, v=new_v, length=start + T)
+    return logits, cache
+
+
+def loss_fn(cfg: LlamaConfig, n_micro: Optional[int] = None):
+    """Causal-LM next-token cross entropy; batch = {tokens, (loss_mask)}.
+
+    ``n_micro``: pipeline-parallel microbatch count (see :func:`forward`);
+    set it to ``gradient_accumulation_steps`` when ``pipe > 1`` — the
+    engine then feeds the full batch in one call (DeepSpeed's
+    PipelineEngine.train_batch contract, ref: runtime/pipe/engine.py).
+    """
 
     def f(params, batch):
         tokens = batch["tokens"]
         logits = forward(params, tokens[:, :-1], cfg,
-                         segment_ids=batch.get("segment_ids"))
+                         segment_ids=batch.get("segment_ids"),
+                         n_micro=n_micro)
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

@@ -1,0 +1,223 @@
+"""Mixtral-style MoE transformer (SURVEY.md §2 #37, MoE family).
+
+Reference behavior: DeepSpeed's MoE training path — a GPT/Llama block whose
+FFN is replaced by deepspeed.moe.layer.MoE (top-2 of N experts, capacity
+factor, load-balance + z losses; ref: deepspeed/moe/layer.py,
+sharded_moe.py) — as instantiated by Mixtral-8x7B-class configs.
+
+TPU design mirrors models/llama.py: stacked layers + lax.scan, bf16-ready
+matmuls, TP spec tree; the MoE FFN uses parallel/moe.py's einsum
+dispatch/combine with the expert stack sharded over the ``expert`` axis.
+Aux losses are carried out of the scan and added to the LM loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.config import MoEConfig
+from deepspeed_tpu.models import llama as _llama
+from deepspeed_tpu.parallel.moe import MoELayer
+
+
+@dataclasses.dataclass
+class MixtralConfig:
+    vocab_size: int = 32000
+    dim: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    ffn_dim: Optional[int] = None
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    z_loss_weight: float = 0.001
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    remat: str = "none"
+    attn_impl: str = "auto"
+
+    def __post_init__(self):
+        if self.ffn_dim is None:
+            self.ffn_dim = int(np.ceil(self.dim * 8 / 3 / 128) * 128)
+        assert self.n_heads % self.n_kv_heads == 0
+        assert self.dim % self.n_heads == 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def moe_config(self) -> MoEConfig:
+        return MoEConfig(enabled=True, num_experts=self.num_experts,
+                         top_k=self.top_k,
+                         capacity_factor=self.capacity_factor,
+                         aux_loss_weight=self.aux_loss_weight,
+                         z_loss_weight=self.z_loss_weight)
+
+    def llama_view(self) -> _llama.LlamaConfig:
+        """Attention/embedding hyperparams in LlamaConfig form (the
+        attention path is shared with models/llama.py)."""
+        return _llama.LlamaConfig(
+            vocab_size=self.vocab_size, dim=self.dim, n_layers=self.n_layers,
+            n_heads=self.n_heads, n_kv_heads=self.n_kv_heads,
+            ffn_dim=self.ffn_dim, max_seq_len=self.max_seq_len,
+            rope_theta=self.rope_theta, norm_eps=self.norm_eps,
+            attn_impl=self.attn_impl)
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw):
+        return cls(vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=8, ffn_dim=14336, num_experts=8, top_k=2,
+                   rope_theta=1e6, **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("dim", 32)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 4)
+        kw.setdefault("n_kv_heads", 2)
+        kw.setdefault("num_experts", 4)
+        kw.setdefault("max_seq_len", 64)
+        return cls(**kw)
+
+
+def param_count(cfg: MixtralConfig) -> int:
+    d, f, L, E = cfg.dim, cfg.ffn_dim, cfg.n_layers, cfg.num_experts
+    kvd = cfg.n_kv_heads * cfg.head_dim
+    attn = (d * d) + (d * kvd) * 2 + (d * d)
+    moe = E * (d * f) * 3 + d * E          # experts + gate
+    per_layer = attn + moe + 2 * d
+    return int(L * per_layer + 2 * cfg.vocab_size * d + d)
+
+
+def init_params(rng: jax.Array, cfg: MixtralConfig,
+                dtype=jnp.float32) -> Dict[str, Any]:
+    k = jax.random.split(rng, 10)
+    d, f, L, E = cfg.dim, cfg.ffn_dim, cfg.n_layers, cfg.num_experts
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    s = lambda *sh: 1.0 / np.sqrt(sh[-2] if len(sh) > 1 else sh[-1])
+
+    def w(key, *sh):
+        return (jax.random.normal(key, sh) * s(*sh)).astype(dtype)
+
+    return {
+        "embed": w(k[0], cfg.vocab_size, d),
+        "blocks": {
+            "attn_norm": jnp.ones((L, d), dtype),
+            "wq": w(k[1], L, d, nh * hd),
+            "wk": w(k[2], L, d, nkv * hd),
+            "wv": w(k[3], L, d, nkv * hd),
+            "wo": w(k[4], L, nh * hd, d),
+            "mlp_norm": jnp.ones((L, d), dtype),
+            "gate": (jax.random.normal(k[5], (L, d, E)) * 0.02).astype(dtype),
+            # expert FFNs stacked [L, E, ...]
+            "w1": w(k[6], L, E, d, f),
+            "w3": w(k[7], L, E, d, f),
+            "w2": w(k[8], L, E, f, d),
+        },
+        "final_norm": jnp.ones((d,), dtype),
+        "lm_head": w(k[9], d, cfg.vocab_size),
+    }
+
+
+def param_specs(cfg: MixtralConfig) -> Dict[str, Any]:
+    """TP over ``model`` for attention; experts sharded over ``expert``
+    (dims: [L, E, in, out] → P(None, "expert", ...))."""
+    col, row = P(None, None, "model"), P(None, "model", None)
+    return {
+        "embed": P(None, "model"),
+        "blocks": {
+            "attn_norm": P(None, None),
+            "wq": col, "wk": col, "wv": col, "wo": row,
+            "mlp_norm": P(None, None),
+            "gate": P(None, None, None),
+            "w1": P(None, "expert", None, "model"),
+            "w3": P(None, "expert", None, "model"),
+            "w2": P(None, "expert", "model", None),
+        },
+        "final_norm": P(None),
+        "lm_head": P(None, "model"),
+    }
+
+
+def _moe_ffn(cfg: MixtralConfig, x, lp, mesh):
+    """x: [B, T, d] → (y, aux) via top-k expert dispatch."""
+    def expert_fn(p, h):
+        from deepspeed_tpu.ops.fused_ops import swiglu
+
+        return swiglu(h, p["w1"], p["w3"]) @ p["w2"]
+
+    layer = MoELayer(cfg=cfg.moe_config(), expert_fn=expert_fn, mesh=mesh)
+    eparams = {"w1": lp["w1"], "w3": lp["w3"], "w2": lp["w2"]}
+    return layer(lp["gate"], eparams, x)
+
+
+def forward(params, tokens, cfg: MixtralConfig, positions=None):
+    """tokens: [B, T] → (logits [B, T, V] f32, aux_losses dict)."""
+    from deepspeed_tpu.topology import current_mesh
+
+    lcfg = cfg.llama_view()
+    mesh = current_mesh()
+    B, T = tokens.shape
+    x = params["embed"][tokens]
+    if positions is None:
+        positions = jnp.arange(T, dtype=jnp.int32)
+    cos, sin = _llama.rope_tables(lcfg, positions)
+
+    def block(carry, lp):
+        x, aux_acc = carry
+        h = _llama.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+        q = (h @ lp["wq"]).reshape(B, T, nh, hd)
+        k = (h @ lp["wk"]).reshape(B, T, nkv, hd)
+        v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
+        q = _llama.apply_rope(q, cos, sin)
+        k = _llama.apply_rope(k, cos, sin)
+        attn = _llama._attention(q, k, v, lcfg).reshape(B, T, nh * hd)
+        x = x + attn @ lp["wo"]
+        h = _llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        y, aux = _moe_ffn(cfg, h, lp, mesh)
+        x = x + y
+        aux_acc = {
+            "moe_aux_loss": aux_acc["moe_aux_loss"] + aux["moe_aux_loss"],
+            "moe_z_loss": aux_acc["moe_z_loss"] + aux["moe_z_loss"],
+        }
+        return (x, aux_acc), None
+
+    blk = block
+    if cfg.remat != "none":
+        from deepspeed_tpu.remat import policy as remat_policy
+
+        blk = jax.checkpoint(block, policy=remat_policy(cfg.remat))
+    zero_aux = {"moe_aux_loss": jnp.float32(0.0),
+                "moe_z_loss": jnp.float32(0.0)}
+    (x, aux), _ = jax.lax.scan(blk, (x, zero_aux), params["blocks"])
+    x = _llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return logits, aux
+
+
+def loss_fn(cfg: MixtralConfig):
+    """Next-token CE + MoE aux losses; returns (loss, aux)."""
+
+    def f(params, batch):
+        tokens = batch["tokens"]
+        logits, aux = forward(params, tokens[:, :-1], cfg)
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        lm = jnp.mean(nll)
+        total = lm + aux["moe_aux_loss"] + aux["moe_z_loss"]
+        return total, {"lm_loss": lm, **aux}
+
+    return f

@@ -41,9 +41,9 @@ def flash_attention(q, k, v, causal: bool = True, segment_ids=None):
     (which XLA still fuses well).
     """
     on_tpu = jax.default_backend() == "tpu"
-    T = q.shape[1]
+    T, S = q.shape[1], k.shape[1]
     if on_tpu and segment_ids is None and T >= 256 and T % 128 == 0 \
-            and q.shape[-1] in (64, 128):
+            and S >= 256 and S % 128 == 0 and q.shape[-1] in (64, 128):
         try:
             from deepspeed_tpu.ops.attention_pallas import flash_attention_tpu
 

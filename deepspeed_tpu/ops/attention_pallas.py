@@ -12,8 +12,11 @@ Backward: custom VJP — one pallas kernel computes dQ (sweep over K
 blocks), a second computes dK/dV (sweep over Q blocks), both recomputing
 p = exp(qk - lse) from the saved logsumexp, FlashAttention-2 style.
 
-GQA is handled by logical head indexing: query head h reads kv head
-h // (H // KV); no materialized repeat.
+GQA: kv heads are currently broadcast (``jnp.repeat``) to the query head
+count before the kernel — XLA usually folds the repeat into the gather
+feeding the kernel, but a true logical-head index map (query head h
+reading kv head h // (H // KV) via the BlockSpec) is the planned
+perf-round upgrade to cut K/V HBM traffic by the group factor.
 """
 
 from __future__ import annotations
@@ -295,6 +298,10 @@ def flash_attention_tpu(q, k, v, causal: bool = True,
     """[B,T,H,D] x [B,S,KV,D]^2 → [B,T,H,D]; GQA via kv-head broadcast."""
     B, T, H, D = q.shape
     S, KV = k.shape[1], k.shape[2]
+    if T % 128 or S % 128:
+        raise ValueError(
+            f"flash_attention_tpu needs T and S divisible by 128 (the block"
+            f" tiling would silently drop trailing keys), got T={T} S={S}")
     if KV != H:
         k = jnp.repeat(k, H // KV, axis=2)
         v = jnp.repeat(v, H // KV, axis=2)

@@ -1,0 +1,19 @@
+"""Parallelism strategies beyond plain data-parallel/ZeRO.
+
+- :mod:`tensor_parallel` — Megatron-style intra-layer model parallelism as
+  GSPMD shardings over the ``model`` mesh axis.
+- :mod:`sequence_parallel` — DeepSpeed-Ulysses all-to-all head/sequence
+  parallel attention over the ``seq`` axis.
+- :mod:`ring_attention` — ring attention (blockwise, online-softmax) over
+  the ``seq`` axis for long-context training.
+- :mod:`pipeline` — pipeline parallelism over the ``pipe`` axis (microbatch
+  ticks + ppermute stage handoff).
+- :mod:`moe` — mixture-of-experts with expert parallelism over the
+  ``expert`` axis.
+"""
+
+from deepspeed_tpu.parallel import moe  # noqa: F401
+from deepspeed_tpu.parallel import pipeline  # noqa: F401
+from deepspeed_tpu.parallel import ring_attention  # noqa: F401
+from deepspeed_tpu.parallel import sequence_parallel  # noqa: F401
+from deepspeed_tpu.parallel import tensor_parallel  # noqa: F401

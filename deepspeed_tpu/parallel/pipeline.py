@@ -1,0 +1,147 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis.
+
+Reference behavior: deepspeed/runtime/pipe/{module,engine,schedule}.py —
+PipelineModule partitions a layer list across stages; PipelineEngine runs a
+schedule (GPipe or 1F1B) of forward/backward micro-batch commands with
+p2p send/recv of activations between stage ranks, then reduces grads.
+
+TPU design: the layer stack is already a stacked ``[L, ...]`` pytree (the
+models scan over it), so "partitioning" is sharding the stack dim over the
+``pipe`` axis.  The schedule is a ``lax.scan`` over M + S - 1 ticks inside
+a shard_map that manualizes ONLY ``pipe``: each tick every stage receives
+its predecessor's activation via ``ppermute`` (one ICI hop), runs its local
+sub-stack, and hands off.  Stage 0 injects microbatch t; stage S-1 emits
+outputs which are psum-broadcast back (so the loss/head runs under plain
+GSPMD).  ``jax.grad`` through the tick scan yields the reverse-ppermute
+backward pipeline automatically — no hand-written backward schedule, no
+p2p bookkeeping, no grad-reduce hooks.
+
+Schedules: the compiled program is GPipe-shaped (all fwd ticks, then all
+bwd ticks under AD).  ``schedule="1f1b"`` is accepted for config parity;
+on TPU the memory advantage 1F1B buys is obtained instead with
+``remat="full"`` on the stage body (activations are recomputed in the
+backward ticks), which composes with this scan.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.topology import MeshSpec
+
+PIPE_AXIS = "pipe"
+
+
+def stage_spec(base: Optional[P]) -> P:
+    """Prepend the pipe axis to a stacked-layer leaf spec: the ``[L, ...]``
+    stack dim becomes ``[S, L/S, ...]`` conceptually — GSPMD just shards
+    dim 0 over ``pipe``."""
+    rest = tuple(base) if base is not None else ()
+    if rest and rest[0] == PIPE_AXIS:
+        return P(*rest)
+    if rest:
+        return P(PIPE_AXIS, *rest[1:])
+    return P(PIPE_AXIS)
+
+
+def pipelined_scan(block_fn: Callable, stacked_params: Any, x: jnp.ndarray,
+                   n_micro: int, mesh: MeshSpec,
+                   remat: bool = False) -> jnp.ndarray:
+    """Pipelined equivalent of ``lax.scan(block_fn, x, stacked_params)``.
+
+    block_fn: ``(act, layer_params) -> (act, None)`` (lax.scan convention).
+    stacked_params: pytree with leading layer dim L (divisible by S),
+        sharded ``P("pipe", ...)`` (see :func:`stage_spec`).
+    x: [B, ...] activations; B divisible by ``n_micro``.
+    Returns activations [B, ...] after all L layers.
+    """
+    S = mesh.size(PIPE_AXIS)
+    if S <= 1:
+        y, _ = jax.lax.scan(block_fn, x, stacked_params)
+        return y
+    B = x.shape[0]
+    if B % n_micro:
+        raise ValueError(f"batch {B} not divisible by n_micro {n_micro}")
+    mb = B // n_micro
+    in_dtype = x.dtype
+    # Boundary-cast to f32: replicated shard_map inputs get their cotangent
+    # psum'd over pipe, and a bf16 psum inside a partially-manual shard_map
+    # CHECK-fails XLA's CPU backend (bf16 all-reduce promotion vs the
+    # Sharding custom-call in the reduction region).  The converts are free
+    # on TPU (fused into the neighboring ops).
+    xs = x.astype(jnp.float32).reshape((n_micro, mb) + x.shape[1:])
+
+    def stage_body(local_params, act):
+        out, _ = jax.lax.scan(block_fn, act, local_params)
+        return out
+
+    if remat:
+        stage_body = jax.checkpoint(stage_body)
+
+    def run(local_params, xs):
+        # local view: xs [M, mb, ...] (replicated over pipe); local_params
+        # have leading dim L/S — this stage's sub-stack.
+        xs = xs.astype(in_dtype)
+        sid = jax.lax.axis_index(PIPE_AXIS)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        pad = jnp.zeros((S - 1,) + xs.shape[1:], xs.dtype)
+        ticks = jnp.concatenate([xs, pad], axis=0)
+
+        def tick(state, x_t):
+            inp = jax.lax.ppermute(state, PIPE_AXIS, perm)
+            inp = jnp.where(sid == 0, x_t, inp)
+            out = stage_body(local_params, inp)
+            y_t = jnp.where(sid == S - 1, out, jnp.zeros_like(out))
+            return out, y_t
+
+        state0 = jnp.zeros(xs.shape[1:], xs.dtype)
+        _, ys = jax.lax.scan(tick, state0, ticks)
+        # only the last stage's ticks S-1..M+S-2 are real outputs; psum
+        # broadcasts them so downstream (head/loss) runs replicated-in-pipe.
+        # psum in f32: low-precision psum inside a partially-manual
+        # shard_map CHECK-fails XLA's CPU backend (bf16 copy opcode bug).
+        out = jax.lax.psum(ys[S - 1:].astype(jnp.float32), PIPE_AXIS)
+        return out.astype(xs.dtype)
+
+    fn = jax.shard_map(
+        run, mesh=mesh.mesh,
+        in_specs=(jax.tree.map(lambda _: P(PIPE_AXIS), stacked_params), P()),
+        out_specs=P(), axis_names={PIPE_AXIS}, check_vma=False)
+    ys = fn(stacked_params, xs)
+    return ys.reshape((B,) + ys.shape[2:])
+
+
+def uniform_partition(n_layers: int, n_stages: int) -> list:
+    """Layer→stage assignment (ref: PipelineModule partition_method
+    "uniform"/"parameters"): contiguous equal slabs; with a scanned stacked
+    layout all layers cost the same, so uniform == parameters."""
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible into {n_stages} stages")
+    per = n_layers // n_stages
+    return [per] * n_stages
+
+
+class PipelineSchedule:
+    """Named schedules for config parity (ref: runtime/pipe/schedule.py).
+
+    Both compile to the same tick scan; ``n_ticks`` documents the bubble:
+    M + S - 1 ticks for M microbatches over S stages (bubble fraction
+    (S-1)/(M+S-1), identical to GPipe; 1F1B differs only in peak-memory
+    which remat covers here).
+    """
+
+    GPIPE = "gpipe"
+    ONE_F_ONE_B = "1f1b"
+
+    @staticmethod
+    def n_ticks(n_micro: int, n_stages: int) -> int:
+        return n_micro + n_stages - 1
+
+    @staticmethod
+    def bubble_fraction(n_micro: int, n_stages: int) -> float:
+        return (n_stages - 1) / (n_micro + n_stages - 1)

@@ -63,6 +63,22 @@ class MeshSpec:
         return P(axes if axes else None)
 
 
+# Ambient mesh registry: the engine publishes its MeshSpec here so model
+# code (ring/ulysses attention, MoE dispatch) can fetch shardings without
+# threading the mesh through every call (the analogue of the reference's
+# global process groups in deepspeed/utils/groups.py).
+_CURRENT_MESH: Optional["MeshSpec"] = None
+
+
+def set_current_mesh(ms: Optional["MeshSpec"]) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = ms
+
+
+def current_mesh() -> Optional["MeshSpec"]:
+    return _CURRENT_MESH
+
+
 def default_mesh(n_devices: Optional[int] = None) -> MeshSpec:
     """All devices on the data axis (pure DP/ZeRO)."""
     devs = jax.devices()

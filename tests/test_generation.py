@@ -1,0 +1,108 @@
+"""Generation / KV-cache / injection tests (SURVEY.md §4).
+
+Ground truth: incremental decode with cache must match full forward.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.generation import (KVCache, llama_generator,
+                                                sample_logits)
+from deepspeed_tpu.models import llama
+
+
+def _setup(T=12, B=2):
+    cfg = llama.LlamaConfig.tiny(attn_impl="reference")
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, 256)
+    return cfg, params, toks
+
+
+def test_prefill_matches_forward():
+    cfg, params, toks = _setup()
+    want = llama.forward(params, toks, cfg)
+    cache = KVCache.alloc(cfg.n_layers, 2, 32, cfg.n_kv_heads, cfg.head_dim,
+                          dtype=jnp.float32)
+    got, cache = llama.forward_with_cache(params, toks, cfg, cache)
+    assert int(cache.length) == toks.shape[1]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_incremental_decode_matches_full():
+    cfg, params, toks = _setup(T=8)
+    full = llama.forward(params, toks, cfg)
+    cache = KVCache.alloc(cfg.n_layers, 2, 16, cfg.n_kv_heads, cfg.head_dim,
+                          dtype=jnp.float32)
+    # prefill 4, then decode 4 one token at a time
+    logits, cache = llama.forward_with_cache(params, toks[:, :4], cfg, cache)
+    outs = [logits]
+    for t in range(4, 8):
+        logits, cache = llama.forward_with_cache(
+            params, toks[:, t:t + 1], cfg, cache)
+        outs.append(logits)
+    got = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full),
+                               atol=5e-4, rtol=5e-4)
+
+
+def test_generator_greedy_deterministic():
+    cfg, params, toks = _setup(T=4)
+    gen = llama_generator(params, cfg, cache_dtype=jnp.float32)
+    out1 = gen.generate(toks, max_new_tokens=6, temperature=0.0)
+    out2 = gen.generate(toks, max_new_tokens=6, temperature=0.0)
+    assert out1.shape == (2, 10)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    np.testing.assert_array_equal(np.asarray(out1[:, :4]), np.asarray(toks))
+
+
+def test_generator_eos_stops():
+    cfg, params, toks = _setup(T=4)
+    gen = llama_generator(params, cfg, cache_dtype=jnp.float32,
+                          eos_token_id=7)
+    out = gen.generate(toks, max_new_tokens=8, temperature=0.0)
+    assert out.shape[1] <= 12
+
+
+def test_sample_logits_modes():
+    rng = jax.random.PRNGKey(0)
+    logits = jnp.asarray([[0.0, 5.0, 1.0, -2.0]] * 4)
+    greedy = sample_logits(logits, rng, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(greedy), [1, 1, 1, 1])
+    # top_k=1 == greedy regardless of temperature
+    tk = sample_logits(logits, rng, temperature=1.0, top_k=1)
+    np.testing.assert_array_equal(np.asarray(tk), [1, 1, 1, 1])
+    # top_p tiny keeps only the max
+    tp = sample_logits(logits, rng, temperature=1.0, top_p=0.1)
+    np.testing.assert_array_equal(np.asarray(tp), [1, 1, 1, 1])
+
+
+def test_injection_roundtrip(tmp_path):
+    from deepspeed_tpu.integrations import hf
+    from deepspeed_tpu.inference.injection import inject
+
+    cfg = llama.LlamaConfig.tiny(attn_impl="reference")
+    params = jax.tree.map(lambda x: np.asarray(x, np.float32),
+                          llama.init_params(jax.random.PRNGKey(0), cfg))
+    hf.save_pretrained(params, cfg, str(tmp_path))
+    assert os.path.exists(tmp_path / "model.safetensors")
+    fn, params2, cfg2, specs = hf.from_pretrained(str(tmp_path),
+                                                  dtype=jnp.float32)
+    assert cfg2.dim == cfg.dim and cfg2.n_layers == cfg.n_layers
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 256)
+    want = llama.forward(params, toks, cfg)
+    got = fn(params2, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_injection_unknown_arch():
+    import pytest
+    from deepspeed_tpu.inference.injection import get_policy
+
+    with pytest.raises(ValueError):
+        get_policy("not-a-real-arch")

@@ -1,0 +1,93 @@
+"""Offload + native AIO tests (SURVEY.md §2 #8/#18/#39)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.io.aio import AioHandle
+from deepspeed_tpu.offload import NvmeSwapper, offload_shardings
+
+
+def test_aio_native_build():
+    h = AioHandle(n_threads=2)
+    # the C++ pool must build in this image (g++ is baked in)
+    assert h.native, "libdstpu_aio.so failed to build"
+
+
+def test_aio_write_read_roundtrip(tmp_path):
+    h = AioHandle(n_threads=4)
+    data = np.random.default_rng(0).standard_normal(4096).astype(np.float32)
+    path = str(tmp_path / "blob.bin")
+    fd = h.open(path, write=True)
+    h.pwrite(fd, data, 0)
+    assert h.wait() == 0
+    h.close(fd)
+
+    out = np.empty_like(data)
+    fd = h.open(path)
+    h.pread(fd, out, 0)
+    assert h.wait() == 0
+    h.close(fd)
+    np.testing.assert_array_equal(out, data)
+
+
+def test_aio_chunked_offsets(tmp_path):
+    h = AioHandle(n_threads=4)
+    path = str(tmp_path / "chunks.bin")
+    chunks = [np.full(1024, i, np.float32) for i in range(8)]
+    fd = h.open(path, write=True)
+    for i, c in enumerate(chunks):
+        h.pwrite(fd, c, i * c.nbytes)
+    assert h.wait() == 0
+    h.close(fd)
+    out = np.empty(8 * 1024, np.float32)
+    fd = h.open(path)
+    h.pread(fd, out, 0)
+    assert h.wait() == 0
+    h.close(fd)
+    np.testing.assert_array_equal(out.reshape(8, 1024)[3], chunks[3])
+
+
+def test_nvme_swapper_roundtrip(tmp_path):
+    sw = NvmeSwapper(str(tmp_path / "swap"))
+    tree = {"a": np.arange(100, dtype=np.float32).reshape(10, 10),
+            "b": {"c": np.ones(7, np.int32)}}
+    sw.swap_out(tree)
+    sw.wait()
+    like = jax.tree.map(np.zeros_like, tree)
+    back = sw.swap_in(like)
+    np.testing.assert_array_equal(back["a"], tree["a"])
+    np.testing.assert_array_equal(back["b"]["c"], tree["b"]["c"])
+
+
+def test_offload_shardings_cpu_fallback():
+    # on the CPU test backend there is no pinned_host memory space; the
+    # config path must degrade gracefully (warning, unchanged shardings)
+    from deepspeed_tpu.topology import default_mesh
+
+    ms = default_mesh()
+    sh = {"w": ms.replicated()}
+    out = offload_shardings(sh, "cpu")
+    assert out["w"] is not None
+
+
+def test_engine_with_offload_config_runs():
+    # train a tiny model with offload_optimizer config present — must run
+    # (real host tier engages only on TPU/GPU backends)
+    def loss_fn(params, batch):
+        return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+    params = {"w": jnp.ones((8, 4))}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        loss_fn=loss_fn, params=params,
+        config={"train_batch_size": 8,
+                "zero_optimization": {
+                    "stage": 2,
+                    "offload_optimizer": {"device": "cpu"}},
+                "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+                "bf16": {"enabled": False}})
+    batch = {"x": jnp.ones((8, 8)), "y": jnp.zeros((8, 4))}
+    l0 = float(engine.train_batch(batch))
+    l1 = float(engine.train_batch(batch))
+    assert l1 < l0
