@@ -153,9 +153,20 @@ class TrainingEngine:
 
         # host bookkeeping (ref: engine.global_steps / skipped_steps)
         self.global_steps = 0
-        self.skipped_steps = 0
         self._pending: Optional[dict] = None
         self._last_metrics = {}
+        # monitoring + throughput (ref: engine._configure_monitoring +
+        # ThroughputTimer in engine.train).  Backends come straight from the
+        # reference's config keys (tensorboard/wandb/csv_monitor).
+        from deepspeed_tpu.monitor import MonitorMaster
+        from deepspeed_tpu.timers import ThroughputTimer
+
+        self.monitor = MonitorMaster(config.raw)
+        self.tput_timer = ThroughputTimer(batch_size=config.train_batch_size)
+        # overflow count, accumulated as a device scalar so the hot loop
+        # never syncs; materialized on read via the skipped_steps property.
+        self._skipped_acc = jnp.zeros([], jnp.int32)
+        self._skipped_base = 0
         logger.info(
             "TrainingEngine: zero=%d mesh=%s micro=%d accum=%d global=%d dtype=%s",
             stage, self.mesh.sizes, config.train_micro_batch_size_per_gpu,
@@ -251,14 +262,48 @@ class TrainingEngine:
         return loss if aux is None else (loss, aux)
 
     # ----------------------------------------------------------- public API
+    @property
+    def skipped_steps(self) -> int:
+        """Overflow-skipped step count (ref: engine.skipped_steps)."""
+        return self._skipped_base + int(self._skipped_acc)
+
+    @skipped_steps.setter
+    def skipped_steps(self, value: int) -> None:
+        self._skipped_base = int(value)
+        self._skipped_acc = jnp.zeros([], jnp.int32)
+
+    def _post_step(self, metrics) -> None:
+        """Per-step bookkeeping shared by train_batch and step().
+
+        Kept sync-free unless a monitor backend is enabled: the overflow
+        counter accumulates on-device, and the throughput timer (which
+        drains the dispatch queue) only runs when someone will read it.
+        """
+        self.global_steps += 1
+        self._last_metrics = metrics
+        self._skipped_acc = self._skipped_acc + metrics["overflow"]
+        if self.monitor.enabled and (
+                self.global_steps % max(self.config.steps_per_print, 1) == 0):
+            self.monitor.write_scalars(
+                {"Train/loss": float(metrics["loss"]),
+                 "Train/lr": float(metrics["lr"]),
+                 "Train/grad_norm": float(metrics["grad_norm"]),
+                 "Train/samples_per_sec": self.tput_timer.samples_per_sec},
+                self.global_steps)
+            self.monitor.flush()
+
     def train_batch(self, batch) -> jnp.ndarray:
         """Run one full optimizer step on a global batch; returns the loss.
 
         (ref: PipelineEngine.train_batch — one call per global step.)
         """
+        timed = self.monitor.enabled
+        if timed:
+            self.tput_timer.start()
         self.state, metrics = self._step_fn(self.state, batch)
-        self.global_steps += 1
-        self._last_metrics = metrics
+        if timed:
+            self.tput_timer.stop()
+        self._post_step(metrics)
         return metrics["loss"]
 
     def eval_batch(self, batch):
@@ -288,8 +333,8 @@ class TrainingEngine:
         """Complete the step started by ``engine(batch)`` (bookkeeping only)."""
         if self._pending is None:
             raise RuntimeError("step() without a preceding engine(batch) call")
-        self._pending = None
-        self.global_steps += 1
+        metrics, self._pending = self._pending, None
+        self._post_step(metrics)
 
     # ------------------------------------------------------------ inspection
     @property

@@ -1,0 +1,103 @@
+"""Execution tracing (aux subsystem; ref: DeepSpeed's profiling hooks +
+
+``deepspeed.comm`` comms-logger).  TPU-native tracing rides
+``jax.profiler``: captured traces contain per-HLO device timelines
+viewable in TensorBoard/Perfetto — strictly richer than the reference's
+python-level hooks, because the schedule being traced is XLA's real one.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+
+class Tracer:
+    """start/stop trace capture + named annotation ranges."""
+
+    def __init__(self, log_dir: str = "/tmp/dstpu_trace"):
+        self.log_dir = log_dir
+        self.active = False
+
+    def start(self) -> None:
+        os.makedirs(self.log_dir, exist_ok=True)
+        jax.profiler.start_trace(self.log_dir)
+        self.active = True
+
+    def stop(self) -> None:
+        if self.active:
+            jax.profiler.stop_trace()
+            self.active = False
+
+    @contextlib.contextmanager
+    def trace(self):
+        self.start()
+        try:
+            yield self
+        finally:
+            self.stop()
+
+    @staticmethod
+    def annotate(name: str):
+        """Named range visible in the device timeline."""
+        return jax.profiler.TraceAnnotation(name)
+
+    @staticmethod
+    def step(step_num: int):
+        """Mark one train step (groups HLOs under a step in the viewer)."""
+        return jax.profiler.StepTraceAnnotation("train_step", step_num=step_num)
+
+
+class CommsLogger:
+    """Python-side collective log (ref: deepspeed/comm comms_logger).
+
+    The comm backend calls :meth:`record` around each collective; we keep
+    (op, bytes, wall_s) so tests/users can audit comm volume without a
+    full device trace.
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self.records: List[Tuple[str, int, float]] = []
+
+    @contextlib.contextmanager
+    def record(self, op: str, nbytes: int):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            with self._lock:
+                self.records.append((op, nbytes, time.perf_counter() - t0))
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for op, nbytes, dt in self.records:
+                s = out.setdefault(op, {"count": 0, "bytes": 0, "time_s": 0.0})
+                s["count"] += 1
+                s["bytes"] += nbytes
+                s["time_s"] += dt
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self.records.clear()
+
+
+_global_tracer: Optional[Tracer] = None
+
+
+def get_tracer(log_dir: str = "/tmp/dstpu_trace") -> Tracer:
+    global _global_tracer
+    if _global_tracer is None:
+        _global_tracer = Tracer(log_dir)
+    return _global_tracer

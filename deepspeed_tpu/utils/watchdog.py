@@ -1,0 +1,105 @@
+"""Failure detection (aux subsystem; ref: DeepSpeed's overflow checking in
+
+``runtime/fp16/loss_scaler.py`` + elastic fault tolerance).
+
+Two guards:
+
+- :class:`NanGuard` — jit-compatible finite check over the grad pytree;
+  the engine uses it to skip the update on overflow (same contract as the
+  reference's ``CHECK_OVERFLOW`` + dynamic loss scaler ``skip step``).
+- :class:`Watchdog` — a host-side heartbeat thread that detects multi-host
+  hangs (a collective stuck because one host died) and invokes a callback
+  / aborts, the TPU analogue of NCCL watchdog timeouts.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class NanGuard:
+    """Finite-check + skip-step accounting, usable inside jit."""
+
+    @staticmethod
+    def all_finite(tree: Any) -> jax.Array:
+        """Scalar bool: every leaf of the pytree is finite."""
+        leaves = [jnp.all(jnp.isfinite(x)) for x in jax.tree_util.tree_leaves(tree)
+                  if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)]
+        if not leaves:
+            return jnp.array(True)
+        return jnp.stack(leaves).all()
+
+    @staticmethod
+    def where_finite(tree: Any, new: Any, old: Any) -> Any:
+        """Select ``new`` if grads were finite else keep ``old`` (skip-step)."""
+        ok = NanGuard.all_finite(tree)
+        return jax.tree_util.tree_map(
+            lambda n, o: jnp.where(ok, n, o), new, old)
+
+
+class Watchdog:
+    """Heartbeat-based hang detector.
+
+    Call :meth:`pet` after every completed step.  A daemon thread fires
+    ``on_timeout`` (default: log + ``os._exit(42)`` so the launcher can
+    restart the job) if no heartbeat arrives within ``timeout_s`` —
+    detecting the classic multi-host failure where a peer dies and every
+    other host blocks forever inside an ICI/DCN collective.
+    """
+
+    def __init__(self, timeout_s: float = 600.0,
+                 on_timeout: Optional[Callable[[], None]] = None,
+                 abort_on_timeout: bool = True,
+                 poll_s: float = 1.0):
+        self.timeout_s = timeout_s
+        self.on_timeout = on_timeout
+        self.abort_on_timeout = abort_on_timeout
+        self.poll_s = poll_s
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self.fired = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Watchdog":
+        self._last = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dstpu-watchdog")
+        self._thread.start()
+        return self
+
+    def pet(self) -> None:
+        self._last = time.monotonic()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            if time.monotonic() - self._last > self.timeout_s:
+                self.fired = True
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.error(
+                    "watchdog: no heartbeat for %.0fs on host %d — "
+                    "likely hung collective (dead peer)",
+                    self.timeout_s, jax.process_index())
+                if self.on_timeout is not None:
+                    self.on_timeout()
+                if self.abort_on_timeout:
+                    os._exit(42)
+                return
