@@ -1,0 +1,182 @@
+"""Config autotuner (ref: deepspeed/autotuning/autotuner.py).
+
+The reference launches sweeps of real training runs over zero-stage /
+micro-batch / offload spaces and picks the fastest.  On TPU a candidate
+is cheap to evaluate — build the jitted step, time a few iterations —
+so the tuner runs in-process: grid (or user-listed) candidates over
+mesh layout, micro batch, remat policy, zero stage; failed candidates
+(OOM, bad mesh product) are recorded and skipped; the best config is
+cached to JSON keyed by (device kind, chip count, space hash) so later
+jobs skip the sweep (ref analogue: autotuning results/exps dirs).
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import itertools
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist
+
+# Default space mirrors the reference's tuning knobs
+# (ref: autotuning/config.py tuner spaces).
+DEFAULT_SPACE: Dict[str, List[Any]] = {
+    "zero_optimization.stage": [0, 1, 2, 3],
+    "train_micro_batch_size_per_gpu": [1, 2, 4, 8],
+    "activation_checkpointing.policy": ["none", "save_dots", "full"],
+}
+
+
+def set_by_path(d: Dict, dotted: str, value: Any) -> None:
+    keys = dotted.split(".")
+    for k in keys[:-1]:
+        d = d.setdefault(k, {})
+    d[keys[-1]] = value
+
+
+def expand_space(space: Dict[str, List[Any]]) -> List[Dict[str, Any]]:
+    """Cartesian product of the space → list of override dicts."""
+    keys = sorted(space)
+    out = []
+    for combo in itertools.product(*(space[k] for k in keys)):
+        out.append(dict(zip(keys, combo)))
+    return out
+
+
+def _space_key(space_or_candidates, extra: str = "") -> str:
+    blob = json.dumps(space_or_candidates, sort_keys=True, default=str) + extra
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class Autotuner:
+    """Measure candidates, keep the fastest, cache the verdict.
+
+    Parameters
+    ----------
+    build_fn: ``overrides -> step()`` — returns a zero-arg callable that
+        runs ONE full training step with the overrides applied (compile
+        happens on first call).  Raise to mark the candidate invalid.
+    candidates: override dicts (dotted config keys), e.g. from
+        :func:`expand_space`.
+    cache_path: JSON result cache; ``None`` disables caching.
+    """
+
+    def __init__(self, build_fn: Callable[[Dict[str, Any]], Callable[[], Any]],
+                 candidates: Iterable[Dict[str, Any]],
+                 cache_path: Optional[str] = "autotune_cache.json",
+                 iters: int = 3, warmup: int = 1,
+                 workload_key: str = ""):
+        self.build_fn = build_fn
+        self.candidates = list(candidates)
+        self.cache_path = cache_path
+        self.iters = iters
+        self.warmup = warmup
+        self.workload_key = workload_key  # distinguishes models/workloads
+        self.results: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------------- cache
+    def _cache_key(self) -> str:
+        dev = jax.devices()[0].device_kind if jax.devices() else "none"
+        return _space_key(
+            self.candidates,
+            f"{dev}:{jax.device_count()}:{self.workload_key}")
+
+    def _load_cache(self) -> Optional[Dict[str, Any]]:
+        if not self.cache_path or not os.path.exists(self.cache_path):
+            return None
+        try:
+            with open(self.cache_path) as f:
+                return json.load(f).get(self._cache_key())
+        except Exception:
+            return None
+
+    def _store_cache(self, entry: Dict[str, Any]) -> None:
+        if not self.cache_path:
+            return
+        data = {}
+        if os.path.exists(self.cache_path):
+            try:
+                with open(self.cache_path) as f:
+                    data = json.load(f)
+            except Exception:
+                data = {}
+        data[self._cache_key()] = entry
+        with open(self.cache_path, "w") as f:
+            json.dump(data, f, indent=1)
+
+    # ------------------------------------------------------------- measure
+    def _measure(self, overrides: Dict[str, Any]) -> float:
+        step = self.build_fn(overrides)
+        for _ in range(self.warmup):
+            jax.block_until_ready(step())
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(self.iters):
+            out = step()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / self.iters
+
+    def tune(self) -> Dict[str, Any]:
+        """Returns ``{"overrides": best, "step_time_s": t, "results": [...]}``."""
+        cached = self._load_cache()
+        if cached is not None:
+            log_dist(f"autotune: cache hit ({self._cache_key()})")
+            return cached
+        best: Optional[Tuple[float, Dict[str, Any]]] = None
+        for ov in self.candidates:
+            try:
+                t = self._measure(ov)
+                self.results.append({"overrides": ov, "step_time_s": t})
+                if best is None or t < best[0]:
+                    best = (t, ov)
+                log_dist(f"autotune: {ov} -> {t * 1e3:.2f}ms")
+            except Exception as e:  # OOM / invalid mesh / compile failure
+                self.results.append({"overrides": ov, "error": str(e)[:200]})
+                log_dist(f"autotune: {ov} failed: {e}")
+        if best is None:
+            raise RuntimeError("autotune: every candidate failed")
+        entry = {"overrides": best[1], "step_time_s": best[0],
+                 "results": self.results}
+        self._store_cache(entry)
+        return entry
+
+
+def autotune_config(base_config: Dict[str, Any], loss_fn: Callable,
+                    params: Any, batch: Any,
+                    space: Optional[Dict[str, List[Any]]] = None,
+                    cache_path: Optional[str] = "autotune_cache.json",
+                    iters: int = 3) -> Dict[str, Any]:
+    """End-to-end: sweep engine configs, return the winning config dict
+
+    (ref: autotuner.tune() → best exp's ds_config)."""
+    from deepspeed_tpu.engine import TrainingEngine
+    from deepspeed_tpu.config import Config
+
+    space = space or DEFAULT_SPACE
+
+    def build(overrides: Dict[str, Any]) -> Callable[[], Any]:
+        d = copy.deepcopy(base_config)
+        for k, v in overrides.items():
+            set_by_path(d, k, v)
+        eng = TrainingEngine(loss_fn, params, Config.from_dict(d))
+        return lambda: eng.train_batch(batch)
+
+    # cache key must pin the workload, not just the space: same sweep on a
+    # different model/base-config must re-measure
+    shapes = jax.tree.map(
+        lambda x: str(getattr(x, "shape", ())) + str(getattr(x, "dtype", "")),
+        (params, batch))
+    wkey = _space_key({"base": base_config, "shapes": shapes})
+    verdict = Autotuner(build, expand_space(space), cache_path=cache_path,
+                        iters=iters, workload_key=wkey).tune()
+    final = copy.deepcopy(base_config)
+    for k, v in verdict["overrides"].items():
+        set_by_path(final, k, v)
+    verdict["config"] = final
+    return verdict

@@ -178,6 +178,7 @@ class Config:
         default_factory=ActivationCheckpointingConfig)
     pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
     moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
+    elasticity: Optional[Any] = None  # ElasticityConfig when enabled
     raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     # ---------------------------------------------------------------- parse
@@ -238,6 +239,10 @@ class Config:
             known = {f.name for f in dataclasses.fields(MoEConfig)}
             c.moe = MoEConfig(**{k: v for k, v in d["moe"].items() if k in known})
             c.moe.enabled = c.moe.enabled or c.moe.num_experts > 1
+        if d.get("elasticity", {}).get("enabled"):
+            from deepspeed_tpu.elasticity import ElasticityConfig
+
+            c.elasticity = ElasticityConfig.from_dict(d["elasticity"])
         return c
 
     @classmethod
@@ -252,6 +257,17 @@ class Config:
         ``_configure_train_batch_size``): any two given determine the third;
         one given assumes the others default; all three must be consistent.
         """
+        if self.elasticity is not None and self.elasticity.enabled:
+            # Elastic mode OWNS the batch config (ref: elasticity.py
+            # ensure_immutable_elastic_config): solve for this world size.
+            from deepspeed_tpu.elasticity import compute_elastic_config
+
+            run = compute_elastic_config(self.elasticity, world_size=dp_world)
+            self.train_batch_size = run["train_batch_size"]
+            self.train_micro_batch_size_per_gpu = \
+                run["train_micro_batch_size_per_gpu"]
+            self.gradient_accumulation_steps = run["gradient_accumulation_steps"]
+            return
         t, m, a = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
                    self.gradient_accumulation_steps)
         if t is not None and m is not None and a is not None:

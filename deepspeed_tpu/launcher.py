@@ -1,0 +1,190 @@
+"""Multi-host launcher (ref: deepspeed/launcher/runner.py + launch.py).
+
+The reference's ``deepspeed`` CLI parses a hostfile, picks a runner
+(pdsh/openmpi/mvapich), and spawns one process per GPU with
+RANK/WORLD_SIZE env.  On TPU the runtime is SPMD multi-controller: ONE
+python process per host, each seeing its local chips, joined via
+``jax.distributed.initialize``.  So the launcher's job is
+
+- **pod autodetect**: on a TPU pod slice the coordinator/process-count/
+  process-id come from the TPU metadata env; ``jax.distributed
+  .initialize()`` with no args resolves them.  (ref analogue: the
+  OpenMPI runner's env detection.)
+- **explicit bring-up**: ``--coordinator host:port --nnodes N --node_rank
+  R`` for DCN clusters, mirroring ``--master_addr/--master_port``.
+- **local simulation**: ``--local_hosts N`` forks N processes with a
+  chosen XLA platform (cpu) so multi-host code paths run on one machine.
+
+CLI: ``python -m deepspeed_tpu.launcher [opts] script.py [script args]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+# Env vars understood by jax.distributed / TPU pods (public names).
+_POD_ENV_HINTS = (
+    "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID", "MEGASCALE_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+)
+
+
+def running_on_pod() -> bool:
+    """True when TPU-pod metadata env is present (auto bring-up works)."""
+    return any(v in os.environ for v in _POD_ENV_HINTS)
+
+
+def build_env(coordinator: str, num_nodes: int, node_rank: int,
+              base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Env for one host process (ref: launcher/launch.py child env)."""
+    env = dict(base if base is not None else os.environ)
+    # the names comm.init_distributed resolves, + the reference's RANK/
+    # WORLD_SIZE so user scripts written against it keep working
+    env["COORDINATOR_ADDRESS"] = coordinator
+    env["NUM_PROCESSES"] = env["WORLD_SIZE"] = str(num_nodes)
+    env["PROCESS_ID"] = env["RANK"] = str(node_rank)
+    return env
+
+
+def parse_hostfile(text: str) -> List[str]:
+    """``host slots=N`` lines → host list (ref: runner.py parse_hostfile).
+
+    Slots are parsed for compatibility but unused: TPU runs one process
+    per host regardless of local chip count.
+    """
+    hosts = []
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        hosts.append(line.split()[0])
+    return hosts
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dstpu", description="deepspeed_tpu multi-host launcher")
+    p.add_argument("--hostfile", default=None,
+                   help="deepspeed-style hostfile (host slots=N per line)")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="coordinator address (ref: --master_addr/--master_port)")
+    p.add_argument("--nnodes", type=int, default=None,
+                   help="number of host processes")
+    p.add_argument("--node_rank", type=int, default=None,
+                   help="this host's process index")
+    p.add_argument("--local_hosts", type=int, default=0,
+                   help="fork N local processes (CPU simulation of multi-host)")
+    p.add_argument("--platform", default=None,
+                   help="force JAX platform in children (e.g. cpu)")
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def _wait_all(procs: List[subprocess.Popen]) -> int:
+    """Wait for children; on first failure (or Ctrl-C) kill the rest so a
+    dead rank can't leave siblings hung in distributed init (ref:
+    launch.py sigkill_handler)."""
+    import time
+
+    try:
+        while True:
+            rcs = [p.poll() for p in procs]
+            if all(rc is not None for rc in rcs):
+                return next((rc for rc in rcs if rc), 0)
+            if any(rc not in (None, 0) for rc in rcs):
+                break
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    return next((p.returncode for p in procs if p.returncode), 1)
+
+
+def launch_local(args) -> int:
+    """Fork ``--local_hosts`` processes on this machine, one per fake host."""
+    coordinator = args.coordinator or "127.0.0.1:12355"
+    procs = []
+    for rank in range(args.local_hosts):
+        env = build_env(coordinator, args.local_hosts, rank)
+        if args.platform:
+            env["JAX_PLATFORMS"] = args.platform
+        procs.append(subprocess.Popen(
+            [sys.executable, args.script] + args.script_args, env=env))
+    return _wait_all(procs)
+
+
+def ssh_command(host: str, coordinator: str, num_nodes: int, node_rank: int,
+                script: str, script_args: List[str]) -> List[str]:
+    """argv for launching one remote rank over ssh (ref: runner.py's pdsh
+    command construction).  Bring-up env is passed inline with ``env`` so
+    no remote shell config is required."""
+    inner = " ".join(
+        ["env",
+         f"COORDINATOR_ADDRESS={coordinator}",
+         f"NUM_PROCESSES={num_nodes}", f"WORLD_SIZE={num_nodes}",
+         f"PROCESS_ID={node_rank}", f"RANK={node_rank}",
+         "python", script] + list(script_args))
+    return ["ssh", "-o", "StrictHostKeyChecking=no", host, inner]
+
+
+def launch_ssh(hosts: List[str], args) -> int:
+    """Spawn one rank per host over ssh (ref: PDSHRunner)."""
+    coordinator = args.coordinator or f"{hosts[0]}:12355"
+    procs = [subprocess.Popen(
+        ssh_command(h, coordinator, len(hosts), rank, args.script,
+                    args.script_args))
+        for rank, h in enumerate(hosts)]
+    return _wait_all(procs)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    if args.local_hosts > 0:
+        return launch_local(args)
+
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = parse_hostfile(f.read())
+        if args.node_rank is None and len(hosts) > 1:
+            # launcher-of-launchers: spawn one rank per listed host
+            return launch_ssh(hosts, args)
+        if args.nnodes is None:
+            args.nnodes = len(hosts)
+        if args.coordinator is None and hosts:
+            args.coordinator = f"{hosts[0]}:12355"
+
+    # Single invocation on this host: export bring-up env and exec the
+    # script in-process so `import deepspeed_tpu; init_distributed()`
+    # connects (ref: launch.py main loop, minus per-GPU fork).
+    if running_on_pod() and args.coordinator is None:
+        # TPU pod slice: jax.distributed.initialize() resolves coordinator/
+        # rank from the pod metadata env — leave it untouched.
+        pass
+    elif args.coordinator and args.nnodes and args.node_rank is not None:
+        os.environ.update(build_env(args.coordinator, args.nnodes,
+                                    args.node_rank, base={}))
+    elif args.coordinator or args.nnodes or args.node_rank is not None:
+        raise SystemExit(
+            "dstpu: --coordinator, --nnodes and --node_rank must be given "
+            "together (or use --hostfile / --local_hosts)")
+    sys.argv = [args.script] + args.script_args
+    with open(args.script) as f:
+        code = compile(f.read(), args.script, "exec")
+    exec(code, {"__name__": "__main__", "__file__": args.script})
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

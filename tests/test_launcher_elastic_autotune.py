@@ -1,0 +1,209 @@
+"""Launcher, elasticity, autotune (SURVEY rows 33-35)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityConfig, compute_elastic_config,
+                                      get_best_candidate_batch_size,
+                                      get_valid_gpus, mesh_factorizations)
+from deepspeed_tpu.launcher import build_env, make_parser, parse_hostfile
+from deepspeed_tpu.autotune import (Autotuner, autotune_config, expand_space,
+                                    set_by_path)
+
+
+# ------------------------------------------------------------------ launcher
+def test_parse_hostfile():
+    hosts = parse_hostfile("""
+# comment
+worker-0 slots=8
+worker-1 slots=8  # trailing
+worker-2
+""")
+    assert hosts == ["worker-0", "worker-1", "worker-2"]
+
+
+def test_build_env_contract():
+    env = build_env("10.0.0.1:1234", 4, 2, base={})
+    # names comm.init_distributed resolves + reference compat names
+    assert env["COORDINATOR_ADDRESS"] == "10.0.0.1:1234"
+    assert env["NUM_PROCESSES"] == "4" and env["WORLD_SIZE"] == "4"
+    assert env["PROCESS_ID"] == "2" and env["RANK"] == "2"
+
+
+def test_parser_passthrough():
+    args = make_parser().parse_args(
+        ["--coordinator", "h:1", "--nnodes", "2", "--node_rank", "0",
+         "train.py", "--lr", "0.1"])
+    assert args.script == "train.py"
+    assert args.script_args == ["--lr", "0.1"]
+
+
+def test_launcher_runs_script(tmp_path):
+    script = tmp_path / "hello.py"
+    script.write_text("import os, sys; print('RANK=' + os.environ.get('RANK','?'))\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher",
+         "--coordinator", "127.0.0.1:1", "--nnodes", "1", "--node_rank", "0",
+         str(script)],
+        capture_output=True, text=True, cwd="/root/repo",
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "RANK=0" in out.stdout
+
+
+# ---------------------------------------------------------------- elasticity
+def test_get_valid_gpus():
+    # batch 12, micro in {2,3}: micro=2 → 6 chips worth of divisors; micro=3 → 4...
+    gpus = get_valid_gpus(12, [2, 3], min_gpus=1, max_gpus=100)
+    assert gpus == [1, 2, 3, 4, 6]
+    assert get_valid_gpus(7, [2], 1, 100) == []  # 7 not divisible by 2
+
+
+def test_best_candidate_prefers_coverage_then_size():
+    b, gpus = get_best_candidate_batch_size(
+        24, [2, 4], min_gpus=1, max_gpus=100, prefer_larger=True)
+    assert b in range(2, 25) and b % 2 == 0
+    # every returned chip count actually divides some micro config
+    for g in gpus:
+        assert any(b % (mb * g) == 0 for mb in [2, 4])
+
+
+def test_compute_elastic_config_resolves_run():
+    cfg = ElasticityConfig(enabled=True, max_train_batch_size=64,
+                           micro_batch_sizes=[2, 4], min_gpus=1, max_gpus=16)
+    out = compute_elastic_config(cfg)
+    assert out["train_batch_size"] <= 64 and out["valid_gpus"]
+    ws = out["valid_gpus"][-1]
+    run = compute_elastic_config(cfg, world_size=ws)
+    mb, ga = run["train_micro_batch_size_per_gpu"], run["gradient_accumulation_steps"]
+    assert mb * ga * ws == run["train_batch_size"]
+    with pytest.raises(ValueError):
+        compute_elastic_config(cfg, world_size=max(out["valid_gpus"]) * 2 + 1)
+
+
+def test_elasticity_applied_in_config_resolution():
+    from deepspeed_tpu.config import Config
+
+    cfg = Config.from_dict({
+        "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                       "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                       "max_gpus": 16}})
+    assert cfg.elasticity is not None
+    ws = compute_elastic_config(cfg.elasticity)["valid_gpus"][-1]
+    cfg.resolve_batch_sizes(ws)
+    assert (cfg.train_micro_batch_size_per_gpu
+            * cfg.gradient_accumulation_steps * ws == cfg.train_batch_size)
+    # an invalid world size fails loudly instead of training mis-sized
+    bad = Config.from_dict({
+        "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                       "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                       "max_gpus": 16}})
+    with pytest.raises(ValueError):
+        bad.resolve_batch_sizes(7 * ws + 1)
+
+
+def test_ssh_command_and_hostfile_spawn_path():
+    from deepspeed_tpu.launcher import ssh_command
+
+    argv = ssh_command("worker-1", "worker-0:12355", 4, 1,
+                       "train.py", ["--lr", "0.1"])
+    assert argv[0] == "ssh" and "worker-1" in argv
+    inner = argv[-1]
+    assert "RANK=1" in inner and "WORLD_SIZE=4" in inner
+    assert "COORDINATOR_ADDRESS=worker-0:12355" in inner
+    assert inner.endswith("train.py --lr 0.1")
+
+
+def test_launch_local_kills_siblings_on_failure(tmp_path):
+    from deepspeed_tpu.launcher import main
+    import time
+
+    crash = tmp_path / "crash.py"
+    crash.write_text(
+        "import os, sys, time\n"
+        "if os.environ['RANK'] == '0': sys.exit(3)\n"
+        "time.sleep(60)\n")
+    t0 = time.time()
+    rc = main(["--local_hosts", "2", "--platform", "cpu", str(crash)])
+    assert rc != 0
+    assert time.time() - t0 < 30  # siblings terminated, no 60s hang
+
+
+def test_mesh_factorizations():
+    shapes = mesh_factorizations(8)
+    assert {"data": 8, "model": 1} in shapes and {"data": 1, "model": 8} in shapes
+    assert all(s["data"] * s["model"] == 8 for s in shapes)
+    capped = mesh_factorizations(8, max_model=2)
+    assert all(s["model"] <= 2 for s in capped)
+
+
+# ------------------------------------------------------------------ autotune
+def test_expand_space_and_set_by_path():
+    combos = expand_space({"a.b": [1, 2], "c": ["x"]})
+    assert len(combos) == 2 and {"a.b": 1, "c": "x"} in combos
+    d = {}
+    set_by_path(d, "zero_optimization.stage", 3)
+    assert d == {"zero_optimization": {"stage": 3}}
+
+
+def test_autotuner_picks_fastest_and_caches(tmp_path):
+    import time
+    calls = []
+
+    def build(ov):
+        delay = ov["delay"]
+        calls.append(delay)
+        def step():
+            time.sleep(delay)
+            return jnp.zeros(())
+        return step
+
+    cache = str(tmp_path / "cache.json")
+    tuner = Autotuner(build, [{"delay": 0.03}, {"delay": 0.001}],
+                      cache_path=cache, iters=2, warmup=1)
+    out = tuner.tune()
+    assert out["overrides"] == {"delay": 0.001}
+    # second run: cache hit, no new builds
+    n = len(calls)
+    out2 = Autotuner(build, [{"delay": 0.03}, {"delay": 0.001}],
+                     cache_path=cache, iters=2, warmup=1).tune()
+    assert out2["overrides"] == {"delay": 0.001} and len(calls) == n
+
+
+def test_autotuner_skips_failed_candidates(tmp_path):
+    def build(ov):
+        if ov["bad"]:
+            raise MemoryError("oom")
+        return lambda: jnp.zeros(())
+
+    out = Autotuner(build, [{"bad": True}, {"bad": False}],
+                    cache_path=None, iters=1, warmup=0).tune()
+    assert out["overrides"] == {"bad": False}
+    assert any("error" in r for r in out["results"])
+
+
+def test_autotune_config_end_to_end(tmp_path):
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(8, 4), jnp.float32)}
+    batch = {"x": jnp.asarray(rng.randn(16, 8), jnp.float32),
+             "y": jnp.asarray(rng.randn(16, 4), jnp.float32)}
+
+    def loss_fn(p, b):
+        return jnp.mean((b["x"] @ p["w"].astype(jnp.float32) - b["y"]) ** 2)
+
+    base = {"train_batch_size": 16,
+            "optimizer": {"type": "sgd", "params": {"lr": 0.1}}}
+    verdict = autotune_config(
+        base, loss_fn, params, batch,
+        space={"zero_optimization.stage": [0, 2]},
+        cache_path=str(tmp_path / "c.json"), iters=2)
+    assert verdict["overrides"]["zero_optimization.stage"] in (0, 2)
+    assert verdict["config"]["train_batch_size"] == 16
+    assert "zero_optimization" in verdict["config"]
