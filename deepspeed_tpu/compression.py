@@ -1,0 +1,215 @@
+"""Compression training (ref: deepspeed/compression/{compress.py,
+basic_layer.py,config.py}).
+
+The reference rewrites torch modules into QuantAct/LinearLayer_Compress
+wrappers driven by the ``compression_training`` config block: QAT weight
+/ activation quantization, magnitude ("sparse") pruning, row pruning,
+attention-head pruning, channel pruning — each gated on a
+``schedule_offset`` step and scoped to module-name patterns.
+
+Functionally here: a :class:`Compressor` built from the same JSON keys
+applies straight-through-estimator fake quantization and pruning masks
+to the param pytree *inside* the jitted forward —
+``params = compressor.apply(params, step)`` — so XLA fuses the masks
+into the matmuls and the schedule gate is a traced ``jnp.where``.
+``init_compression`` mirrors the reference entrypoint name.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quant import quantize, dequantize
+
+
+# ----------------------------------------------------------------- fake quant
+def fake_quant(x: jnp.ndarray, bits: int = 8, num_groups: int = 1,
+               symmetric: bool = True) -> jnp.ndarray:
+    """Quantize-dequantize with a straight-through gradient (QAT)."""
+    q, s, z = quantize(x, bits=bits, num_groups=num_groups,
+                       symmetric=symmetric)
+    deq = dequantize(q, s, z, bits=bits, dtype=jnp.float32).astype(x.dtype)
+    return x + jax.lax.stop_gradient(deq - x)
+
+
+# -------------------------------------------------------------------- masks
+def magnitude_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Keep the top ``dense_ratio`` fraction by |w| (ref: sparse_pruning
+    method=l1)."""
+    k = max(1, int(round(w.size * dense_ratio)))
+    thresh = jnp.sort(jnp.abs(w).ravel())[w.size - k]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def row_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Keep rows (output channels) with top L2 norms (ref: row_pruning)."""
+    norms = jnp.linalg.norm(w.reshape(w.shape[0], -1).astype(jnp.float32),
+                            axis=1)
+    k = max(1, int(round(w.shape[0] * dense_ratio)))
+    thresh = jnp.sort(norms)[w.shape[0] - k]
+    keep = (norms >= thresh).astype(w.dtype)
+    return keep.reshape((w.shape[0],) + (1,) * (w.ndim - 1))
+
+
+def head_mask(w: jnp.ndarray, num_heads: int, dense_ratio: float) -> jnp.ndarray:
+    """Keep attention heads with top L2 norms (ref: head_pruning on the
+    attention output projection).  ``w``: [..., num_heads*head_dim] on the
+    last axis."""
+    d = w.shape[-1]
+    hd = d // num_heads
+    per_head = w.reshape(-1, num_heads, hd).astype(jnp.float32)
+    norms = jnp.sqrt(jnp.sum(jnp.square(per_head), axis=(0, 2)))
+    k = max(1, int(round(num_heads * dense_ratio)))
+    thresh = jnp.sort(norms)[num_heads - k]
+    keep = (norms >= thresh).astype(w.dtype)
+    return jnp.repeat(keep, hd).reshape((1,) * (w.ndim - 1) + (d,))
+
+
+# -------------------------------------------------------------------- config
+@dataclasses.dataclass
+class CompressionGroup:
+    """One ``different_groups`` entry (ref: compression/config.py)."""
+
+    modules: List[str]
+    bits: int = 8                  # weight/activation quantization target
+    dense_ratio: float = 1.0       # pruning keep fraction
+    num_heads: int = 0             # head pruning
+    quantize_groups: int = 1
+
+
+@dataclasses.dataclass
+class CompressionMethod:
+    enabled: bool = False
+    schedule_offset: int = 0
+    groups: List[CompressionGroup] = dataclasses.field(default_factory=list)
+
+
+def _parse_method(d: Dict[str, Any], kind: str) -> CompressionMethod:
+    shared = d.get("shared_parameters", {})
+    m = CompressionMethod(enabled=bool(shared.get("enabled", False)),
+                          schedule_offset=int(shared.get("schedule_offset", 0)))
+    for name, grp in d.get("different_groups", {}).items():
+        p = grp.get("params", {})
+        m.groups.append(CompressionGroup(
+            modules=list(grp.get("modules", ["*"])),
+            bits=int(p.get("target_bits", p.get("bits", 8))),
+            dense_ratio=float(p.get("dense_ratio", 1.0)),
+            num_heads=int(p.get("num_heads", 0)),
+            quantize_groups=int(shared.get("quantize_groups", 1)),
+        ))
+    return m
+
+
+@dataclasses.dataclass
+class CompressionConfig:
+    """Parsed ``compression_training`` block (same keys as the reference)."""
+
+    weight_quantization: CompressionMethod = dataclasses.field(
+        default_factory=CompressionMethod)
+    activation_quantization: CompressionMethod = dataclasses.field(
+        default_factory=CompressionMethod)
+    sparse_pruning: CompressionMethod = dataclasses.field(
+        default_factory=CompressionMethod)
+    row_pruning: CompressionMethod = dataclasses.field(
+        default_factory=CompressionMethod)
+    head_pruning: CompressionMethod = dataclasses.field(
+        default_factory=CompressionMethod)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CompressionConfig":
+        ct = d.get("compression_training", d)
+        c = cls()
+        for field in dataclasses.fields(cls):
+            if field.name in ct:
+                setattr(c, field.name, _parse_method(ct[field.name], field.name))
+        return c
+
+
+def _match(path: str, patterns: List[str]) -> bool:
+    return any(fnmatch.fnmatch(path, pat) or pat in path for pat in patterns)
+
+
+def _leaf_path(kp) -> str:
+    parts = []
+    for k in kp:
+        parts.append(str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k)))))
+    return ".".join(parts)
+
+
+class Compressor:
+    """Applies the configured compression to a param pytree inside jit."""
+
+    def __init__(self, config: CompressionConfig):
+        self.config = config
+
+    @property
+    def active(self) -> bool:
+        c = self.config
+        return any(m.enabled for m in (
+            c.weight_quantization, c.sparse_pruning, c.row_pruning,
+            c.head_pruning))
+
+    def apply(self, params: Any, step=0) -> Any:
+        """params → compressed params; ``step`` may be traced."""
+        if not self.active:
+            return params
+        c = self.config
+        step = jnp.asarray(step)
+
+        def apply_one(method, transform, path, out):
+            """Gate ``transform`` on enablement, module match, schedule."""
+            if not method.enabled:
+                return out
+            for g in method.groups:
+                if _match(path, g.modules):
+                    return jnp.where(step >= method.schedule_offset,
+                                     transform(out, g), out)
+            return out
+
+        def leaf(kp, w):
+            if not hasattr(w, "ndim") or w.ndim < 2 or not jnp.issubdtype(
+                    jnp.asarray(w).dtype, jnp.floating):
+                return w
+            path = _leaf_path(kp)
+            out = w
+            # masks stack; quantization runs last on the pruned weight
+            out = apply_one(c.sparse_pruning,
+                            lambda x, g: x * magnitude_mask(x, g.dense_ratio),
+                            path, out)
+            out = apply_one(c.row_pruning,
+                            lambda x, g: x * row_mask(x, g.dense_ratio),
+                            path, out)
+            out = apply_one(c.head_pruning,
+                            lambda x, g: x * head_mask(x, g.num_heads,
+                                                       g.dense_ratio)
+                            if g.num_heads else x, path, out)
+            out = apply_one(c.weight_quantization,
+                            lambda x, g: fake_quant(x, bits=g.bits,
+                                                    num_groups=g.quantize_groups),
+                            path, out)
+            return out
+
+        return jax.tree_util.tree_map_with_path(leaf, params)
+
+    def quantize_activation(self, x: jnp.ndarray, step=0) -> jnp.ndarray:
+        """Fake-quantize an activation (call inside the model's forward)."""
+        m = self.config.activation_quantization
+        if not m.enabled or not m.groups:
+            return x
+        g = m.groups[0]
+        return jnp.where(jnp.asarray(step) >= m.schedule_offset,
+                         fake_quant(x, bits=g.bits), x)
+
+
+def init_compression(config: Any) -> Compressor:
+    """ref: deepspeed.compression.compress.init_compression."""
+    if isinstance(config, Compressor):
+        return config
+    if isinstance(config, CompressionConfig):
+        return Compressor(config)
+    return Compressor(CompressionConfig.from_dict(config or {}))

@@ -258,8 +258,17 @@ class Config:
         one given assumes the others default; all three must be consistent.
         """
         if self.elasticity is not None and self.elasticity.enabled:
-            # Elastic mode OWNS the batch config (ref: elasticity.py
-            # ensure_immutable_elastic_config): solve for this world size.
+            # Elastic mode OWNS the batch config; explicit batch params
+            # alongside it are a config error (ref: elasticity.py
+            # ensure_immutable_elastic_config raises ElasticityConfigError).
+            fixed = [k for k, v in (
+                (TRAIN_BATCH_SIZE, self.train_batch_size),
+                (MICRO_BATCH, self.train_micro_batch_size_per_gpu),
+                (GRAD_ACCUM, self.gradient_accumulation_steps)) if v is not None]
+            if fixed:
+                raise ValueError(
+                    f"elasticity is enabled but {fixed} set explicitly; "
+                    "elastic mode computes the batch config itself")
             from deepspeed_tpu.elasticity import compute_elastic_config
 
             run = compute_elastic_config(self.elasticity, world_size=dp_world)

@@ -130,12 +130,14 @@ def ssh_command(host: str, coordinator: str, num_nodes: int, node_rank: int,
     """argv for launching one remote rank over ssh (ref: runner.py's pdsh
     command construction).  Bring-up env is passed inline with ``env`` so
     no remote shell config is required."""
-    inner = " ".join(
-        ["env",
-         f"COORDINATOR_ADDRESS={coordinator}",
-         f"NUM_PROCESSES={num_nodes}", f"WORLD_SIZE={num_nodes}",
-         f"PROCESS_ID={node_rank}", f"RANK={node_rank}",
-         "python", script] + list(script_args))
+    import shlex
+
+    inner = " ".join(shlex.quote(tok) for tok in
+                     ["env",
+                      f"COORDINATOR_ADDRESS={coordinator}",
+                      f"NUM_PROCESSES={num_nodes}", f"WORLD_SIZE={num_nodes}",
+                      f"PROCESS_ID={node_rank}", f"RANK={node_rank}",
+                      "python", script] + list(script_args))
     return ["ssh", "-o", "StrictHostKeyChecking=no", host, inner]
 
 

@@ -10,3 +10,5 @@ here.  Optimizers live in :mod:`deepspeed_tpu.ops.optim`; attention in
 from deepspeed_tpu.ops.optim import (
     Optimizer, adam, adamw, lamb, lion, adagrad, sgd, from_config,
 )
+from deepspeed_tpu.ops import quant
+from deepspeed_tpu.ops.onebit import onebit_adam, onebit_lamb

@@ -1,0 +1,153 @@
+"""1-bit optimizers (ref: deepspeed/runtime/fp16/onebit/{adam,lamb}.py).
+
+The reference's 1-bit Adam cuts data-parallel comm ~32x: after a
+full-precision warmup it freezes the Adam variance and communicates only
+``sign(momentum)`` plus a scale, with per-worker error feedback keeping
+the compression unbiased over time.
+
+TPU-native shape: compression lives INSIDE the SPMD program.
+:func:`onebit_allreduce` runs under ``shard_map`` — each chip all-gathers
+int8 signs + f32 group scales over the dp axis (1/4 the f32 bytes on
+ICI) and averages locally.  The optimizers follow the reference's
+algorithm: local momentum update → compressed momentum allreduce → param
+update from the averaged compressed momentum; variance frozen after
+warmup.  They expect LOCAL (unreduced) grads, i.e. a custom loop or an
+engine configured not to pre-reduce — matching the reference, where the
+optimizer owns communication.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optim import Optimizer, ScalarOrSchedule, _lr_at
+
+
+def _groups_for(size: int, num_groups: int) -> int:
+    """Per-leaf group count: fall back to 1 when the leaf doesn't divide."""
+    return num_groups if num_groups > 0 and size % num_groups == 0 else 1
+
+
+def _compress(v: jnp.ndarray, num_groups: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """sign + per-group L1 scale (ref: onebit adam's compression basis)."""
+    g = v.reshape(_groups_for(v.size, num_groups), -1)
+    scale = jnp.mean(jnp.abs(g), axis=1)
+    signs = jnp.where(g >= 0, 1, -1).astype(jnp.int8)
+    return signs, scale
+
+
+def _decompress(signs: jnp.ndarray, scale: jnp.ndarray,
+                shape) -> jnp.ndarray:
+    return (signs.astype(jnp.float32) * scale[:, None]).reshape(shape)
+
+
+def onebit_allreduce(x: jnp.ndarray, err: jnp.ndarray, axis_name: str,
+                     num_groups: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Error-feedback sign-compressed mean over ``axis_name``.
+
+    Returns (averaged tensor, new error).  Must run under ``shard_map``.
+    """
+    v = x + err
+    signs, scale = _compress(v, num_groups)
+    new_err = v - _decompress(signs, scale, v.shape)
+    sg = jax.lax.all_gather(signs, axis_name)      # int8 on the wire
+    sc = jax.lax.all_gather(scale, axis_name)
+    avg = jnp.mean(jax.vmap(lambda s, c: _decompress(s, c, v.shape))(sg, sc),
+                   axis=0)
+    return avg, new_err
+
+
+class OneBitState(NamedTuple):
+    step: jnp.ndarray
+    mu: Any            # local momentum
+    nu: Any            # variance (frozen after warmup)
+    err: Any           # per-worker compression error
+
+
+def onebit_adam(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999),
+                eps: float = 1e-8, weight_decay: float = 0.0,
+                freeze_step: int = 100, axis_name: Optional[str] = "data",
+                num_groups: int = 1) -> Optimizer:
+    """ref: onebit/adam.py OnebitAdam (``freeze_step`` = warmup length)."""
+    b1, b2 = betas
+
+    def init(params):
+        z = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return OneBitState(jnp.zeros([], jnp.int32),
+                           jax.tree.map(z, params), jax.tree.map(z, params),
+                           jax.tree.map(z, params))
+
+    def update(grads, state, params):
+        step = state.step + 1
+        in_warmup = step <= freeze_step
+
+        def leaf(g, m, v, e, p):
+            g = g.astype(jnp.float32)
+
+            # lax.cond so exactly ONE comm pattern runs per step: warmup
+            # pays the full-precision pmean, steady state pays only the
+            # int8 signs + scales — the whole point of the algorithm.
+            def warm(_):
+                g_exact = jax.lax.pmean(g, axis_name) \
+                    if axis_name is not None else g
+                return (b1 * m + (1 - b1) * g_exact,
+                        b2 * v + (1 - b2) * jnp.square(g_exact), e)
+
+            def steady(_):
+                m_local = b1 * m + (1 - b1) * g
+                if axis_name is not None:
+                    m_comp, e_new = onebit_allreduce(m_local, e, axis_name,
+                                                     num_groups)
+                else:
+                    m_comp, e_new = m_local, e
+                return m_comp, v, e_new   # variance frozen post-warmup
+
+            m_new, v_new, e_new = jax.lax.cond(in_warmup, warm, steady, None)
+            upd = m_new / (jnp.sqrt(v_new) + eps)
+            if weight_decay:
+                upd = upd + weight_decay * p.astype(jnp.float32)
+            return -_lr_at(lr, step) * upd, m_new, v_new, e_new
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        flat_e = treedef.flatten_up_to(state.err)
+        flat_p = treedef.flatten_up_to(params)
+        outs = [leaf(*args) for args in zip(flat_g, flat_m, flat_v, flat_e,
+                                            flat_p)]
+        unflat = lambda i: jax.tree.unflatten(treedef, [o[i] for o in outs])
+        return unflat(0), OneBitState(step, unflat(1), unflat(2), unflat(3))
+
+    return Optimizer(init=init, update=update, name="onebit_adam")
+
+
+def onebit_lamb(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999),
+                eps: float = 1e-6, weight_decay: float = 0.0,
+                freeze_step: int = 100, axis_name: Optional[str] = "data",
+                num_groups: int = 1,
+                min_trust: float = 0.01, max_trust: float = 10.0) -> Optimizer:
+    """ref: onebit/lamb.py OnebitLamb — 1-bit momentum comm + layerwise
+    trust ratio applied to the decompressed update."""
+    base = onebit_adam(1.0, betas, eps, 0.0, freeze_step, axis_name,
+                       num_groups)
+
+    def update(grads, state, params):
+        raw_upd, new_state = base.update(grads, state, params)
+
+        def leaf(u, p):
+            p32 = p.astype(jnp.float32)
+            upd = -u  # base returns -1.0 * adam_direction (lr was 1.0)
+            if weight_decay:
+                upd = upd + weight_decay * p32
+            wn = jnp.linalg.norm(p32)
+            un = jnp.linalg.norm(upd)
+            trust = jnp.where((wn > 0) & (un > 0),
+                              jnp.clip(wn / un, min_trust, max_trust), 1.0)
+            return -_lr_at(lr, new_state.step) * trust * upd
+
+        return jax.tree.map(leaf, raw_upd, params), new_state
+
+    return Optimizer(init=base.init, update=update, name="onebit_lamb")

@@ -255,10 +255,20 @@ _REGISTRY = {
 }
 
 
+def _register_onebit():
+    from deepspeed_tpu.ops import onebit
+
+    _REGISTRY["onebitadam"] = onebit.onebit_adam
+    _REGISTRY["onebitlamb"] = onebit.onebit_lamb
+    _REGISTRY["zerooneadam"] = onebit.onebit_adam  # 0/1 Adam maps to the same comm scheme
+
+
 def from_config(name: str, params: dict) -> Optimizer:
     """Build from the config ``optimizer`` block (ref:
     deepspeed/runtime/engine.py _configure_basic_optimizer)."""
     name = name.lower()
+    if name.startswith("onebit") or name.startswith("zeroone"):
+        _register_onebit()   # deferred: onebit imports this module
     if name not in _REGISTRY:
         raise ValueError(f"unknown optimizer {name!r}; known: {sorted(_REGISTRY)}")
     kw = dict(params)

@@ -1,0 +1,166 @@
+"""Quantization kernels + quantized collectives (ref: deepspeed/ops/quantizer,
+csrc/quantization, and ZeRO++ qgZ in deepspeed/runtime/zero).
+
+Group-wise symmetric/asymmetric int quantization with the same semantics
+as the reference's CUDA quantizer (per-group scale from max-abs /
+min-max), plus fp8 casts and the communication-compression primitives
+ZeRO++ uses: quantized all-gather (weights) and a quantized
+all-to-all-based reduce-scatter (gradients).  Inside ``shard_map`` the
+int8 payloads ride the ICI collectives at 1/4 the bytes of f32; scales
+travel alongside.
+
+A Pallas group-quantize kernel covers the HBM-bound big-tensor case; the
+jnp path is the reference semantics and the CPU/interpret fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+INT_BOUNDS = {8: 127.0, 4: 7.0, 2: 1.0, 1: 1.0}
+
+
+def _group(x: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    n = x.size
+    if n % num_groups:
+        raise ValueError(f"size {n} not divisible into {num_groups} groups")
+    return x.reshape(num_groups, n // num_groups)
+
+
+def quantize(x: jnp.ndarray, bits: int = 8, num_groups: int = 1,
+             symmetric: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                              Optional[jnp.ndarray]]:
+    """Group-wise quantize → (q int8, scale f32, zero-point or None).
+
+    Symmetric: q = round(x / scale), scale = amax/(2^(b-1)-1)
+    Asymmetric: q = round((x - min)/scale) - 2^(b-1) (ref: quantizer's
+    ``QuantizationType``).
+    """
+    shape = x.shape
+    g = _group(x.astype(jnp.float32), num_groups)
+    bound = INT_BOUNDS[bits]
+    if symmetric:
+        scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) / bound
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.clip(jnp.round(g / scale), -bound, bound).astype(jnp.int8)
+        return q.reshape(shape), scale[:, 0], None
+    lo = jnp.min(g, axis=1, keepdims=True)
+    hi = jnp.max(g, axis=1, keepdims=True)
+    scale = (hi - lo) / (2.0 * bound)
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round((g - lo) / scale) - bound, -bound, bound)
+    return q.astype(jnp.int8).reshape(shape), scale[:, 0], lo[:, 0]
+
+
+def dequantize(q: jnp.ndarray, scale: jnp.ndarray,
+               zero: Optional[jnp.ndarray] = None, bits: int = 8,
+               dtype=jnp.float32) -> jnp.ndarray:
+    shape = q.shape
+    g = _group(q.astype(jnp.float32), scale.shape[0])
+    if zero is None:
+        out = g * scale[:, None]
+    else:
+        out = (g + INT_BOUNDS[bits]) * scale[:, None] + zero[:, None]
+    return out.reshape(shape).astype(dtype)
+
+
+# ------------------------------------------------------------------- fp8
+def to_fp8(x: jnp.ndarray, kind: str = "e4m3") -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scaled fp8 cast: returns (fp8 tensor, per-tensor scale)."""
+    dt = jnp.float8_e4m3fn if kind == "e4m3" else jnp.float8_e5m2
+    fmax = 448.0 if kind == "e4m3" else 57344.0
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    scale = jnp.where(amax == 0, 1.0, amax / fmax)
+    return (x.astype(jnp.float32) / scale).astype(dt), scale
+
+
+def from_fp8(x: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    return x.astype(jnp.float32).astype(dtype) * scale
+
+
+# ---------------------------------------------------------- pallas kernel
+_ROWS = 8  # groups per grid step (TPU sublane alignment)
+
+
+def _quant_kernel(x_ref, q_ref, s_ref):
+    """One grid step = 8 quantization groups (rows), VMEM-resident."""
+    x = x_ref[...].astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.where(amax == 0, 1.0, amax / 127.0)
+    q_ref[...] = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    s_ref[...] = scale
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
+def quantize_pallas(x: jnp.ndarray, num_groups: int = 1,
+                    interpret: bool = False):
+    """int8 group quantize as a single-pass Pallas kernel (symmetric).
+
+    Grid = groups/8; each step reads its 8 groups once from HBM, writes
+    int8 + scales — the memory-bound pattern the reference's CUDA
+    quantizer uses.  Shapes off the TPU tile grid (groups % 8, group size
+    % 128) fall back to the jnp path, which XLA fuses comparably.
+    """
+    g = _group(x, num_groups)
+    gsz = g.shape[1]
+    if num_groups % _ROWS or gsz % 128:
+        q, s, _ = quantize(x, bits=8, num_groups=num_groups)
+        return q, s
+    q, s = pl.pallas_call(
+        _quant_kernel,
+        grid=(num_groups // _ROWS,),
+        in_specs=[pl.BlockSpec((_ROWS, gsz), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((_ROWS, gsz), lambda i: (i, 0)),
+                   pl.BlockSpec((_ROWS, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((num_groups, gsz), jnp.int8),
+                   jax.ShapeDtypeStruct((num_groups, 1), jnp.float32)],
+        interpret=interpret,
+    )(g)
+    return q.reshape(x.shape), s[:, 0]
+
+
+# ------------------------------------------------- quantized collectives
+def quantized_all_gather(x: jnp.ndarray, axis_name: str, bits: int = 8,
+                         num_groups: int = 1) -> jnp.ndarray:
+    """ZeRO++ qwZ: all-gather int8(+scales) instead of f32 params.
+
+    Call inside ``shard_map``; returns the gathered, dequantized array
+    stacked on a leading axis-size dim.
+    """
+    q, s, _ = quantize(x, bits=bits, num_groups=num_groups)
+    qg = jax.lax.all_gather(q, axis_name)
+    sg = jax.lax.all_gather(s, axis_name)
+    return jax.vmap(lambda qq, ss: dequantize(qq, ss, bits=bits))(qg, sg)
+
+
+def quantized_reduce_scatter(x: jnp.ndarray, axis_name: str, bits: int = 8,
+                             groups_per_shard: int = 1) -> jnp.ndarray:
+    """ZeRO++ qgZ gradient reduce-scatter.
+
+    The reference's qgZ replaces ring reduce-scatter (which would
+    quantize/dequantize at every hop) with ONE quantized all-to-all +
+    local reduction: each chip quantizes the shard destined for every
+    peer, all-to-alls the int8 payload, then dequantizes and sums its own
+    shard.  Identical structure here on the ICI mesh.  ``x``: [world *
+    shard, ...] per-chip partial gradient; returns this chip's reduced
+    [shard, ...] (mean over the axis).
+    """
+    world = jax.lax.axis_size(axis_name)
+    shard = x.shape[0] // world
+    parts = x.reshape((world, shard) + x.shape[1:])
+    flat = parts.reshape(world, -1)
+    qs = [quantize(flat[i], bits=bits, num_groups=groups_per_shard)
+          for i in range(world)]
+    q = jnp.stack([p[0] for p in qs])              # [world, n] int8
+    s = jnp.stack([p[1] for p in qs])              # [world, groups] f32
+    q = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                           tiled=False)
+    s = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                           tiled=False)
+    deq = jax.vmap(lambda qq, ss: dequantize(qq, ss, bits=bits))(q, s)
+    return jnp.mean(deq, axis=0).reshape((shard,) + x.shape[1:])

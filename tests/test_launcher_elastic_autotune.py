@@ -52,7 +52,8 @@ def test_launcher_runs_script(tmp_path):
         [sys.executable, "-m", "deepspeed_tpu.launcher",
          "--coordinator", "127.0.0.1:1", "--nnodes", "1", "--node_rank", "0",
          str(script)],
-        capture_output=True, text=True, cwd="/root/repo",
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120)
     assert out.returncode == 0, out.stderr
     assert "RANK=0" in out.stdout
@@ -107,6 +108,21 @@ def test_elasticity_applied_in_config_resolution():
                        "max_gpus": 16}})
     with pytest.raises(ValueError):
         bad.resolve_batch_sizes(7 * ws + 1)
+    # explicit batch params + elasticity = config error (ref behavior)
+    conflicted = Config.from_dict({
+        "train_batch_size": 32,
+        "elasticity": {"enabled": True, "max_train_batch_size": 64,
+                       "micro_batch_sizes": [2, 4]}})
+    with pytest.raises(ValueError, match="elastic"):
+        conflicted.resolve_batch_sizes(ws)
+
+
+def test_ssh_command_quotes_args():
+    from deepspeed_tpu.launcher import ssh_command
+
+    argv = ssh_command("h", "c:1", 2, 0, "my train.py", ["--tag", "a b; rm"])
+    inner = argv[-1]
+    assert "'my train.py'" in inner and "'a b; rm'" in inner
 
 
 def test_ssh_command_and_hostfile_spawn_path():
