@@ -179,6 +179,11 @@ class Config:
     pipeline: PipelineConfig = dataclasses.field(default_factory=PipelineConfig)
     moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
     elasticity: Optional[Any] = None  # ElasticityConfig when enabled
+    curriculum: Optional[Any] = None  # CurriculumConfig when enabled
+    random_ltd: Optional[Any] = None  # RandomLTDConfig when enabled
+    progressive_layer_drop: Optional[Dict[str, Any]] = None
+    eigenvalue: Optional[Dict[str, Any]] = None
+    sparse_attention: Optional[Dict[str, Any]] = None
     raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     # ---------------------------------------------------------------- parse
@@ -243,6 +248,28 @@ class Config:
             from deepspeed_tpu.elasticity import ElasticityConfig
 
             c.elasticity = ElasticityConfig.from_dict(d["elasticity"])
+        # Data-efficiency blocks: accept both the reference's legacy
+        # top-level "curriculum_learning" key and the nested
+        # "data_efficiency" schema (ref: deepspeed/runtime/data_pipeline/
+        # config.py get_data_efficiency_config).
+        de = d.get("data_efficiency", {})
+        cl = (de.get("data_sampling", {}).get("curriculum_learning")
+              or d.get("curriculum_learning"))
+        if cl and cl.get("enabled"):
+            from deepspeed_tpu.data.curriculum import CurriculumConfig
+
+            c.curriculum = CurriculumConfig.from_dict(cl)
+        rltd = de.get("data_routing", {}).get("random_ltd") or d.get("random_ltd")
+        if rltd and rltd.get("enabled"):
+            from deepspeed_tpu.random_ltd import RandomLTDConfig
+
+            c.random_ltd = RandomLTDConfig.from_dict(rltd)
+        if d.get("progressive_layer_drop", {}).get("enabled"):
+            c.progressive_layer_drop = dict(d["progressive_layer_drop"])
+        if d.get("eigenvalue", {}).get("enabled"):
+            c.eigenvalue = dict(d["eigenvalue"])
+        if d.get("sparse_attention"):
+            c.sparse_attention = dict(d["sparse_attention"])
         return c
 
     @classmethod
@@ -261,6 +288,13 @@ class Config:
             # Elastic mode OWNS the batch config; explicit batch params
             # alongside it are a config error (ref: elasticity.py
             # ensure_immutable_elastic_config raises ElasticityConfigError).
+            # Values written by a previous elastic resolution don't count
+            # as "explicit" — re-resolving (e.g. a second engine on the
+            # same Config) just recomputes for the new world size.
+            if getattr(self, "_batch_from_elastic", False):
+                self.train_batch_size = None
+                self.train_micro_batch_size_per_gpu = None
+                self.gradient_accumulation_steps = None
             fixed = [k for k, v in (
                 (TRAIN_BATCH_SIZE, self.train_batch_size),
                 (MICRO_BATCH, self.train_micro_batch_size_per_gpu),
@@ -276,6 +310,7 @@ class Config:
             self.train_micro_batch_size_per_gpu = \
                 run["train_micro_batch_size_per_gpu"]
             self.gradient_accumulation_steps = run["gradient_accumulation_steps"]
+            self._batch_from_elastic = True
             return
         t, m, a = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
                    self.gradient_accumulation_steps)

@@ -1,0 +1,126 @@
+"""Curriculum learning (ref: deepspeed/runtime/data_pipeline/curriculum_scheduler.py
++ deepspeed/runtime/data_pipeline/config.py).
+
+The reference schedules a "difficulty" (canonically sequence length) from
+``min_difficulty`` to ``max_difficulty`` with fixed_linear / fixed_root /
+fixed_discrete / custom schedules; the training loop truncates or re-packs
+each batch to the current difficulty.
+
+TPU-native notes: seqlen is a static shape, so each distinct difficulty is
+one XLA compile.  ``difficulty_step`` (the reference's quantization knob,
+default 8 there for sentence packing) doubles here as the recompile
+limiter — difficulties only move in multiples of it, so a full curriculum
+costs (max-min)/step compiles, each cached.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class CurriculumConfig:
+    """ref: data_pipeline/config.py curriculum_learning block keys."""
+
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"   # fixed_linear|fixed_root|fixed_discrete
+    # schedule_config sub-keys (flattened, same names as reference):
+    total_curriculum_step: int = 1000
+    difficulty_step: int = 8
+    root_degree: int = 2
+    difficulty: Tuple[int, ...] = ()       # fixed_discrete: difficulty list
+    max_step: Tuple[int, ...] = ()         # fixed_discrete: step boundaries
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CurriculumConfig":
+        flat = dict(d)
+        sched = flat.pop("schedule_config", {})
+        flat.update(sched)
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in flat.items() if k in known}
+        for tup in ("difficulty", "max_step"):
+            if tup in kw:
+                kw[tup] = tuple(kw[tup])
+        return cls(**kw)
+
+
+class CurriculumScheduler:
+    """ref: curriculum_scheduler.py CurriculumScheduler — maps global step
+    → difficulty."""
+
+    def __init__(self, cfg: CurriculumConfig):
+        self.cfg = cfg
+        if cfg.schedule_type == "fixed_discrete":
+            if not cfg.difficulty or len(cfg.max_step) != len(cfg.difficulty) - 1:
+                raise ValueError(
+                    "fixed_discrete needs difficulty list and max_step with "
+                    "len(difficulty)-1 boundaries")
+        elif cfg.schedule_type not in ("fixed_linear", "fixed_root"):
+            raise ValueError(f"unknown schedule_type {cfg.schedule_type}")
+
+    def _quantize(self, diff: float) -> int:
+        c = self.cfg
+        q = max(1, c.difficulty_step)
+        d = int(diff // q) * q
+        return int(min(max(d, c.min_difficulty), c.max_difficulty))
+
+    def get_difficulty(self, global_step: int) -> int:
+        c = self.cfg
+        if not c.enabled:
+            return c.max_difficulty
+        if c.schedule_type == "fixed_discrete":
+            for bound, diff in zip(c.max_step, c.difficulty):
+                if global_step <= bound:
+                    return int(diff)
+            return int(c.difficulty[-1])
+        frac = min(1.0, global_step / max(1, c.total_curriculum_step))
+        if c.schedule_type == "fixed_root":
+            frac = frac ** (1.0 / c.root_degree)
+        diff = c.min_difficulty + (c.max_difficulty - c.min_difficulty) * frac
+        return self._quantize(diff)
+
+
+def truncate_to_difficulty(batch: Dict[str, jnp.ndarray] | jnp.ndarray,
+                           seqlen: int,
+                           seq_keys: Sequence[str] = ("input_ids", "labels",
+                                                      "attention_mask",
+                                                      "position_ids")):
+    """Truncate the sequence axis (axis 1) to ``seqlen`` — the reference's
+    batch post-processing for seqlen curriculum (megatron utils
+    curriculum truncation)."""
+    if isinstance(batch, dict):
+        return {k: (v[:, :seqlen] if k in seq_keys and v.ndim >= 2 else v)
+                for k, v in batch.items()}
+    return batch[:, :seqlen]
+
+
+# ------------------------------------------------- difficulty-ordered sampling
+class DifficultyIndexer:
+    """Data-analysis half of curriculum (ref: data_pipeline/data_sampling/
+    data_analyzer.py, simplified): pre-computes a difficulty value per
+    sample and serves index batches restricted to the current difficulty
+    ceiling."""
+
+    def __init__(self, difficulties: Sequence[float], seed: int = 0):
+        self.diff = np.asarray(difficulties, np.float64)
+        self.order = np.argsort(self.diff, kind="stable")
+        self.sorted_diff = self.diff[self.order]
+        self.rng = np.random.RandomState(seed)
+
+    def eligible(self, max_difficulty: float) -> np.ndarray:
+        hi = np.searchsorted(self.sorted_diff, max_difficulty, side="right")
+        return self.order[:hi]
+
+    def sample(self, batch_size: int, max_difficulty: float) -> np.ndarray:
+        pool = self.eligible(max_difficulty)
+        if len(pool) == 0:
+            pool = self.order[:1]
+        return self.rng.choice(pool, size=batch_size,
+                               replace=len(pool) < batch_size)

@@ -29,6 +29,7 @@ class DataLoader:
         self.collate_fn = collate_fn or _default_collate
         self.prefetch = prefetch
         self.epoch = 0
+        self._idx_svc = None  # lazy native shuffle service (csrc/hostruntime)
 
     def __len__(self) -> int:
         n = len(self.dataset)
@@ -38,11 +39,16 @@ class DataLoader:
         self.epoch = epoch
 
     def _indices(self) -> np.ndarray:
-        idx = np.arange(len(self.dataset))
-        if self.shuffle:
-            rng = np.random.default_rng(self.seed + self.epoch)
-            rng.shuffle(idx)
-        return idx
+        if not self.shuffle:
+            return np.arange(len(self.dataset))
+        # Epoch shuffle via the C++ index service (csrc/hostruntime.cpp),
+        # off the GIL; falls back to numpy inside the service.
+        if self._idx_svc is None or self._idx_svc.n != len(self.dataset):
+            from deepspeed_tpu.io.native import ShuffleIndexService
+
+            self._idx_svc = ShuffleIndexService(
+                len(self.dataset), seed=self.seed, shuffle=True)
+        return self._idx_svc.epoch_order(self.epoch)
 
     def __iter__(self) -> Iterator[Any]:
         idx = self._indices()

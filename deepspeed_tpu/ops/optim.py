@@ -269,6 +269,13 @@ def from_config(name: str, params: dict) -> Optimizer:
     name = name.lower()
     if name.startswith("onebit") or name.startswith("zeroone"):
         _register_onebit()   # deferred: onebit imports this module
+        # The engine steps under plain jax.jit (GSPMD shardings, no named
+        # axes) and already mean-reduces grads across dp, so the bound
+        # axis_name="data" default would (a) hit an unbound-axis error at
+        # trace time and (b) double-average.  Explicit axis_name is for
+        # shard_map users driving onebit_allreduce themselves.
+        params = dict(params)
+        params.setdefault("axis_name", None)
     if name not in _REGISTRY:
         raise ValueError(f"unknown optimizer {name!r}; known: {sorted(_REGISTRY)}")
     kw = dict(params)

@@ -1,0 +1,91 @@
+"""Random layerwise token dropping (ref: deepspeed/runtime/data_pipeline/
+data_routing/basic_layer.py RandomLayerTokenDrop +
+deepspeed/runtime/data_pipeline/data_routing/scheduler.py BaseScheduler).
+
+The reference wraps each middle transformer layer: per step it samples a
+random subset of tokens, runs the layer only on that subset, and passes
+dropped tokens through unchanged; a scheduler grows the kept-token count
+from ``random_ltd_layer_token_drop`` start to full seq_len over training.
+
+TPU design: the kept count is a *static* Python int per compile (like
+curriculum seqlen — recompile on change, which the scheduler quantizes to
+keep rare).  Selection = random permutation → take first k (sorted, so
+causal attention order is preserved) → gather → layer → scatter-add back.
+All static shapes; gather/scatter lower to dynamic-slice-free one-hot-free
+`take`/`scatter` ops XLA handles natively.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token_indices(rng: jax.Array, seq_len: int, keep: int,
+                         batch: int) -> jnp.ndarray:
+    """[B, keep] sorted random token indices (sorted keeps causal order,
+    matching the reference's gpt-style sorted sampling in
+    data_routing/utils.py)."""
+    def one(r):
+        return jnp.sort(jax.random.permutation(r, seq_len)[:keep])
+    return jax.vmap(one)(jax.random.split(rng, batch))
+
+
+def random_ltd_layer(layer_fn: Callable[..., jnp.ndarray], x: jnp.ndarray,
+                     rng: jax.Array, keep: int, *args: Any,
+                     **kwargs: Any) -> jnp.ndarray:
+    """Apply ``layer_fn`` to a random ``keep``-token subset of x [B,S,D];
+    dropped tokens ride through unchanged (ref: basic_layer.py forward)."""
+    B, S, _ = x.shape
+    if keep >= S:
+        return layer_fn(x, *args, **kwargs)
+    idx = sample_token_indices(rng, S, keep, B)            # [B, keep]
+    sub = jnp.take_along_axis(x, idx[:, :, None], axis=1)  # [B, keep, D]
+    out = layer_fn(sub, *args, **kwargs)
+    upd = jnp.zeros_like(x)
+    upd = jax.vmap(lambda u, o, i: u.at[i].set(o))(upd, out, idx)
+    mask = jnp.zeros((B, S, 1), bool)
+    mask = jax.vmap(lambda m, i: m.at[i].set(True))(mask, idx)
+    return jnp.where(mask, upd, x)
+
+
+@dataclasses.dataclass
+class RandomLTDConfig:
+    """ref: data_routing config block (random_ltd in the JSON schema)."""
+
+    enabled: bool = False
+    total_layer_num: int = 0
+    random_ltd_layer_num: int = 0          # how many middle layers wrapped
+    random_ltd_layer_id: tuple = ()        # which layers; default: middle
+    start_ratio: float = 0.5               # initial kept fraction
+    schedule_type: str = "fixed_linear"
+    total_schedule_steps: int = 1000
+    step_quantum: int = 16                 # round kept count (recompile rate)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RandomLTDConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (ref: data_routing/scheduler.py
+    RandomLTDScheduler — fixed_linear ramp from start to full)."""
+
+    def __init__(self, cfg: RandomLTDConfig, seq_len: int):
+        self.cfg = cfg
+        self.seq_len = seq_len
+        self.start = max(1, int(round(seq_len * cfg.start_ratio)))
+
+    def keep_at(self, step: int) -> int:
+        c = self.cfg
+        if not c.enabled or step >= c.total_schedule_steps:
+            return self.seq_len
+        frac = step / max(1, c.total_schedule_steps)
+        k = self.start + (self.seq_len - self.start) * frac
+        q = max(1, c.step_quantum)
+        k = int(k // q) * q
+        return int(min(max(k, 1), self.seq_len))

@@ -1,0 +1,119 @@
+"""Curvature probe + progressive layer drop (ref:
+deepspeed/runtime/eigenvalue.py, deepspeed/runtime/progressive_layer_drop.py).
+
+Eigenvalue: the reference runs power iteration on the loss Hessian
+(per-block) to drive compression/quantization decisions.  TPU-native:
+Hessian-vector products via ``jax.jvp`` over ``jax.grad`` — exact HVPs,
+no double-backprop graph surgery — and the whole iteration is one jitted
+``lax``-free Python loop of jitted HVPs (few iterations, host-controlled
+convergence like the reference's while loop).
+
+Progressive layer drop (PLD): theta(t) = (1-theta_bar)·exp(-gamma·t) +
+theta_bar gives a global keep probability; layer i of L keeps with
+p_i = 1 - (1-theta)·(i+1)/L (deeper layers drop more), matching the
+reference's get_theta/get_state schedule.  Inside jit the per-layer
+keep decisions are a Bernoulli vector consumed by the model's scan.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------- eigenvalue
+def hvp(loss_fn: Callable[[Any], jnp.ndarray], params: Any, vec: Any) -> Any:
+    """Hessian-vector product ∇²L(params) · vec via forward-over-reverse."""
+    return jax.jvp(jax.grad(loss_fn), (params,), (vec,))[1]
+
+
+class Eigenvalue:
+    """Power-iteration top-eigenvalue estimate of the loss Hessian
+    (ref: deepspeed/runtime/eigenvalue.py Eigenvalue.compute_eigenvalue)."""
+
+    def __init__(self, max_iter: int = 100, tol: float = 1e-2,
+                 stability: float = 1e-6, seed: int = 0):
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.seed = seed
+        self._jit_hvp = None
+
+    def _normalize(self, v):
+        sq = sum(jnp.vdot(x, x) for x in jax.tree.leaves(v))
+        nrm = jnp.sqrt(sq) + self.stability
+        return jax.tree.map(lambda x: (x / nrm).astype(x.dtype), v), jnp.sqrt(sq)
+
+    def compute(self, loss_fn: Callable[[Any], jnp.ndarray],
+                params: Any) -> float:
+        """Dominant |eigenvalue| of ∇²loss at params."""
+        if self._jit_hvp is None:
+            self._jit_hvp = jax.jit(lambda p, v: hvp(loss_fn, p, v))
+        key = jax.random.PRNGKey(self.seed)
+        leaves, treedef = jax.tree.flatten(params)
+        keys = jax.random.split(key, len(leaves))
+        v = treedef.unflatten([
+            jax.random.normal(k, x.shape, jnp.float32).astype(x.dtype)
+            for k, x in zip(keys, leaves)])
+        v, _ = self._normalize(v)
+        prev = 0.0
+        for _ in range(self.max_iter):
+            hv = self._jit_hvp(params, v)
+            v, lam = self._normalize(hv)
+            lam = float(lam)
+            if abs(lam - prev) / (abs(lam) + self.stability) < self.tol:
+                break
+            prev = lam
+        return lam
+
+
+# ------------------------------------------------------ progressive layer drop
+class ProgressiveLayerDrop:
+    """ref: deepspeed/runtime/progressive_layer_drop.py — theta schedule
+    theta(t) = (1 - theta_bar)·exp(-gamma·t) + theta_bar, consumed by the
+    model as per-layer keep probabilities."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta_bar = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        t = float(global_step)
+        self.current_theta = \
+            (1.0 - self.theta_bar) * np.exp(-self.gamma * t) + self.theta_bar
+        return self.current_theta
+
+    def state_dict(self):
+        return {"current_theta": self.current_theta}
+
+    def load_state_dict(self, sd):
+        self.current_theta = sd["current_theta"]
+
+    def layer_keep_probs(self, num_layers: int,
+                         theta: float | None = None) -> jnp.ndarray:
+        """[L] keep probability per layer: p_i = 1 - (1-θ)·(i+1)/L —
+        deeper layers drop more, as in the PLD paper / reference."""
+        th = self.current_theta if theta is None else theta
+        i = jnp.arange(1, num_layers + 1, dtype=jnp.float32)
+        return 1.0 - (1.0 - th) * i / num_layers
+
+
+def apply_layer_drop(layer_fn: Callable[[jnp.ndarray], jnp.ndarray],
+                     x: jnp.ndarray, keep_prob: jnp.ndarray,
+                     rng: jax.Array, deterministic: bool = False
+                     ) -> jnp.ndarray:
+    """Stochastically skip a residual layer (identity when dropped), with
+    1/p output scaling when kept — PLD's expected-depth-preserving rule."""
+    if deterministic:
+        return layer_fn(x)
+    keep = jax.random.bernoulli(rng, keep_prob)
+    out = jax.lax.cond(keep, lambda a: layer_fn(a) / keep_prob,
+                       lambda a: a, x)
+    return out

@@ -108,6 +108,11 @@ def test_elasticity_applied_in_config_resolution():
                        "max_gpus": 16}})
     with pytest.raises(ValueError):
         bad.resolve_batch_sizes(7 * ws + 1)
+    # re-resolution is idempotent (a second engine on the same Config must
+    # not mistake elastic-written batch sizes for explicit ones)
+    cfg.resolve_batch_sizes(ws)
+    assert (cfg.train_micro_batch_size_per_gpu
+            * cfg.gradient_accumulation_steps * ws == cfg.train_batch_size)
     # explicit batch params + elasticity = config error (ref behavior)
     conflicted = Config.from_dict({
         "train_batch_size": 32,

@@ -227,6 +227,29 @@ def test_onebit_from_config_and_ragged_leaves():
     assert upd["b"].shape == (5,)
 
 
+def test_onebit_engine_config_defaults_unbound_axis():
+    # The engine steps under plain jax.jit: from_config must default
+    # axis_name=None so tracing doesn't hit an unbound "data" axis.
+    import numpy as np
+    import deepspeed_tpu as dstpu
+
+    params = {"w": jnp.ones((4, 2)) * 0.1}
+    def loss_fn(p, batch):
+        return jnp.mean((batch["x"] @ p["w"] - batch["y"]) ** 2)
+    engine, _, _, _ = dstpu.initialize(
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "OnebitAdam",
+                              "params": {"lr": 0.01, "freeze_step": 2}},
+                "bf16": {"enabled": False}},
+        params=params, loss_fn=loss_fn)
+    batch = {"x": np.ones((8, 4), np.float32),
+             "y": np.zeros((8, 2), np.float32)}
+    l0 = float(engine.train_batch(batch))
+    for _ in range(4):
+        l1 = float(engine.train_batch(batch))
+    assert l1 < l0
+
+
 def test_onebit_lamb_converges_single():
     rng = np.random.RandomState(10)
     W = rng.randn(8, 2).astype(np.float32)

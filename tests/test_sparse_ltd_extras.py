@@ -1,0 +1,225 @@
+"""Tests: sparse attention, random-LTD, curriculum, eigenvalue, PLD."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig,
+    SparseSelfAttention, VariableSparsityConfig, sparse_attention)
+from deepspeed_tpu.random_ltd import (RandomLTDConfig, RandomLTDScheduler,
+                                      random_ltd_layer)
+from deepspeed_tpu.runtime_extras import (Eigenvalue, ProgressiveLayerDrop,
+                                          apply_layer_drop)
+from deepspeed_tpu.data.curriculum import (CurriculumConfig,
+                                           CurriculumScheduler,
+                                           DifficultyIndexer,
+                                           truncate_to_difficulty)
+from deepspeed_tpu.config import Config
+
+
+def _ref_attention(q, k, v, mask):
+    """Dense reference: mask [H?, S, S] bool (True = attend)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _qkv(B=2, H=2, S=64, D=8, seed=0):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, H, S, D)
+    return (jax.random.normal(k1, shape), jax.random.normal(k2, shape),
+            jax.random.normal(k3, shape))
+
+
+class TestSparseAttention:
+    def test_dense_layout_matches_full(self):
+        q, k, v = _qkv()
+        cfg = DenseSparsityConfig(num_heads=2, block=16)
+        out = sparse_attention(q, k, v, cfg.make_layout(64), 16)
+        ref = _ref_attention(q, k, v, jnp.ones((64, 64), bool))
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    @pytest.mark.parametrize("cfg", [
+        FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                            num_global_blocks=1),
+        BigBirdSparsityConfig(num_heads=2, block=16, num_random_blocks=1,
+                              num_sliding_window_blocks=3),
+        BSLongformerSparsityConfig(num_heads=2, block=16,
+                                   num_sliding_window_blocks=3),
+        VariableSparsityConfig(num_heads=2, block=16,
+                               local_window_blocks=(2, 1),
+                               global_block_indices=(0,)),
+    ])
+    def test_matches_masked_dense(self, cfg):
+        q, k, v = _qkv()
+        lay = cfg.make_layout(64)
+        out = sparse_attention(q, k, v, lay, 16)
+        # expand block layout to token mask
+        mask = jnp.asarray(np.kron(lay, np.ones((16, 16), bool)))[None]
+        ref = _ref_attention(q, k, v, mask)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_causal(self):
+        q, k, v = _qkv()
+        cfg = LocalSlidingWindowSparsityConfig(
+            num_heads=2, block=16, num_sliding_window_blocks=2,
+            attention="unidirectional")
+        lay = cfg.make_layout(64)
+        out = sparse_attention(q, k, v, lay, 16, causal=True)
+        blockmask = np.kron(lay, np.ones((16, 16), bool))
+        tok = np.tril(np.ones((64, 64), bool))
+        ref = _ref_attention(q, k, v, jnp.asarray(blockmask & tok)[None])
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_module_and_density(self):
+        cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                                  attention="unidirectional")
+        sa = SparseSelfAttention(cfg)
+        assert sa.causal
+        q, k, v = _qkv()
+        out = sa(q, k, v)
+        assert out.shape == q.shape
+        assert 0 < sa.density(64) < 1.0
+
+    def test_key_padding_mask(self):
+        q, k, v = _qkv(B=1)
+        cfg = DenseSparsityConfig(num_heads=2, block=16)
+        pad = jnp.ones((1, 64)).at[:, 48:].set(0)
+        out = sparse_attention(q, k, v, cfg.make_layout(64), 16,
+                               attn_mask=pad)
+        mask = jnp.broadcast_to(pad[:, None, None, :] > 0, (1, 1, 64, 64))
+        ref = _ref_attention(q, k, v, mask)
+        np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def test_jit_and_grad(self):
+        q, k, v = _qkv()
+        cfg = BigBirdSparsityConfig(num_heads=2, block=16)
+        lay = cfg.make_layout(64)
+        f = jax.jit(lambda a, b, c: sparse_attention(a, b, c, lay, 16).sum())
+        g = jax.grad(f)(q, k, v)
+        assert jnp.isfinite(g).all()
+
+
+class TestRandomLTD:
+    def test_passthrough_when_full(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4))
+        out = random_ltd_layer(lambda h: h * 2, x, jax.random.PRNGKey(1), 16)
+        np.testing.assert_allclose(out, x * 2)
+
+    def test_subset_semantics(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 4))
+        out = random_ltd_layer(lambda h: h + 100.0, x,
+                               jax.random.PRNGKey(1), 8)
+        changed = np.isclose(np.asarray(out - x), 100.0).all(-1).sum(1)
+        np.testing.assert_array_equal(changed, [8, 8])
+        # untouched tokens identical
+        kept = np.isclose(np.asarray(out), np.asarray(x)).all(-1).sum(1)
+        np.testing.assert_array_equal(kept, [8, 8])
+
+    def test_scheduler_monotone(self):
+        cfg = RandomLTDConfig(enabled=True, start_ratio=0.25,
+                              total_schedule_steps=100, step_quantum=4)
+        sch = RandomLTDScheduler(cfg, seq_len=64)
+        ks = [sch.keep_at(s) for s in range(0, 120, 10)]
+        assert ks[0] == 16 and ks[-1] == 64
+        assert all(a <= b for a, b in zip(ks, ks[1:]))
+        assert all(k % 4 == 0 for k in ks)
+
+
+class TestCurriculum:
+    def test_linear_and_root(self):
+        cfg = CurriculumConfig(enabled=True, min_difficulty=8,
+                               max_difficulty=128, total_curriculum_step=100,
+                               difficulty_step=8)
+        sch = CurriculumScheduler(cfg)
+        assert sch.get_difficulty(0) == 8
+        assert sch.get_difficulty(100) == 128
+        assert sch.get_difficulty(1000) == 128
+        mids = [sch.get_difficulty(s) for s in range(0, 101, 10)]
+        assert all(a <= b for a, b in zip(mids, mids[1:]))
+        root = CurriculumScheduler(dataclasses_replace(cfg, "fixed_root"))
+        assert root.get_difficulty(25) >= sch.get_difficulty(25)
+
+    def test_discrete(self):
+        cfg = CurriculumConfig(enabled=True, schedule_type="fixed_discrete",
+                               difficulty=(8, 32, 128), max_step=(10, 20))
+        sch = CurriculumScheduler(cfg)
+        assert sch.get_difficulty(5) == 8
+        assert sch.get_difficulty(15) == 32
+        assert sch.get_difficulty(50) == 128
+
+    def test_truncate(self):
+        b = {"input_ids": jnp.ones((2, 64), jnp.int32),
+             "labels": jnp.ones((2, 64), jnp.int32),
+             "meta": jnp.zeros((2,))}
+        t = truncate_to_difficulty(b, 16)
+        assert t["input_ids"].shape == (2, 16)
+        assert t["meta"].shape == (2,)
+
+    def test_indexer(self):
+        idx = DifficultyIndexer([5, 1, 9, 3, 7])
+        assert set(idx.eligible(4)) == {1, 3}
+        s = idx.sample(8, 4)
+        assert set(s) <= {1, 3}
+
+    def test_config_parse(self):
+        c = Config.from_dict({
+            "train_batch_size": 8,
+            "data_efficiency": {"data_sampling": {"curriculum_learning": {
+                "enabled": True, "min_difficulty": 8, "max_difficulty": 64,
+                "schedule_config": {"total_curriculum_step": 50}}},
+                "data_routing": {"random_ltd": {
+                    "enabled": True, "start_ratio": 0.5}}},
+            "progressive_layer_drop": {"enabled": True, "theta": 0.6},
+            "eigenvalue": {"enabled": True, "max_iter": 10},
+        })
+        assert c.curriculum.max_difficulty == 64
+        assert c.curriculum.total_curriculum_step == 50
+        assert c.random_ltd.start_ratio == 0.5
+        assert c.progressive_layer_drop["theta"] == 0.6
+        assert c.eigenvalue["max_iter"] == 10
+
+
+def dataclasses_replace(cfg, sched):
+    import dataclasses
+    return dataclasses.replace(cfg, schedule_type=sched)
+
+
+class TestRuntimeExtras:
+    def test_eigenvalue_quadratic(self):
+        # loss = 0.5 xᵀ diag(d) x → top eigenvalue = max(d)
+        d = jnp.asarray([1.0, 4.0, 2.0])
+        loss = lambda p: 0.5 * jnp.sum(d * p["x"] ** 2)
+        ev = Eigenvalue(max_iter=200, tol=1e-5)
+        lam = ev.compute(loss, {"x": jnp.asarray([0.3, 0.2, 0.1])})
+        assert abs(lam - 4.0) < 1e-2
+
+    def test_pld_schedule(self):
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.get_theta() == 1.0
+        t1 = pld.update_state(10)
+        t2 = pld.update_state(1000)
+        assert t1 > t2 >= 0.5
+        probs = pld.layer_keep_probs(4, theta=0.5)
+        np.testing.assert_allclose(probs, [0.875, 0.75, 0.625, 0.5])
+        sd = pld.state_dict()
+        pld2 = ProgressiveLayerDrop()
+        pld2.load_state_dict(sd)
+        assert pld2.get_theta() == pld.get_theta()
+
+    def test_apply_layer_drop(self):
+        x = jnp.ones((2, 3))
+        out = apply_layer_drop(lambda a: a * 2, x, jnp.asarray(1.0),
+                               jax.random.PRNGKey(0))
+        np.testing.assert_allclose(out, x * 2)
+        out = apply_layer_drop(lambda a: a * 2, x, jnp.asarray(0.0),
+                               jax.random.PRNGKey(0))
+        np.testing.assert_allclose(out, x)
+        out = apply_layer_drop(lambda a: a * 2, x, jnp.asarray(0.5),
+                               jax.random.PRNGKey(0), deterministic=True)
+        np.testing.assert_allclose(out, x * 2)
