@@ -1,0 +1,248 @@
+"""Decode-optimized paged-KV attention (ref: deepspeed/ops/transformer/
+inference — the decode attention kernels behind init_inference's kernel
+injection, which read a preallocated KV workspace; paging per vLLM-style
+block tables is the modern equivalent contract).
+
+TPU design: KV lives in fixed-size **pages** [KV, num_pages, page_size,
+Dh]; each sequence owns a list of page ids (the page table).  Decode
+attention is HBM-bandwidth-bound, so the pallas kernel streams exactly
+the live pages of each sequence: the page table is a **scalar-prefetch**
+operand and the K/V BlockSpec index maps dereference it, so the grid's
+page axis walks `table[b, p]` — gathers happen in the DMA engine, never
+materialising a contiguous copy of the sequence.  Online softmax (m, l,
+acc in VMEM scratch) accumulates across the page sweep; pages at or past
+the sequence length are masked (their DMA reads page 0 — cheap and safe).
+
+The jnp reference path (`paged_attention_reference`) materialises the
+gather and is the numerics oracle for tests/CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# ------------------------------------------------------------- page store
+class PagedKVCache(NamedTuple):
+    """Paged KV store for one layer stack.
+
+    k/v: [L, KV, num_pages, page_size, Dh]; table: [B, max_pages] int32
+    page ids; seq_lens: [B] int32 valid token counts.
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    table: jnp.ndarray
+    seq_lens: jnp.ndarray
+    page_size: int
+
+    @classmethod
+    def alloc(cls, n_layers: int, n_kv: int, num_pages: int, page_size: int,
+              head_dim: int, batch: int, max_seq: int,
+              dtype=jnp.bfloat16) -> "PagedKVCache":
+        max_pages = -(-max_seq // page_size)
+        if num_pages < batch * max_pages:
+            raise ValueError(
+                f"num_pages {num_pages} < batch*max_pages {batch * max_pages}")
+        shape = (n_layers, n_kv, num_pages, page_size, head_dim)
+        # static round-robin page assignment: sequence b, slot p → page id.
+        # (A dynamic free-list allocator lives host-side in PageAllocator.)
+        table = (np.arange(batch)[:, None] * max_pages
+                 + np.arange(max_pages)[None]).astype(np.int32)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   table=jnp.asarray(table),
+                   seq_lens=jnp.zeros((batch,), jnp.int32),
+                   page_size=page_size)
+
+    def write_token(self, layer: int, new_k: jnp.ndarray,
+                    new_v: jnp.ndarray) -> "PagedKVCache":
+        """Append one token's K/V ([B, KV, Dh]) at each sequence's frontier.
+
+        Raises when a sequence is at capacity (concrete seq_lens); under a
+        jit trace the caller must bound decode length to max_seq — an
+        overflowing write would clamp to the final page's last slot.
+        """
+        B = new_k.shape[0]
+        pos = self.seq_lens                          # [B]
+        capacity = self.table.shape[1] * self.page_size
+        try:
+            if int(jnp.max(pos)) >= capacity:
+                raise ValueError(
+                    f"KV cache overflow: seq_len {int(jnp.max(pos))} at "
+                    f"capacity {capacity}")
+        except jax.errors.TracerArrayConversionError:
+            pass  # traced: bounded by the caller's decode-loop length
+        page_slot = pos // self.page_size
+        in_page = pos % self.page_size
+        page_id = jnp.take_along_axis(self.table, page_slot[:, None],
+                                      axis=1)[:, 0]  # [B]
+
+        def upd(store, new):
+            def one_seq(st, pid, off, val):
+                # st: [KV, num_pages, page_size, Dh]; val: [KV, Dh]
+                return jax.lax.dynamic_update_slice(
+                    st, val[:, None, None, :].astype(st.dtype),
+                    (0, pid, off, 0))
+            st = store[layer]
+            for b in range(B):  # B is small at decode; unrolled is fine
+                st = one_seq(st, page_id[b], in_page[b], new[b])
+            return store.at[layer].set(st)
+
+        return self._replace(k=upd(self.k, new_k), v=upd(self.v, new_v))
+
+    def bump(self) -> "PagedKVCache":
+        return self._replace(seq_lens=self.seq_lens + 1)
+
+
+class PageAllocator:
+    """Host-side free-list page allocator (continuous batching bookkeeping)."""
+
+    def __init__(self, num_pages: int):
+        self.free = list(range(num_pages - 1, -1, -1))
+        self.owned = {}
+
+    def allocate(self, seq_id: int, n: int = 1):
+        if len(self.free) < n:
+            raise MemoryError(f"out of KV pages (need {n}, "
+                              f"free {len(self.free)})")
+        got = [self.free.pop() for _ in range(n)]
+        self.owned.setdefault(seq_id, []).extend(got)
+        return got
+
+    def release(self, seq_id: int):
+        self.free.extend(reversed(self.owned.pop(seq_id, [])))
+
+
+# -------------------------------------------------------- numerics oracle
+def paged_attention_reference(q, k_pages, v_pages, table, seq_lens,
+                              scale: Optional[float] = None):
+    """q: [B, H, Dh]; k/v_pages: [KV, P, ps, Dh]; table: [B, max_pages];
+    seq_lens: [B]. Returns [B, H, Dh]."""
+    B, H, Dh = q.shape
+    KV, _, ps, _ = k_pages.shape
+    G = H // KV
+    scale = scale if scale is not None else Dh ** -0.5
+    kg = k_pages[:, table]                     # [KV, B, mp, ps, Dh]
+    vg = v_pages[:, table]
+    mp = table.shape[1]
+    kg = kg.transpose(1, 0, 2, 3, 4).reshape(B, KV, mp * ps, Dh)
+    vg = vg.transpose(1, 0, 2, 3, 4).reshape(B, KV, mp * ps, Dh)
+    qg = q.reshape(B, KV, G, Dh)
+    s = jnp.einsum("bkgd,bksd->bkgs", qg.astype(jnp.float32),
+                   kg.astype(jnp.float32)) * scale
+    valid = jnp.arange(mp * ps)[None] < seq_lens[:, None]   # [B, S]
+    s = jnp.where(valid[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgs,bksd->bkgd", p, vg.astype(jnp.float32))
+    # empty sequences (continuous batching admits them): zero, not mean-of-V
+    out = jnp.where(seq_lens[:, None, None, None] > 0, out, 0.0)
+    return out.reshape(B, H, Dh).astype(q.dtype)
+
+
+# ------------------------------------------------------------ pallas kernel
+def _paged_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale, page_size, kv_heads,
+                  max_pages):
+    bk = pl.program_id(0)
+    p = pl.program_id(1)
+    b = bk // kv_heads
+
+    @pl.when(p == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    seq_len = lens_ref[b]
+    # page live iff it holds any position < seq_len
+    @pl.when(p * page_size < seq_len)
+    def _():
+        q = q_ref[0]                        # [G, Dh]
+        k = k_ref[0]                        # [ps, Dh]
+        s = jax.lax.dot_general(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [G, ps]
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < seq_len, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pr = jnp.exp(s - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(pr, axis=1, keepdims=True)
+        m_scr[:] = m_new
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            pr, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(p == max_pages - 1)
+    def _():
+        l = jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:])
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, table, seq_lens,
+                           scale: Optional[float] = None,
+                           interpret: bool = False):
+    """Pallas paged decode attention; same contract as the reference fn.
+
+    q: [B, H, Dh] (one decode step), k/v_pages: [KV, P, ps, Dh].
+    """
+    B, H, Dh = q.shape
+    KV, P, ps, _ = k_pages.shape
+    G = H // KV
+    mp = table.shape[1]
+    scale = scale if scale is not None else Dh ** -0.5
+    Gp = max(G, 8)                       # pad query-head group to a VPU tile
+    qg = q.reshape(B, KV, G, Dh)
+    if Gp != G:
+        qg = jnp.concatenate(
+            [qg, jnp.zeros((B, KV, Gp - G, Dh), q.dtype)], axis=2)
+
+    grid = (B * KV, mp)
+    kernel = functools.partial(_paged_kernel, scale=scale, page_size=ps,
+                               kv_heads=KV, max_pages=mp)
+
+    # K/V flattened to [KV*P, ps, Dh]; the page axis of the grid walks the
+    # page table via scalar prefetch: physical block = kv_head*P + table[b,p].
+    # Dead slots (page beyond seq_len) may hold stale/sentinel ids under a
+    # dynamic allocator — clamp them to page 0; the kernel masks the scores.
+    def kv_map(bk, p, tbl, lens):
+        b = bk // KV
+        pid = jnp.where(p * ps < lens[b], tbl[b, p], 0)
+        return ((bk % KV) * P + pid, 0, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,   # table, seq_lens
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, Gp, Dh), lambda bk, p, tbl, lens: (bk, 0, 0)),
+                pl.BlockSpec((1, ps, Dh), kv_map),
+                pl.BlockSpec((1, ps, Dh), kv_map),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, Gp, Dh), lambda bk, p, tbl, lens: (bk, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((Gp, 1), jnp.float32),
+                pltpu.VMEM((Gp, 1), jnp.float32),
+                pltpu.VMEM((Gp, Dh), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * KV, Gp, Dh), q.dtype),
+        interpret=interpret,
+    )(table, seq_lens, qg.reshape(B * KV, Gp, Dh),
+      k_pages.reshape(KV * P, ps, Dh), v_pages.reshape(KV * P, ps, Dh))
+    out = out.reshape(B, KV, Gp, Dh)[:, :, :G]
+    return out.reshape(B, H, Dh)

@@ -1,0 +1,38 @@
+"""Stochastic rounding f32 → bf16 (SURVEY row 9; ref behavior:
+deepspeed's bf16_optimizer keeps f32 masters precisely because plain
+round-to-nearest bf16 updates lose small deltas — stochastic rounding is
+the TPU-native mitigation when even masters are kept in bf16).
+
+Rule: with x's f32 bits u, add a uniform 16-bit integer to the low
+mantissa bits and truncate to the high 16 — rounds up with probability
+(low bits)/2^16, so E[round(x)] = x.  Non-finite values fall back to
+round-to-nearest.  Pure jnp bit-twiddling: XLA fuses it into the
+surrounding update chain, so a separate pallas kernel would only add a
+dispatch; the fused-Adam pallas path can inline the same formula.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def stochastic_round_bf16(x: jnp.ndarray, rng: jax.Array) -> jnp.ndarray:
+    """Round f32 → bf16 stochastically (unbiased). x: any shape f32."""
+    x = x.astype(jnp.float32)
+    u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    r = jax.random.bits(rng, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    rounded = (u + r) & jnp.uint32(0xFFFF0000)
+    y = jax.lax.bitcast_convert_type(rounded, jnp.float32)
+    y = jnp.where(jnp.isfinite(x), y, x)  # NaN/inf: plain cast
+    return y.astype(jnp.bfloat16)
+
+
+def stochastic_round_tree(tree, rng: jax.Array):
+    """Stochastically cast every f32 leaf of a pytree to bf16."""
+    leaves, treedef = jax.tree.flatten(tree)
+    keys = jax.random.split(rng, len(leaves))
+    out = [stochastic_round_bf16(l, k)
+           if jnp.issubdtype(l.dtype, jnp.floating) else l
+           for l, k in zip(leaves, keys)]
+    return treedef.unflatten(out)

@@ -1,0 +1,227 @@
+"""Tests: pallas fused Adam, stochastic rounding, paged decode attention."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.ops import optim
+from deepspeed_tpu.ops.adam_pallas import adam_update_flat, fused_adam
+from deepspeed_tpu.ops.rounding import (stochastic_round_bf16,
+                                        stochastic_round_tree)
+from deepspeed_tpu.inference.kernels import (PageAllocator, PagedKVCache,
+                                             paged_attention_reference,
+                                             paged_decode_attention)
+
+
+class TestFusedAdamPallas:
+    def test_matches_reference_adam(self):
+        ref = optim.adam(lr=0.01, weight_decay=0.1)
+        fus = fused_adam(lr=0.01, weight_decay=0.1, interpret=True)
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (33, 7)),
+                  "b": jax.random.normal(jax.random.PRNGKey(1), (129,))}
+        rs, fs = ref.init(params), fus.init(params)
+        g = jax.tree.map(
+            lambda p: jax.random.normal(jax.random.PRNGKey(2), p.shape), params)
+        for _ in range(3):
+            ru, rs = ref.update(g, rs, params)
+            fu, fs = fus.update(g, fs, params)
+            params_r = jax.tree.map(lambda p, u: p + u, params, ru)
+            params = jax.tree.map(lambda p, u: p + u, params, fu)
+            jax.tree.map(lambda a, b: np.testing.assert_allclose(
+                a, b, atol=1e-6), params, params_r)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            a, b, atol=1e-6), fs.mu, rs.mu)
+
+    def test_bf16_grads_and_params(self):
+        g = jax.random.normal(jax.random.PRNGKey(0), (300,)).astype(jnp.bfloat16)
+        p = jnp.ones((300,), jnp.bfloat16)
+        m = jnp.zeros((300,), jnp.float32)
+        v = jnp.zeros((300,), jnp.float32)
+        u, m1, v1 = adam_update_flat(g, m, v, p, jnp.int32(0), 0.1,
+                                     interpret=True)
+        assert u.dtype == jnp.float32 and u.shape == (300,)
+        assert jnp.isfinite(u).all()
+
+    def test_schedule_parity_with_reference(self):
+        # warmup schedule: step-1 off-by-one would use lr=0 on step one
+        sched = lambda s: 0.05 * jnp.minimum(s.astype(jnp.float32) / 3.0, 1.0)
+        ref, fus = optim.adam(lr=sched), fused_adam(lr=sched, interpret=True)
+        params = {"w": jnp.ones((32,))}
+        rs, fs = ref.init(params), fus.init(params)
+        g = {"w": jnp.full((32,), 0.5)}
+        for _ in range(4):
+            ru, rs = ref.update(g, rs, params)
+            fu, fs = fus.update(g, fs, params)
+            np.testing.assert_allclose(fu["w"], ru["w"], atol=1e-7)
+
+    def test_tuple_params_tree(self):
+        fus = fused_adam(lr=0.01, interpret=True)
+        params = (jnp.ones((16,)), {"b": jnp.ones((8,))})
+        st = fus.init(params)
+        g = jax.tree.map(jnp.ones_like, params)
+        u, st = fus.update(g, st, params)
+        assert isinstance(u, tuple) and u[0].shape == (16,)
+        assert u[1]["b"].shape == (8,)
+
+    def test_schedule_lr(self):
+        sched = lambda s: 0.1 / (1.0 + s.astype(jnp.float32))
+        fus = fused_adam(lr=sched, interpret=True)
+        params = {"w": jnp.ones((16,))}
+        st = fus.init(params)
+        g = {"w": jnp.ones((16,))}
+        u0, st = fus.update(g, st, params)
+        u1, st = fus.update(g, st, params)
+        assert abs(float(u1["w"][0])) < abs(float(u0["w"][0]))
+
+
+class TestStochasticRounding:
+    def test_unbiased(self):
+        # value exactly between two bf16 neighbours rounds ~50/50
+        lo = jnp.float32(jnp.bfloat16(1.0))
+        hi = jnp.float32(jnp.nextafter(jnp.bfloat16(1.0), jnp.bfloat16(2.0)))
+        mid = (lo + hi) / 2
+        x = jnp.full((20000,), mid, jnp.float32)
+        y = stochastic_round_bf16(x, jax.random.PRNGKey(0)).astype(jnp.float32)
+        frac_up = float((y == hi).mean())
+        assert 0.45 < frac_up < 0.55
+        assert float(jnp.abs(y.mean() - mid)) < 1e-4
+
+    def test_exact_values_unchanged(self):
+        x = jnp.asarray([1.0, -2.5, 0.0, 384.0], jnp.float32)  # bf16-exact
+        y = stochastic_round_bf16(x, jax.random.PRNGKey(1))
+        np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                      np.asarray(x))
+
+    def test_nonfinite_passthrough(self):
+        x = jnp.asarray([jnp.inf, -jnp.inf, jnp.nan], jnp.float32)
+        y = stochastic_round_bf16(x, jax.random.PRNGKey(2))
+        assert jnp.isinf(y[0]) and jnp.isinf(y[1]) and jnp.isnan(y[2])
+
+    def test_tree(self):
+        t = {"a": jnp.ones((4, 4)), "i": jnp.ones((3,), jnp.int32)}
+        out = stochastic_round_tree(t, jax.random.PRNGKey(0))
+        assert out["a"].dtype == jnp.bfloat16
+        assert out["i"].dtype == jnp.int32
+
+
+def _mk_pages(KV=2, P=16, ps=8, Dh=16, seed=0):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    return (jax.random.normal(k1, (KV, P, ps, Dh)),
+            jax.random.normal(k2, (KV, P, ps, Dh)))
+
+
+class TestPagedAttention:
+    def test_reference_matches_dense(self):
+        # paged reference with identity paging == dense cached attention
+        B, H, KV, ps, Dh, S = 2, 4, 2, 8, 16, 24
+        mp = S // ps
+        kp, vp = _mk_pages(KV, B * mp, ps, Dh)
+        table = jnp.arange(B * mp, dtype=jnp.int32).reshape(B, mp)
+        lens = jnp.asarray([S, S - 5], jnp.int32)
+        q = jax.random.normal(jax.random.PRNGKey(3), (B, H, Dh))
+        out = paged_attention_reference(q, kp, vp, table, lens)
+        # dense oracle: contiguous caches per batch, masked softmax
+        kc = kp.reshape(KV, B, mp, ps, Dh).transpose(1, 0, 2, 3, 4) \
+            .reshape(B, KV, S, Dh)
+        vc = vp.reshape(KV, B, mp, ps, Dh).transpose(1, 0, 2, 3, 4) \
+            .reshape(B, KV, S, Dh)
+        qg = q.reshape(B, KV, H // KV, Dh)
+        s = jnp.einsum("bkgd,bksd->bkgs", qg, kc) * Dh ** -0.5
+        s = jnp.where((jnp.arange(S)[None] < lens[:, None])[:, None, None],
+                      s, -1e30)
+        pr = jax.nn.softmax(s, -1)
+        ref = jnp.einsum("bkgs,bksd->bkgd", pr, vc).reshape(B, H, Dh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_pallas_matches_reference(self):
+        B, H, KV, P, ps, Dh = 2, 8, 2, 12, 8, 16
+        kp, vp = _mk_pages(KV, P, ps, Dh)
+        # non-trivial page table: scrambled pages
+        table = jnp.asarray([[3, 7, 1, 0], [5, 2, 9, 11]], jnp.int32)
+        lens = jnp.asarray([29, 17], jnp.int32)
+        q = jax.random.normal(jax.random.PRNGKey(4), (B, H, Dh))
+        ref = paged_attention_reference(q, kp, vp, table, lens)
+        out = paged_decode_attention(q, kp, vp, table, lens, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=1e-4)
+
+    def test_pallas_mha_no_gqa(self):
+        B, H, KV, P, ps, Dh = 1, 4, 4, 8, 8, 16
+        kp, vp = _mk_pages(KV, P, ps, Dh, seed=9)
+        table = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+        lens = jnp.asarray([26], jnp.int32)
+        q = jax.random.normal(jax.random.PRNGKey(5), (B, H, Dh))
+        ref = paged_attention_reference(q, kp, vp, table, lens)
+        out = paged_decode_attention(q, kp, vp, table, lens, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=1e-4)
+
+    def test_cache_write_and_attend(self):
+        cache = PagedKVCache.alloc(n_layers=1, n_kv=2, num_pages=8,
+                                   page_size=4, head_dim=16, batch=2,
+                                   max_seq=16, dtype=jnp.float32)
+        ks, vs = [], []
+        for t in range(6):
+            nk = jax.random.normal(jax.random.PRNGKey(10 + t), (2, 2, 16))
+            nv = jax.random.normal(jax.random.PRNGKey(50 + t), (2, 2, 16))
+            cache = cache.write_token(0, nk, nv).bump()
+            ks.append(nk)
+            vs.append(nv)
+        assert int(cache.seq_lens[0]) == 6
+        q = jax.random.normal(jax.random.PRNGKey(99), (2, 4, 16))
+        out = paged_attention_reference(q, cache.k[0], cache.v[0],
+                                        cache.table, cache.seq_lens)
+        # oracle: dense attention over the appended K/V
+        kd = jnp.stack(ks, axis=1)   # [B, 6, KV, Dh]
+        vd = jnp.stack(vs, axis=1)
+        qg = q.reshape(2, 2, 2, 16)
+        s = jnp.einsum("bkgd,bskd->bkgs", qg, kd) * 16 ** -0.5
+        pr = jax.nn.softmax(s, -1)
+        ref = jnp.einsum("bkgs,bskd->bkgd", pr, vd).reshape(2, 4, 16)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_empty_sequence_zero_output(self):
+        kp, vp = _mk_pages(2, 8, 8, 16)
+        table = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+        lens = jnp.asarray([10, 0], jnp.int32)
+        q = jax.random.normal(jax.random.PRNGKey(6), (2, 4, 16))
+        ref = paged_attention_reference(q, kp, vp, table, lens)
+        out = paged_decode_attention(q, kp, vp, table, lens, interpret=True)
+        np.testing.assert_allclose(np.asarray(out[1]), 0.0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=1e-4)
+
+    def test_stale_table_ids_masked(self):
+        # dead slots hold garbage ids; clamped to page 0 and masked
+        kp, vp = _mk_pages(2, 8, 8, 16)
+        table = jnp.asarray([[0, 1, 7, 7]], jnp.int32)
+        stale = jnp.asarray([[0, 1, 6, 5]], jnp.int32)  # dead slots differ
+        lens = jnp.asarray([12], jnp.int32)              # only 2 live pages
+        q = jax.random.normal(jax.random.PRNGKey(7), (1, 4, 16))
+        a = paged_decode_attention(q, kp, vp, table, lens, interpret=True)
+        b = paged_decode_attention(q, kp, vp, stale, lens, interpret=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_cache_overflow_raises(self):
+        cache = PagedKVCache.alloc(n_layers=1, n_kv=1, num_pages=2,
+                                   page_size=2, head_dim=8, batch=1,
+                                   max_seq=4, dtype=jnp.float32)
+        nk = jnp.ones((1, 1, 8))
+        for _ in range(4):
+            cache = cache.write_token(0, nk, nk).bump()
+        with pytest.raises(ValueError, match="overflow"):
+            cache.write_token(0, nk, nk)
+
+    def test_allocator(self):
+        al = PageAllocator(4)
+        a = al.allocate("s1", 2)
+        b = al.allocate("s2", 2)
+        assert len(set(a) | set(b)) == 4
+        with pytest.raises(MemoryError):
+            al.allocate("s3", 1)
+        al.release("s1")
+        c = al.allocate("s3", 2)
+        assert set(c) == set(a)
