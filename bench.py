@@ -25,11 +25,41 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _backend_with_retry(attempts: int = 4, wait_s: float = 30.0) -> str:
+    """The axon TPU tunnel can be transiently unavailable; retry before
+    concluding anything about the backend.  A failed TPU init can either
+    raise OR silently fall back to CPU — when this image's TPU plugin is
+    present, treat a CPU answer as a transient failure too."""
+    import os
+
+    tpu_expected = os.path.isdir("/root/.axon_site")
+    last = "cpu"
+    for i in range(attempts):
+        try:
+            last = jax.default_backend()
+            if last == "tpu" or not tpu_expected:
+                return last
+            msg = f"backend came up as {last!r} but TPU plugin is present"
+        except RuntimeError as e:
+            msg = str(e)
+        if i < attempts - 1:
+            print(f"backend init: {msg}; retry {i + 1}/{attempts} "
+                  f"in {wait_s:.0f}s", file=sys.stderr)
+            time.sleep(wait_s)
+            try:
+                # a silent CPU fallback is memoized; drop it so the next
+                # attempt re-probes the TPU plugin
+                jax.clear_backends()
+            except Exception:
+                pass
+    return last
+
+
 def main():
     import deepspeed_tpu as dstpu
     from deepspeed_tpu.models import llama
 
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = _backend_with_retry() == "tpu"
     if on_tpu:
         # ~0.6B-param Llama slice sized for one v5e (16G HBM) with f32
         # master + Adam moments resident; same per-layer math as 8B.

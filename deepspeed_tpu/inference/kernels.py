@@ -79,7 +79,8 @@ class PagedKVCache(NamedTuple):
                 raise ValueError(
                     f"KV cache overflow: seq_len {int(jnp.max(pos))} at "
                     f"capacity {capacity}")
-        except jax.errors.TracerArrayConversionError:
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
             pass  # traced: bounded by the caller's decode-loop length
         page_slot = pos // self.page_size
         in_page = pos % self.page_size

@@ -12,11 +12,10 @@ Backward: custom VJP — one pallas kernel computes dQ (sweep over K
 blocks), a second computes dK/dV (sweep over Q blocks), both recomputing
 p = exp(qk - lse) from the saved logsumexp, FlashAttention-2 style.
 
-GQA: kv heads are currently broadcast (``jnp.repeat``) to the query head
-count before the kernel — XLA usually folds the repeat into the gather
-feeding the kernel, but a true logical-head index map (query head h
-reading kv head h // (H // KV) via the BlockSpec) is the planned
-perf-round upgrade to cut K/V HBM traffic by the group factor.
+GQA: logical-head BlockSpec index maps — query head h reads kv head
+h // (H // KV) directly (``_kv_row``), so K/V are never repeated in HBM
+and their traffic is cut by the group factor; dK/dV accumulate the sum
+over each kv head's query group inside the backward sweep.
 """
 
 from __future__ import annotations
@@ -123,11 +122,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, block_q, block_k, num_q_blocks):
+                    *, scale, causal, block_q, block_k, num_q_blocks,
+                    n_rep):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    # inner axis sweeps (query-head-in-group, q block): dk/dv accumulate
+    # over every query head sharing this kv head (GQA)
+    qi = pl.program_id(2) % num_q_blocks
 
-    @pl.when(qi == 0)
+    @pl.when(pl.program_id(2) == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -162,20 +164,29 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)         # [BK, D]
 
-    @pl.when(qi == num_q_blocks - 1)
+    @pl.when(pl.program_id(2) == num_q_blocks * n_rep - 1)
     def _():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _pick_blocks(T: int):
+def _pick_blocks(T: int, S: int):
     bq = 256 if T % 256 == 0 else 128
-    return bq, bq
+    bk = 256 if S % 256 == 0 else 128
+    return bq, bk
+
+
+def _kv_row(b, heads, kv_heads):
+    """Logical-head map: flat q row b = batch*H + h → flat kv row
+    batch*KV + h // (H // KV).  The DMA engine reads each kv block once
+    per group instead of materialising a repeated copy in HBM."""
+    g = heads // kv_heads
+    return (b // heads) * kv_heads + (b % heads) // g
 
 
 def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
-                    interpret: bool):
-    """q: [BH, T, D] (kv already expanded to BH) → (out, lse)."""
+                    heads: int, kv_heads: int, interpret: bool):
+    """q: [B*H, T, D]; k/v: [B*KV, S, D] → (out, lse)."""
     BH, T, D = q.shape
     S = k.shape[1]
     scale = 1.0 / np.sqrt(D)
@@ -184,13 +195,16 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, num_k_blocks=nk)
+    kv_spec = pl.BlockSpec(
+        (1, block_k, D),
+        lambda b, i, j: (_kv_row(b, heads, kv_heads), j, 0))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
@@ -211,22 +225,26 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
 
 
 def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k,
-                    interpret):
+                    heads, kv_heads, interpret):
     BH, T, D = q.shape
-    S = k.shape[1]
+    BKV, S = k.shape[0], k.shape[1]
+    G = heads // kv_heads
     scale = 1.0 / np.sqrt(D)
     nq, nk = T // block_q, S // block_k
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1, keepdims=True)        # [BH, T, 1]
 
+    kv_spec = pl.BlockSpec(
+        (1, block_k, D),
+        lambda b, i, j: (_kv_row(b, heads, kv_heads), j, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            kv_spec,
+            kv_spec,
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -237,25 +255,33 @@ def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    # dk/dv grid runs over KV heads; the inner axis sweeps (group member,
+    # q block) so the scratch accumulates the sum over the G query heads
+    # sharing each kv head — the GQA head-sum fused into the sweep.
+    def q_row(b, i):
+        return ((b // kv_heads) * heads + (b % kv_heads) * G + i // nq,
+                i % nq, 0)
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_q_blocks=nq),
-        grid=(BH, nk, nq),
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq,
+                          n_rep=G),
+        grid=(BKV, nk, nq * G),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: q_row(b, i)),
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, j, i: q_row(b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: q_row(b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: q_row(b, i)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+            jax.ShapeDtypeStruct((BKV, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BKV, S, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -266,26 +292,30 @@ def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_bhtd(q, k, v, causal: bool, interpret: bool):
-    block_q, block_k = _pick_blocks(q.shape[1])
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhtd(q, k, v, causal: bool, interpret: bool, heads: int,
+                kv_heads: int):
+    block_q, block_k = _pick_blocks(q.shape[1], k.shape[1])
     out, _ = _flash_fwd_impl(q, k, v, causal=causal, block_q=block_q,
-                             block_k=block_k, interpret=interpret)
+                             block_k=block_k, heads=heads,
+                             kv_heads=kv_heads, interpret=interpret)
     return out
 
 
-def _flash_bhtd_fwd(q, k, v, causal, interpret):
-    block_q, block_k = _pick_blocks(q.shape[1])
+def _flash_bhtd_fwd(q, k, v, causal, interpret, heads, kv_heads):
+    block_q, block_k = _pick_blocks(q.shape[1], k.shape[1])
     out, lse = _flash_fwd_impl(q, k, v, causal=causal, block_q=block_q,
-                               block_k=block_k, interpret=interpret)
+                               block_k=block_k, heads=heads,
+                               kv_heads=kv_heads, interpret=interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bhtd_bwd(causal, interpret, res, do):
+def _flash_bhtd_bwd(causal, interpret, heads, kv_heads, res, do):
     q, k, v, out, lse = res
-    block_q, block_k = _pick_blocks(q.shape[1])
+    block_q, block_k = _pick_blocks(q.shape[1], k.shape[1])
     dq, dk, dv = _flash_bwd_impl(q, k, v, out, lse, do, causal=causal,
                                  block_q=block_q, block_k=block_k,
+                                 heads=heads, kv_heads=kv_heads,
                                  interpret=interpret)
     return dq, dk, dv
 
@@ -295,18 +325,18 @@ _flash_bhtd.defvjp(_flash_bhtd_fwd, _flash_bhtd_bwd)
 
 def flash_attention_tpu(q, k, v, causal: bool = True,
                         interpret: bool = False):
-    """[B,T,H,D] x [B,S,KV,D]^2 → [B,T,H,D]; GQA via kv-head broadcast."""
+    """[B,T,H,D] x [B,S,KV,D]^2 → [B,T,H,D]; GQA via logical-head index
+    maps — kv blocks are DMA'd once per group, never repeated in HBM."""
     B, T, H, D = q.shape
     S, KV = k.shape[1], k.shape[2]
     if T % 128 or S % 128:
         raise ValueError(
             f"flash_attention_tpu needs T and S divisible by 128 (the block"
             f" tiling would silently drop trailing keys), got T={T} S={S}")
-    if KV != H:
-        k = jnp.repeat(k, H // KV, axis=2)
-        v = jnp.repeat(v, H // KV, axis=2)
+    if H % KV:
+        raise ValueError(f"n_heads {H} not a multiple of kv_heads {KV}")
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    out = _flash_bhtd(qf, kf, vf, causal, interpret)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * KV, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * KV, S, D)
+    out = _flash_bhtd(qf, kf, vf, causal, interpret, H, KV)
     return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)

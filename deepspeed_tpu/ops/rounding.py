@@ -33,6 +33,6 @@ def stochastic_round_tree(tree, rng: jax.Array):
     leaves, treedef = jax.tree.flatten(tree)
     keys = jax.random.split(rng, len(leaves))
     out = [stochastic_round_bf16(l, k)
-           if jnp.issubdtype(l.dtype, jnp.floating) else l
+           if l.dtype == jnp.float32 else l
            for l, k in zip(leaves, keys)]
     return treedef.unflatten(out)

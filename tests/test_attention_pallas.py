@@ -54,6 +54,40 @@ def test_backward_matches_reference():
             err_msg=f"grad d{name} mismatch")
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_gqa_backward_matches_reference(causal):
+    # dk/dv must sum over the G query heads sharing each kv head
+    q, k, v = _inputs(B=2, T=256, H=4, KV=2)
+
+    def f_flash(q, k, v):
+        return jnp.sum(
+            flash_attention_tpu(q, k, v, causal=causal, interpret=True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_reference(q, k, v, causal=causal) ** 2)
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        assert a.shape == b.shape, name
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3,
+            err_msg=f"grad d{name} mismatch")
+
+
+def test_cross_lengths_T_ne_S():
+    # T=256 picks block_q=256; S=128 must pick block_k=128 (not 256,
+    # which would give an empty k grid and garbage output)
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (1, 256, 2, 128))
+    k = jax.random.normal(ks[1], (1, 128, 2, 128))
+    v = jax.random.normal(ks[2], (1, 128, 2, 128))
+    out = flash_attention_tpu(q, k, v, causal=False, interpret=True)
+    ref = _reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
 def test_bf16_forward():
     q, k, v = _inputs(dtype=jnp.bfloat16)
     out = flash_attention_tpu(q, k, v, causal=True, interpret=True)
