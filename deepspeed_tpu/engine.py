@@ -209,7 +209,7 @@ class TrainingEngine:
             g, (loss, _aux) = grad_fn(state.params, mb)
             g = zero.grad_constraint(g, self.mesh, stage, self.param_specs)
             gacc = jax.tree.map(lambda a, b: a + b.astype(jnp.float32), gacc, g)
-            return (gacc, lacc + loss), None
+            return (gacc, lacc + loss), _aux
 
         if accum > 1:
             # [global_batch, ...] -> [accum, micro_global, ...]
@@ -220,10 +220,12 @@ class TrainingEngine:
                                  state.params)
             zeros = zero.grad_constraint(zeros, self.mesh, stage,
                                          self.param_specs)
-            (grads, loss_sum), _ = jax.lax.scan(
+            (grads, loss_sum), aux_stack = jax.lax.scan(
                 micro, (zeros, jnp.float32(0.0)), mbatch)
             grads = jax.tree.map(lambda g: g / accum, grads)
             loss = loss_sum / accum
+            _aux = (jax.tree.map(lambda a: jnp.mean(a, axis=0), aux_stack)
+                    if self.has_aux else None)
         else:
             grads, (loss, _aux) = grad_fn(state.params, batch)
             grads = zero.grad_constraint(grads, self.mesh, stage, self.param_specs)
@@ -252,6 +254,9 @@ class TrainingEngine:
                    "overflow": (~ok).astype(jnp.int32),
                    "lr": self.lr_schedule(state.step + 1),
                    "loss_scale": new_scaler.scale}
+        if self.has_aux:
+            # surface the model's aux outputs (e.g. MoE load/aux losses)
+            metrics["aux"] = _aux
         return new_state, metrics
 
     def _eval_step(self, state: TrainState, batch):

@@ -155,3 +155,25 @@ def llama_generator(params, cfg, eos_token_id: Optional[int] = None,
         return logits, cache
 
     return Generator(params, step, step, alloc, eos_token_id=eos_token_id)
+
+
+def llama_paged_generator(params, cfg, eos_token_id: Optional[int] = None,
+                          page_size: int = 16, num_pages: Optional[int] = None,
+                          cache_dtype=jnp.bfloat16) -> Generator:
+    """Paged-KV variant: decode streams only live pages via the pallas
+    paged-attention kernel (ref contract: deepspeed/ops/transformer/
+    inference decode kernels + their preallocated KV workspace)."""
+    from deepspeed_tpu.inference.kernels import PagedKVCache
+    from deepspeed_tpu.models import llama
+
+    def alloc(batch, max_seq):
+        mp = -(-max_seq // page_size)
+        n = num_pages if num_pages is not None else batch * mp
+        return PagedKVCache.alloc(cfg.n_layers, cfg.n_kv_heads, n, page_size,
+                                  cfg.head_dim, batch, max_seq,
+                                  dtype=cache_dtype)
+
+    def step(params, tokens, cache):
+        return llama.forward_paged(params, tokens, cfg, cache)
+
+    return Generator(params, step, step, alloc, eos_token_id=eos_token_id)

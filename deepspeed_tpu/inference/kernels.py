@@ -71,7 +71,6 @@ class PagedKVCache(NamedTuple):
         jit trace the caller must bound decode length to max_seq — an
         overflowing write would clamp to the final page's last slot.
         """
-        B = new_k.shape[0]
         pos = self.seq_lens                          # [B]
         capacity = self.table.shape[1] * self.page_size
         try:
@@ -82,23 +81,11 @@ class PagedKVCache(NamedTuple):
         except (jax.errors.TracerArrayConversionError,
                 jax.errors.ConcretizationTypeError):
             pass  # traced: bounded by the caller's decode-loop length
-        page_slot = pos // self.page_size
-        in_page = pos % self.page_size
-        page_id = jnp.take_along_axis(self.table, page_slot[:, None],
-                                      axis=1)[:, 0]  # [B]
-
-        def upd(store, new):
-            def one_seq(st, pid, off, val):
-                # st: [KV, num_pages, page_size, Dh]; val: [KV, Dh]
-                return jax.lax.dynamic_update_slice(
-                    st, val[:, None, None, :].astype(st.dtype),
-                    (0, pid, off, 0))
-            st = store[layer]
-            for b in range(B):  # B is small at decode; unrolled is fine
-                st = one_seq(st, page_id[b], in_page[b], new[b])
-            return store.at[layer].set(st)
-
-        return self._replace(k=upd(self.k, new_k), v=upd(self.v, new_v))
+        k_l, v_l = write_token_pages(self.k[layer], self.v[layer],
+                                     new_k, new_v, self.table, pos,
+                                     self.page_size)
+        return self._replace(k=self.k.at[layer].set(k_l),
+                             v=self.v.at[layer].set(v_l))
 
     def bump(self) -> "PagedKVCache":
         return self._replace(seq_lens=self.seq_lens + 1)
@@ -121,6 +108,49 @@ class PageAllocator:
 
     def release(self, seq_id: int):
         self.free.extend(reversed(self.owned.pop(seq_id, [])))
+
+
+# ----------------------------------------------- per-layer page writers
+# (scan-friendly: operate on ONE layer's pages [KV, P, ps, Dh] with a
+# static page_size, so models can lax.scan over the layer axis)
+def write_token_pages(pages_k, pages_v, new_k, new_v, table, seq_lens,
+                      page_size: int):
+    """Append one token's K/V ([B, KV, Dh]) at each sequence frontier."""
+    B = new_k.shape[0]
+    page_slot = seq_lens // page_size
+    in_page = seq_lens % page_size
+    page_id = jnp.take_along_axis(table, page_slot[:, None], axis=1)[:, 0]
+
+    def upd(store, new):
+        for b in range(B):      # decode-time B is small; unrolled
+            store = jax.lax.dynamic_update_slice(
+                store, new[b][:, None, None, :].astype(store.dtype),
+                (0, page_id[b], in_page[b], 0))
+        return store
+
+    return upd(pages_k, new_k), upd(pages_v, new_v)
+
+
+def write_prompt_pages(pages_k, pages_v, new_k, new_v, table,
+                       page_size: int):
+    """Bulk-write a fresh prompt's K/V ([B, T, KV, Dh]) into pages,
+    starting at position 0 (prefill of an empty cache)."""
+    B, T, KV, Dh = new_k.shape
+    np_used = -(-T // page_size)
+    pad = np_used * page_size - T
+
+    def upd(store, new):
+        if pad:
+            new = jnp.concatenate(
+                [new, jnp.zeros((B, pad, KV, Dh), new.dtype)], axis=1)
+        # [B, np, ps, KV, Dh] → [KV, B*np, ps, Dh]
+        blocks = new.reshape(B, np_used, page_size, KV, Dh) \
+            .transpose(3, 0, 1, 2, 4).reshape(KV, B * np_used,
+                                              page_size, Dh)
+        ids = table[:, :np_used].reshape(-1)            # [B*np]
+        return store.at[:, ids].set(blocks.astype(store.dtype))
+
+    return upd(pages_k, new_k), upd(pages_v, new_v)
 
 
 # -------------------------------------------------------- numerics oracle

@@ -162,19 +162,25 @@ def rms_norm(x, weight, eps):
 
 
 def rope_tables(cfg: LlamaConfig, positions: jnp.ndarray):
-    """positions: [T] int32 → (cos, sin) [T, head_dim/2] in f32."""
+    """positions: [T] (or [B, T] for per-sequence offsets) int32 →
+    (cos, sin) [..., head_dim/2] in f32."""
     half = cfg.head_dim // 2
     freqs = cfg.rope_theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs
     return jnp.cos(angles), jnp.sin(angles)
 
 
 def apply_rope(x, cos, sin):
-    """x: [B, T, H, Dh]; rotate pairs (x1, x2) = (x[..., :half], x[..., half:])."""
+    """x: [B, T, H, Dh]; rotate pairs (x1, x2) = (x[..., :half], x[..., half:]).
+
+    cos/sin: [T, half] shared across the batch, or [B, T, half] per-sequence
+    (paged decode with ragged frontiers)."""
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
-    c = cos[None, :, None, :].astype(x.dtype)
-    s = sin[None, :, None, :].astype(x.dtype)
+    if cos.ndim == 2:
+        cos, sin = cos[None], sin[None]
+    c = cos[:, :, None, :].astype(x.dtype)
+    s = sin[:, :, None, :].astype(x.dtype)
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
@@ -314,6 +320,78 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache):
     logits = jnp.einsum("btd,dv->btv", x, head,
                         preferred_element_type=jnp.float32)
     cache = cache._replace(k=new_k, v=new_v, length=start + T)
+    return logits, cache
+
+
+def forward_paged(params, tokens, cfg: LlamaConfig, cache,
+                  interpret: Optional[bool] = None):
+    """Forward over a paged KV cache (ref: the reference's inference
+    kernels' workspace contract, modernised to vLLM-style page tables).
+
+    Prefill (T > 1, empty cache): dense causal attention over the prompt,
+    K/V bulk-written into pages.  Decode (T == 1): pallas paged attention
+    streaming only the live pages.  tokens: [B, T] → (logits, cache).
+    """
+    from deepspeed_tpu.inference.kernels import (
+        paged_attention_reference, paged_decode_attention,
+        write_prompt_pages, write_token_pages)
+    from deepspeed_tpu.ops.attention import flash_attention
+    from deepspeed_tpu.ops.fused_ops import swiglu
+
+    B, T = tokens.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    ps = cache.k.shape[3]   # [L, KV, P, page_size, Dh] — static from shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    start = cache.seq_lens
+    x = params["embed"][tokens]
+    # per-sequence position offsets: ragged frontiers under continuous
+    # batching rotate each row by ITS seq_len, not row 0's
+    positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
+    cos, sin = rope_tables(cfg, positions)
+    prefill = T > 1
+    if prefill:
+        # bulk page writes start at slot 0 and attention is prompt-local:
+        # only valid on an empty cache (no chunked prefill)
+        try:
+            if int(jnp.max(start)) != 0:
+                raise ValueError(
+                    "forward_paged prefill (T>1) requires an empty cache; "
+                    "chunked prefill is not supported — decode token by "
+                    "token past the first chunk")
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            pass  # traced: caller's responsibility
+
+    def block(x, layer):
+        lp, kp, vp = layer
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, T, nh, hd)
+        k = (h @ lp["wk"]).reshape(B, T, nkv, hd)
+        v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if prefill:
+            attn = flash_attention(q, k, v, causal=True)
+            kp, vp = write_prompt_pages(kp, vp, k, v, cache.table, ps)
+        else:
+            kp, vp = write_token_pages(kp, vp, k[:, 0], v[:, 0],
+                                       cache.table, start, ps)
+            pa = (paged_attention_reference if interpret
+                  else paged_decode_attention)
+            attn = pa(q[:, 0], kp, vp, cache.table, start + 1)[:, None]
+        x = x + attn.reshape(B, T, nh * hd) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + swiglu(h, lp["w1"], lp["w3"]) @ lp["w2"]
+        return x, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(block, x,
+                                     (params["blocks"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x, head,
+                        preferred_element_type=jnp.float32)
+    cache = cache._replace(k=new_k, v=new_v, seq_lens=start + T)
     return logits, cache
 
 

@@ -190,6 +190,8 @@ def forward(params, tokens, cfg: MixtralConfig, positions=None):
         aux_acc = {
             "moe_aux_loss": aux_acc["moe_aux_loss"] + aux["moe_aux_loss"],
             "moe_z_loss": aux_acc["moe_z_loss"] + aux["moe_z_loss"],
+            "moe_expert_load": aux_acc["moe_expert_load"]
+            + aux["moe_expert_load"] / cfg.n_layers,
         }
         return (x, aux_acc), None
 
@@ -199,7 +201,8 @@ def forward(params, tokens, cfg: MixtralConfig, positions=None):
 
         blk = jax.checkpoint(block, policy=remat_policy(cfg.remat))
     zero_aux = {"moe_aux_loss": jnp.float32(0.0),
-                "moe_z_loss": jnp.float32(0.0)}
+                "moe_z_loss": jnp.float32(0.0),
+                "moe_expert_load": jnp.zeros((cfg.num_experts,), jnp.float32)}
     (x, aux), _ = jax.lax.scan(blk, (x, zero_aux), params["blocks"])
     x = _llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],

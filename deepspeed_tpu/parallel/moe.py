@@ -128,6 +128,12 @@ class MoELayer:
         B, T, d = x.shape
         N = B * T
         xf = x.reshape(N, d)
+        if self.mesh is not None:
+            # keep tokens sharded over the joint batch axes through the
+            # flatten + gating matmul (prevents an SPMD full-remat reshard
+            # when the batch rides both data and expert axes)
+            xf = jax.lax.with_sharding_constraint(
+                xf, self.mesh.sharding(P(self.mesh.batch_spec()[0], None)))
         logits = (xf.astype(jnp.float32) @ gate_w.astype(jnp.float32))
         factor = cfg.capacity_factor if train else cfg.eval_capacity_factor
         cap = capacity(N, cfg.num_experts, cfg.top_k, factor,

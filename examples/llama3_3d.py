@@ -1,0 +1,83 @@
+"""Baseline config 3: Llama-3 8B/70B, ZeRO-3 + 3D parallel (TP x PP x DP)
+(ref: the reference's megatron-deepspeed 3D recipes).
+
+The mesh block IS the 3D topology: {"pipe": P, "data": D, "model": T};
+ZeRO-3 shards params over the data axis on top of TP/PP.
+
+    python examples/llama3_3d.py --scale tiny --pp 2 --tp 2   # 8 CPU devs
+    python examples/llama3_3d.py --scale 8b --tp 4 --pp 2     # pod slice
+"""
+import argparse
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.models import llama
+from deepspeed_tpu.topology import MeshSpec
+
+
+def llama3_cfg(scale: str) -> llama.LlamaConfig:
+    if scale == "8b":
+        return llama.LlamaConfig(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, ffn_dim=14336, max_seq_len=8192,
+            rope_theta=500000.0, remat="save_dots")
+    if scale == "70b":
+        return llama.LlamaConfig(
+            vocab_size=128256, dim=8192, n_layers=80, n_heads=64,
+            n_kv_heads=8, ffn_dim=28672, max_seq_len=8192,
+            rope_theta=500000.0, remat="full")
+    return llama.LlamaConfig.tiny(dim=128, n_heads=4, n_kv_heads=2,
+                                  n_layers=4)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", choices=["tiny", "8b", "70b"], default="tiny")
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--pp", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--seq", type=int, default=0)
+    args = ap.parse_args()
+
+    cfg = llama3_cfg(args.scale)
+    n_dev = len(jax.devices())
+    dp = n_dev // (args.tp * args.pp)
+    mesh = MeshSpec.build({"pipe": args.pp, "data": dp, "model": args.tp})
+    seq = args.seq or (64 if args.scale == "tiny" else 4096)
+    n_micro = 2 * args.pp if args.pp > 1 else 1
+
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=llama.loss_fn(cfg, n_micro=n_micro if args.pp > 1 else None),
+        params=params, mesh=mesh,
+        param_specs=llama.param_specs(cfg, pipeline=args.pp > 1),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": n_micro,
+            "pipeline": {"stages": args.pp, "schedule": "1f1b"},
+            "zero_optimization": {"stage": 3},
+            "optimizer": {"type": "adamw", "params": {"lr": 3e-4}},
+            "scheduler": {"type": "WarmupCosineLR",
+                          "params": {"warmup_num_steps": 2000,
+                                     "total_num_steps": 100000}},
+            "gradient_clipping": 1.0,
+            "bf16": {"enabled": True},
+        })
+
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (engine.train_batch_size, seq + 1)), jnp.int32)
+    print(f"mesh: pp={args.pp} dp={dp} tp={args.tp}; "
+          f"params={llama.param_count(cfg)/1e9:.2f}B")
+    for step in range(args.steps):
+        loss = engine.train_batch({"tokens": toks})
+        print(f"step {step}: loss={float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

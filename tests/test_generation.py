@@ -60,6 +60,91 @@ def test_generator_greedy_deterministic():
     np.testing.assert_array_equal(np.asarray(out1[:, :4]), np.asarray(toks))
 
 
+def test_paged_forward_matches_cached():
+    from deepspeed_tpu.inference.kernels import PagedKVCache
+
+    cfg, params, toks = _setup(T=8)
+    full = llama.forward(params, toks, cfg)
+    cache = PagedKVCache.alloc(cfg.n_layers, cfg.n_kv_heads, num_pages=8,
+                               page_size=4, head_dim=cfg.head_dim, batch=2,
+                               max_seq=16, dtype=jnp.float32)
+    # prefill 6 = one full page + a HALF page (exercises the pad path in
+    # write_prompt_pages and decoding into a partially-filled page)
+    logits, cache = llama.forward_paged(params, toks[:, :6], cfg, cache)
+    outs = [logits]
+    for t in range(6, 8):
+        logits, cache = llama.forward_paged(params, toks[:, t:t + 1], cfg,
+                                            cache)
+        outs.append(logits)
+    got = jnp.concatenate(outs, axis=1)
+    assert int(cache.seq_lens[0]) == 8
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full),
+                               atol=5e-4, rtol=5e-4)
+
+
+def test_paged_prefill_requires_empty_cache():
+    from deepspeed_tpu.inference.kernels import PagedKVCache
+
+    cfg, params, toks = _setup(T=8)
+    cache = PagedKVCache.alloc(cfg.n_layers, cfg.n_kv_heads, num_pages=8,
+                               page_size=4, head_dim=cfg.head_dim, batch=2,
+                               max_seq=16, dtype=jnp.float32)
+    _, cache = llama.forward_paged(params, toks[:, :4], cfg, cache)
+    import pytest
+    with pytest.raises(ValueError, match="empty cache"):
+        llama.forward_paged(params, toks[:, 4:8], cfg, cache)
+
+
+def test_paged_decode_ragged_frontiers():
+    """Batched decode with per-row seq_lens must equal per-sequence
+    decode (per-row RoPE offsets + per-row page frontiers)."""
+    cfg, params, toks = _setup(T=8, B=2)
+    ps, mp = 4, 4
+
+    def one_row(row, L):
+        from deepspeed_tpu.inference.kernels import PagedKVCache
+
+        c = PagedKVCache.alloc(cfg.n_layers, cfg.n_kv_heads, num_pages=mp,
+                               page_size=ps, head_dim=cfg.head_dim, batch=1,
+                               max_seq=ps * mp, dtype=jnp.float32)
+        _, c = llama.forward_paged(params, toks[row:row + 1, :L], cfg, c)
+        logits, _ = llama.forward_paged(params, toks[row:row + 1, L:L + 1],
+                                        cfg, c)
+        return c, logits
+
+    c0, l0 = one_row(0, 4)
+    c1, l1 = one_row(1, 6)
+    # merge into one B=2 cache: row 1's pages live at ids [mp, 2mp)
+    from deepspeed_tpu.inference.kernels import PagedKVCache
+
+    merged = PagedKVCache.alloc(cfg.n_layers, cfg.n_kv_heads,
+                                num_pages=2 * mp, page_size=ps,
+                                head_dim=cfg.head_dim, batch=2,
+                                max_seq=ps * mp, dtype=jnp.float32)
+    merged = merged._replace(
+        k=merged.k.at[:, :, :mp].set(c0.k).at[:, :, mp:].set(c1.k),
+        v=merged.v.at[:, :, :mp].set(c0.v).at[:, :, mp:].set(c1.v),
+        seq_lens=jnp.asarray([4, 6], jnp.int32))
+    nxt = jnp.stack([toks[0, 4], toks[1, 6]])[:, None]
+    lb, _ = llama.forward_paged(params, nxt, cfg, merged)
+    np.testing.assert_allclose(np.asarray(lb[0]), np.asarray(l0[0]),
+                               atol=5e-4, rtol=5e-4)
+    np.testing.assert_allclose(np.asarray(lb[1]), np.asarray(l1[0]),
+                               atol=5e-4, rtol=5e-4)
+
+
+def test_paged_generator_matches_dense():
+    from deepspeed_tpu.inference.generation import llama_paged_generator
+
+    cfg, params, toks = _setup(T=4)
+    dense = llama_generator(params, cfg, cache_dtype=jnp.float32)
+    paged = llama_paged_generator(params, cfg, page_size=4,
+                                  cache_dtype=jnp.float32)
+    o1 = dense.generate(toks, max_new_tokens=6, temperature=0.0)
+    o2 = paged.generate(toks, max_new_tokens=6, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+
+
 def test_generator_eos_stops():
     cfg, params, toks = _setup(T=4)
     gen = llama_generator(params, cfg, cache_dtype=jnp.float32,
