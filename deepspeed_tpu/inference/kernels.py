@@ -68,8 +68,10 @@ class PagedKVCache(NamedTuple):
         """Append one token's K/V ([B, KV, Dh]) at each sequence's frontier.
 
         Raises when a sequence is at capacity (concrete seq_lens); under a
-        jit trace the caller must bound decode length to max_seq — an
-        overflowing write would clamp to the final page's last slot.
+        jit trace an overflowing sequence's write is *dropped* (validity
+        predicate inside ``write_token_pages``) so live KV is never
+        corrupted — overflow degrades to stale attention on the final
+        token rather than silently overwriting the last slot.
         """
         pos = self.seq_lens                          # [B]
         capacity = self.table.shape[1] * self.page_size
@@ -115,18 +117,28 @@ class PageAllocator:
 # static page_size, so models can lax.scan over the layer axis)
 def write_token_pages(pages_k, pages_v, new_k, new_v, table, seq_lens,
                       page_size: int):
-    """Append one token's K/V ([B, KV, Dh]) at each sequence frontier."""
-    B = new_k.shape[0]
-    page_slot = seq_lens // page_size
+    """Append one token's K/V ([B, KV, Dh]) at each sequence frontier.
+
+    One vectorized scatter over the batch (no per-b unroll — decode B can
+    be large under continuous batching).  A sequence at capacity writes
+    its *existing* value back (no-op) instead of clamping onto the last
+    live slot, so overflow never corrupts attention (advisor finding r1).
+    """
+    max_pages = table.shape[1]
+    num_pages = pages_k.shape[1]
+    capacity = max_pages * page_size
+    valid = seq_lens < capacity                              # [B]
+    page_slot = jnp.minimum(seq_lens // page_size, max_pages - 1)
     in_page = seq_lens % page_size
     page_id = jnp.take_along_axis(table, page_slot[:, None], axis=1)[:, 0]
+    # overflow → point the scatter out of range and drop it (free: no
+    # gather/blend on the hot path, the scatter itself skips the write)
+    page_id = jnp.where(valid, page_id, num_pages)
 
     def upd(store, new):
-        for b in range(B):      # decode-time B is small; unrolled
-            store = jax.lax.dynamic_update_slice(
-                store, new[b][:, None, None, :].astype(store.dtype),
-                (0, page_id[b], in_page[b], 0))
-        return store
+        # store: [KV, P, ps, Dh]; new: [B, KV, Dh] → scatter [KV, B, Dh]
+        vals = new.transpose(1, 0, 2).astype(store.dtype)
+        return store.at[:, page_id, in_page].set(vals, mode="drop")
 
     return upd(pages_k, new_k), upd(pages_v, new_v)
 

@@ -272,6 +272,14 @@ def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     the active blocks (gathered K/V), matching the reference's
     MatMul(sdd)→Softmax→MatMul(dsd) pipeline semantics
     (ref: deepspeed/ops/sparse_attention/sparse_self_attention.py).
+
+    Memory trade-off: the savings here are FLOPs-side.  The gather
+    materialises kg/vg of shape [B,H,nb,A,block,D] — every K/V block is
+    duplicated once per attending query block-row (≈window-size× for
+    sliding-window/Longformer layouts), so peak activation memory and
+    HBM traffic can *exceed* dense attention unless XLA fuses the gather
+    into the einsum.  For long sequences where memory dominates, use the
+    flash path (`ops.attention_pallas`) which streams blocks instead.
     """
     B, H, S, D = q.shape
     nb = S // block

@@ -36,14 +36,26 @@ def sample_token_indices(rng: jax.Array, seq_len: int, keep: int,
 
 def random_ltd_layer(layer_fn: Callable[..., jnp.ndarray], x: jnp.ndarray,
                      rng: jax.Array, keep: int, *args: Any,
+                     pass_positions: bool = False,
                      **kwargs: Any) -> jnp.ndarray:
     """Apply ``layer_fn`` to a random ``keep``-token subset of x [B,S,D];
-    dropped tokens ride through unchanged (ref: basic_layer.py forward)."""
+    dropped tokens ride through unchanged (ref: basic_layer.py forward).
+
+    With ``pass_positions=True``, layer_fn receives ``positions=[B, keep]``
+    — the ORIGINAL token indices of the kept subset — mirroring the
+    reference's forwarding of sampled indices so RoPE tables / relative
+    position bias / padding masks see real positions, not the compacted
+    0..keep-1 range (advisor finding r1).  Layers that derive positions
+    internally MUST opt in or be position-agnostic."""
     B, S, _ = x.shape
     if keep >= S:
+        if pass_positions:
+            kwargs["positions"] = jnp.broadcast_to(jnp.arange(S), (B, S))
         return layer_fn(x, *args, **kwargs)
     idx = sample_token_indices(rng, S, keep, B)            # [B, keep]
     sub = jnp.take_along_axis(x, idx[:, :, None], axis=1)  # [B, keep, D]
+    if pass_positions:
+        kwargs["positions"] = idx
     out = layer_fn(sub, *args, **kwargs)
     upd = jnp.zeros_like(x)
     upd = jax.vmap(lambda u, o, i: u.at[i].set(o))(upd, out, idx)

@@ -105,15 +105,21 @@ class ProgressiveLayerDrop:
         return 1.0 - (1.0 - th) * i / num_layers
 
 
-def apply_layer_drop(layer_fn: Callable[[jnp.ndarray], jnp.ndarray],
+def apply_layer_drop(branch_fn: Callable[[jnp.ndarray], jnp.ndarray],
                      x: jnp.ndarray, keep_prob: jnp.ndarray,
                      rng: jax.Array, deterministic: bool = False
                      ) -> jnp.ndarray:
-    """Stochastically skip a residual layer (identity when dropped), with
-    1/p output scaling when kept — PLD's expected-depth-preserving rule."""
+    """Stochastic depth over a *residual branch*: ``x + b·f(x)/p`` with
+    ``b ~ Bernoulli(p)`` — so ``E[out] = x + f(x)`` for every p, the
+    expected-depth-preserving rule from the PLD / stochastic-depth papers.
+
+    ``branch_fn`` is the residual branch f alone (attention or MLP body),
+    NOT the full ``x + f(x)`` layer: scaling must touch only the branch,
+    or the identity path gets biased by 1/p (advisor finding r1)."""
     if deterministic:
-        return layer_fn(x)
+        return x + branch_fn(x)
     keep = jax.random.bernoulli(rng, keep_prob)
-    out = jax.lax.cond(keep, lambda a: layer_fn(a) / keep_prob,
-                       lambda a: a, x)
-    return out
+    return x + jax.lax.cond(
+        keep,
+        lambda a: branch_fn(a) / jnp.maximum(keep_prob, 1e-6),
+        lambda a: jnp.zeros_like(a), x)

@@ -213,13 +213,26 @@ class TestRuntimeExtras:
         assert pld2.get_theta() == pld.get_theta()
 
     def test_apply_layer_drop(self):
+        # branch f(x) = 2x; full layer out = x + b·f(x)/p
         x = jnp.ones((2, 3))
         out = apply_layer_drop(lambda a: a * 2, x, jnp.asarray(1.0),
                                jax.random.PRNGKey(0))
-        np.testing.assert_allclose(out, x * 2)
+        np.testing.assert_allclose(out, x * 3)  # x + f(x)
         out = apply_layer_drop(lambda a: a * 2, x, jnp.asarray(0.0),
                                jax.random.PRNGKey(0))
-        np.testing.assert_allclose(out, x)
+        np.testing.assert_allclose(out, x)      # identity path unscaled
         out = apply_layer_drop(lambda a: a * 2, x, jnp.asarray(0.5),
                                jax.random.PRNGKey(0), deterministic=True)
-        np.testing.assert_allclose(out, x * 2)
+        np.testing.assert_allclose(out, x * 3)
+
+    def test_apply_layer_drop_unbiased_at_intermediate_p(self):
+        # E[out] over rng must be x + f(x) for 0<p<1 (advisor r1: the old
+        # impl scaled the identity path too, giving x/p + f(x)/p when kept)
+        x = jnp.ones((2, 3))
+        p = 0.7
+        outs = jnp.stack([
+            apply_layer_drop(lambda a: a * 2, x, jnp.asarray(p),
+                             jax.random.PRNGKey(i))
+            for i in range(2000)])
+        mean = outs.mean(0)
+        np.testing.assert_allclose(mean, x * 3, rtol=0.05)
