@@ -40,6 +40,12 @@ class ZeroConfig:
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ZeroConfig":
+        d = dict(d)
+        # reference ZeRO++ key spellings (deepspeed/runtime/zero/config.py)
+        for ref_key, ours in (("zero_quantized_gradients", "zeropp_quantized_gradients"),
+                              ("zero_quantized_weights", "zeropp_quantized_weights")):
+            if ref_key in d:
+                d.setdefault(ours, d.pop(ref_key))
         known = {f.name for f in dataclasses.fields(cls)}
         kwargs = {k: v for k, v in d.items() if k in known}
         z = cls(**kwargs)

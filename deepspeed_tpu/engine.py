@@ -91,6 +91,31 @@ class TrainingEngine:
         self.param_specs = param_specs
         stage = config.zero.stage
 
+        # ---- compressed-communication mode (ref: onebit optimizers +
+        # ZeRO++ qgZ).  Resolved BEFORE the optimizer is built so a 1-bit
+        # optimizer gets the bound axis name when the compressed shard_map
+        # step will actually run.
+        from deepspeed_tpu import comm_compress
+
+        self.grad_comm_mode = comm_compress.resolve_mode(
+            config, self.mesh,
+            optimizer.name if optimizer is not None else config.optimizer.type,
+            has_aux)
+        if self.grad_comm_mode == "onebit" and config.gradient_clipping > 0:
+            logger.warning(
+                "gradient_clipping is ignored under the 1-bit optimizer "
+                "path (the exact global grad never exists; the reference "
+                "has the same semantics)")
+        if self.grad_comm_mode == "onebit" and optimizer is not None and \
+                optimizer.axis_name != comm_compress.AXIS:
+            raise ValueError(
+                "user-supplied 1-bit optimizer must be built with "
+                f"axis_name={comm_compress.AXIS!r} to run in the engine's "
+                "compressed step (yours has "
+                f"axis_name={optimizer.axis_name!r}, which would do NO "
+                "cross-device communication and silently diverge); or "
+                "omit `optimizer=` and configure it via the config dict")
+
         # ---- optimizer + schedule (ref: engine._configure_optimizer)
         from deepspeed_tpu.ops.optim import default_lr
 
@@ -104,7 +129,24 @@ class TrainingEngine:
         if optimizer is None:
             oparams = dict(config.optimizer.params)
             oparams["lr"] = self.lr_schedule
+            if self.grad_comm_mode == "onebit":
+                oparams["axis_name"] = comm_compress.AXIS
             optimizer = opt_from_config(config.optimizer.type, oparams)
+        if self.grad_comm_mode == "onebit":
+            # per-device error feedback lives in engine state as a
+            # [world, ...] stack; each device owns its slice via a
+            # P("data") sharding on the leading dim.
+            import dataclasses as _dc
+
+            W = self.mesh.size("data")
+            base_init = optimizer.init
+
+            def stacked_init(p):
+                st = base_init(p)
+                return st._replace(err=jax.tree.map(
+                    lambda e: jnp.zeros((W,) + e.shape, e.dtype), st.err))
+
+            optimizer = _dc.replace(optimizer, init=stacked_init)
         self.optimizer = optimizer
 
         # ---- state layout: ZeRO shardings
@@ -118,6 +160,12 @@ class TrainingEngine:
         opt_state_shape = jax.eval_shape(self.optimizer.init, params)
         self.opt_shardings = zero.optstate_shardings(
             opt_state_shape, params, self.mesh, stage, param_specs)
+        if self.grad_comm_mode == "onebit":
+            from jax.sharding import PartitionSpec as _P
+
+            self.opt_shardings = self.opt_shardings._replace(
+                err=jax.tree.map(
+                    lambda _: self.mesh.sharding(_P("data")), params))
         if config.zero.offload_optimizer or config.zero.offload_param:
             from deepspeed_tpu.offload import engine_offload_shardings
 
@@ -204,6 +252,24 @@ class TrainingEngine:
 
         grad_fn = jax.grad(scaled_loss, has_aux=True)
 
+        if self.grad_comm_mode == "onebit":
+            return self._onebit_train_step(state, batch, accum)
+        if self.grad_comm_mode == "qgz":
+            from deepspeed_tpu import comm_compress
+
+            def local_gf(p, mb):
+                g, (loss, _a) = grad_fn(p, mb)
+                return g, loss
+
+            grads, loss = comm_compress.local_grad_shardmap(
+                local_gf, self.mesh, accum,
+                reduce_fn=comm_compress.quantized_all_reduce_tree)(
+                    state.params, batch)
+            grads = zero.grad_constraint(grads, self.mesh, stage,
+                                         self.param_specs)
+            _aux = None
+            return self._finish_step(state, grads, loss, _aux)
+
         def micro(carry, mb):
             gacc, lacc = carry
             g, (loss, _aux) = grad_fn(state.params, mb)
@@ -231,6 +297,11 @@ class TrainingEngine:
             grads = zero.grad_constraint(grads, self.mesh, stage, self.param_specs)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
 
+        return self._finish_step(state, grads, loss, _aux)
+
+    def _finish_step(self, state: TrainState, grads, loss, _aux):
+        """Shared step tail: unscale/overflow-check, clip, update, commit."""
+        cfg = self.config
         grads, ok, new_scaler = precision.unscale_and_check(
             grads, state.scaler, cfg.precision)
 
@@ -257,6 +328,86 @@ class TrainingEngine:
         if self.has_aux:
             # surface the model's aux outputs (e.g. MoE load/aux losses)
             metrics["aux"] = _aux
+        return new_state, metrics
+
+    def _onebit_train_step(self, state: TrainState, batch, accum: int):
+        """1-bit optimizer step: the whole grad→compressed-momentum-comm→
+        update sequence runs under shard_map over the data axis, so the
+        optimizer's int8 sign all-gather is genuinely what crosses the
+        wire (ref: deepspeed/runtime/fp16/onebit/adam.py, where the
+        optimizer owns communication).
+
+        State contract: mu/nu replicated (identical on every device after
+        the shared compressed reduction), err stacked [world, ...] with
+        each device owning its slice (P("data") leading dim).
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu import comm_compress
+
+        ms = self.mesh
+
+        def f(params, opt_state, mb):
+            err_local = jax.tree.map(
+                lambda e: jnp.squeeze(e, 0), opt_state.err)
+            ob = opt_state._replace(err=err_local)
+
+            def local_gf(p, m):
+                # bf16/fp32 only (gated at init): no loss scaling
+                loss, g = jax.value_and_grad(
+                    lambda pp: self._loss_for(pp, m)[0])(p)
+                return g, loss
+
+            grads, loss = comm_compress.accumulate_local_grads(
+                local_gf, params, mb, accum)
+
+            # nonfinite guard needs GLOBAL consensus: a nan can appear on
+            # one device's shard only, and a divergent skip decision would
+            # desync mu across devices.
+            ok = jax.lax.pmin(
+                precision.finite_all(grads).astype(jnp.int32),
+                comm_compress.AXIS).astype(bool)
+            updates, new_ob = self.optimizer.update(grads, ob, params)
+            keep = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(ok, n, o), new, old)
+            new_params = keep(jax.tree.map(
+                lambda p, u: p + u.astype(p.dtype), params, updates), params)
+            new_ob = ob._replace(
+                step=jnp.where(ok, new_ob.step, ob.step),
+                mu=keep(new_ob.mu, ob.mu),
+                nu=keep(new_ob.nu, ob.nu),
+                err=keep(new_ob.err, ob.err))
+            # approximation: sqrt(E_dev ||g_local||^2) — the exact global
+            # grad never exists on any device in this mode
+            gnorm = jnp.sqrt(jax.lax.pmean(
+                jnp.square(global_norm(grads)), comm_compress.AXIS))
+            new_opt = new_ob._replace(err=jax.tree.map(
+                lambda e: e[None], new_ob.err))
+            return new_params, new_opt, \
+                jax.lax.pmean(loss, comm_compress.AXIS), gnorm, ok
+
+        repl = lambda tree: jax.tree.map(lambda _: P(), tree)
+        err_spec = jax.tree.map(lambda _: P("data"), state.params)
+        # opt_state specs: everything P() except the err stack
+        opt_specs = type(state.opt_state)(
+            step=P(),
+            mu=repl(state.opt_state.mu),
+            nu=repl(state.opt_state.nu),
+            err=err_spec)
+        new_params, new_opt, loss, gnorm, ok = jax.shard_map(
+            f, mesh=ms.mesh,
+            in_specs=(repl(state.params), opt_specs,
+                      jax.tree.map(lambda _: P("data"), batch)),
+            out_specs=(repl(state.params), opt_specs, P(), P(), P()),
+            check_vma=False)(state.params, state.opt_state, batch)
+        new_state = TrainState(
+            step=state.step + jnp.where(ok, 1, 0).astype(jnp.int32),
+            params=new_params, opt_state=new_opt,
+            scaler=state.scaler)
+        metrics = {"loss": loss, "grad_norm": gnorm,
+                   "overflow": (~ok).astype(jnp.int32),
+                   "lr": self.lr_schedule(state.step + 1),
+                   "loss_scale": state.scaler.scale}
         return new_state, metrics
 
     def _eval_step(self, state: TrainState, batch):

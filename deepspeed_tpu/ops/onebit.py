@@ -121,7 +121,8 @@ def onebit_adam(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999),
         unflat = lambda i: jax.tree.unflatten(treedef, [o[i] for o in outs])
         return unflat(0), OneBitState(step, unflat(1), unflat(2), unflat(3))
 
-    return Optimizer(init=init, update=update, name="onebit_adam")
+    return Optimizer(init=init, update=update, name="onebit_adam",
+                     axis_name=axis_name)
 
 
 def onebit_lamb(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999),
@@ -150,4 +151,5 @@ def onebit_lamb(lr: ScalarOrSchedule = 1e-3, betas=(0.9, 0.999),
 
         return jax.tree.map(leaf, raw_upd, params), new_state
 
-    return Optimizer(init=base.init, update=update, name="onebit_lamb")
+    return Optimizer(init=base.init, update=update, name="onebit_lamb",
+                     axis_name=axis_name)

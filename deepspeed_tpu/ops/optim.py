@@ -36,6 +36,10 @@ class Optimizer:
     init: Callable[[Any], Any]
     update: Callable[[Any, Any, Any], Any]  # (grads, state, params) -> (updates, state)
     name: str = "optimizer"
+    # Named mesh axis this optimizer communicates over (1-bit family);
+    # None = no internal communication.  The engine checks this before
+    # routing an optimizer into the compressed shard_map step.
+    axis_name: Optional[str] = None
 
 
 class AdamState(NamedTuple):
@@ -269,13 +273,22 @@ def from_config(name: str, params: dict) -> Optimizer:
     name = name.lower()
     if name.startswith("onebit") or name.startswith("zeroone"):
         _register_onebit()   # deferred: onebit imports this module
-        # The engine steps under plain jax.jit (GSPMD shardings, no named
-        # axes) and already mean-reduces grads across dp, so the bound
-        # axis_name="data" default would (a) hit an unbound-axis error at
-        # trace time and (b) double-average.  Explicit axis_name is for
-        # shard_map users driving onebit_allreduce themselves.
+        # Outside the engine's compressed shard_map path there is no bound
+        # named axis, so axis_name defaults to None — which means NO
+        # compressed communication happens.  The engine passes
+        # axis_name="data" itself when its compressed step is active
+        # (deepspeed_tpu/comm_compress.py); warn loudly for everyone else
+        # so nobody believes they enabled 32x comm reduction and didn't.
         params = dict(params)
-        params.setdefault("axis_name", None)
+        if params.setdefault("axis_name", None) is None:
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                "%s built with axis_name=None: momentum compression is "
+                "INACTIVE (updates are exact Adam/LAMB with frozen "
+                "variance). Use it through TrainingEngine on a "
+                "data-parallel mesh, or pass axis_name= under your own "
+                "shard_map, to get compressed communication.", name)
     if name not in _REGISTRY:
         raise ValueError(f"unknown optimizer {name!r}; known: {sorted(_REGISTRY)}")
     kw = dict(params)
