@@ -550,6 +550,31 @@ def initialize(args=None, *, loss_fn: Callable, params: Any,
     elif config is None:
         config = Config()
 
+    # ZeRO-Infinity routing: an NVMe optimizer tier (or a cpu tier on a
+    # backend without pinned_host memory) needs host-scheduled state
+    # streaming — IO cannot live inside the jitted step (ref:
+    # deepspeed/runtime/swap_tensor/partitioned_optimizer_swapper.py).
+    off = config.zero.offload_optimizer or {}
+    off_dev = off.get("device", "none")
+    if off_dev == "nvme" or (off_dev == "cpu" and off.get("scheduled")):
+        from deepspeed_tpu.infinity import InfinityEngine
+
+        if optimizer is not None or param_specs is not None or has_aux:
+            raise ValueError(
+                "the ZeRO-Infinity scheduled-offload engine drives its own "
+                "Adam update and parameter layout; pass the optimizer via "
+                "the config block and drop param_specs/has_aux")
+        engine = InfinityEngine(loss_fn, params, config, mesh=mesh,
+                                lr_scheduler=lr_scheduler)
+        dataloader = None
+        if training_data is not None:
+            from deepspeed_tpu.data.loader import DataLoader
+
+            dataloader = DataLoader(training_data,
+                                    batch_size=config.train_batch_size,
+                                    seed=config.seed)
+        return engine, engine.optimizer, dataloader, engine.lr_schedule
+
     engine = TrainingEngine(loss_fn, params, config, mesh=mesh,
                             optimizer=optimizer, lr_scheduler=lr_scheduler,
                             param_specs=param_specs, has_aux=has_aux)

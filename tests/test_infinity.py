@@ -1,0 +1,173 @@
+"""ZeRO-Infinity scheduled offload (ref: deepspeed/runtime/swap_tensor/
+partitioned_optimizer_swapper.py): optimizer state streamed through the
+host/NVMe tier around sub-group updates, double-buffered via the aio pool.
+"""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as dstpu
+from deepspeed_tpu.infinity import InfinityEngine
+from deepspeed_tpu.models import llama
+
+
+def tiny_setup():
+    cfg = llama.LlamaConfig.tiny(dim=64, n_layers=2, n_heads=4, n_kv_heads=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tok = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 65)),
+        jnp.int32)
+    return cfg, params, {"tokens": tok}
+
+
+def build(cfg, params, offload, sub_group=0):
+    zero = {"stage": 0}
+    if offload:
+        zero["offload_optimizer"] = offload
+        if sub_group:
+            zero["sub_group_size"] = sub_group
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=llama.loss_fn(cfg), params=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "zero_optimization": zero,
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                "bf16": {"enabled": True}})
+    return engine
+
+
+class TestInfinityEngine:
+    def test_routing_and_trajectory_matches_plain_engine(self, devices):
+        cfg, params, batch = tiny_setup()
+        plain = build(cfg, params, None)
+        inf = build(cfg, params, {"device": "cpu", "scheduled": True})
+        assert isinstance(inf, InfinityEngine)
+        assert not isinstance(plain, InfinityEngine)
+        lp = [float(plain.train_batch(batch)) for _ in range(6)]
+        li = [float(inf.train_batch(batch)) for _ in range(6)]
+        # identical math (f32 master+moments, bf16 compute, adamw):
+        # trajectories agree to float tolerance
+        np.testing.assert_allclose(li, lp, rtol=2e-3, atol=2e-3)
+        assert li[-1] < li[0]
+
+    def test_nvme_tier_matches_ram_tier(self, devices):
+        cfg, params, batch = tiny_setup()
+        ram = build(cfg, params, {"device": "cpu", "scheduled": True})
+        nvme = build(cfg, params, {
+            "device": "nvme",
+            "nvme_path": tempfile.mkdtemp(prefix="dstpu_test_nvme_")})
+        lr_ = [float(ram.train_batch(batch)) for _ in range(4)]
+        ln = [float(nvme.train_batch(batch)) for _ in range(4)]
+        np.testing.assert_allclose(ln, lr_, rtol=1e-6, atol=1e-6)
+
+    def test_multi_group_double_buffer_matches_single_group(self, devices):
+        cfg, params, batch = tiny_setup()
+        one = build(cfg, params, {
+            "device": "nvme",
+            "nvme_path": tempfile.mkdtemp(prefix="dstpu_g1_")})
+        many = build(cfg, params, {
+            "device": "nvme",
+            "nvme_path": tempfile.mkdtemp(prefix="dstpu_gN_")},
+            sub_group=8192)  # tiny groups → many, exercises both slots
+        assert len(many.groups) > 2 >= len(one.groups)
+        lo = [float(one.train_batch(batch)) for _ in range(4)]
+        lm = [float(many.train_batch(batch)) for _ in range(4)]
+        np.testing.assert_allclose(lm, lo, rtol=1e-6, atol=1e-6)
+
+    def test_master_params_consolidation(self, devices):
+        cfg, params, batch = tiny_setup()
+        inf = build(cfg, params, {"device": "cpu", "scheduled": True})
+        inf.train_batch(batch)
+        master = inf.master_params()
+        # same structure, f32, and actually updated (differs from init)
+        assert jax.tree.structure(master) == jax.tree.structure(params)
+        l0 = jax.tree.leaves(params)[0]
+        m0 = jax.tree.leaves(master)[0]
+        assert m0.dtype == np.float32
+        assert not np.allclose(np.asarray(l0, np.float32), m0)
+
+    def test_rejects_client_optimizer(self, devices):
+        cfg, params, _ = tiny_setup()
+        from deepspeed_tpu.ops import optim as ops_optim
+
+        with pytest.raises(ValueError, match="Infinity"):
+            dstpu.initialize(
+                loss_fn=llama.loss_fn(cfg), params=params,
+                optimizer=ops_optim.adam(1e-3),
+                config={"train_micro_batch_size_per_gpu": 4,
+                        "zero_optimization": {"offload_optimizer": {
+                            "device": "nvme",
+                            "nvme_path": tempfile.mkdtemp()}},
+                        "optimizer": {"type": "adamw",
+                                      "params": {"lr": 1e-3}}})
+
+    def test_hbm_state_is_bf16_only(self, devices):
+        cfg, params, batch = tiny_setup()
+        inf = build(cfg, params, {"device": "cpu", "scheduled": True})
+        n = llama.param_count(cfg)
+        assert inf.hbm_state_bytes() == 2 * n  # bf16 compute copy only
+
+    def test_plain_cpu_offload_stays_on_training_engine(self, devices):
+        # no "scheduled" opt-in → the memory-kind sharding path
+        # (graceful no-op on backends without pinned_host)
+        cfg, params, batch = tiny_setup()
+        eng = build(cfg, params, {"device": "cpu"})
+        assert not isinstance(eng, InfinityEngine)
+        assert float(eng.train_batch(batch)) > 0
+
+    def test_nonfinite_grad_skips_and_counts(self, devices):
+        cfg, params, batch = tiny_setup()
+        inf = build(cfg, params, {"device": "cpu", "scheduled": True})
+        inf.train_batch(batch)
+        master_before = jax.tree.leaves(inf.master_params())
+        bad = {"tokens": batch["tokens"]}
+        # poison the embedding path via a param? simpler: nan in loss via
+        # nan-inducing overflow is hard with int tokens — instead poison a
+        # compute param directly
+        inf.params_c[0] = inf.params_c[0].at[(0,) * inf.params_c[0].ndim
+                                             ].set(jnp.nan)
+        inf.train_batch(bad)
+        assert inf.skipped_steps == 1
+        master_after = jax.tree.leaves(inf.master_params())
+        for a, b in zip(master_before, master_after):
+            np.testing.assert_array_equal(a, b)
+
+    def test_checkpoint_roundtrip(self, devices, tmp_path):
+        cfg, params, batch = tiny_setup()
+        inf = build(cfg, params, {"device": "cpu", "scheduled": True})
+        losses = [float(inf.train_batch(batch)) for _ in range(3)]
+        inf.save_checkpoint(str(tmp_path), tag="t3")
+        l4 = float(inf.train_batch(batch))
+        inf2 = build(cfg, params, {"device": "cpu", "scheduled": True})
+        _, _ = inf2.load_checkpoint(str(tmp_path))
+        assert inf2.global_steps == 3
+        l4b = float(inf2.train_batch(batch))
+        np.testing.assert_allclose(l4b, l4, rtol=1e-6)
+
+    def test_accum_and_clipping_match_plain_engine(self, devices):
+        cfg, params, batch = tiny_setup()
+
+        def mk(offload):
+            zero = {"stage": 0}
+            if offload:
+                zero["offload_optimizer"] = {"device": "cpu",
+                                             "scheduled": True}
+            engine, _, _, _ = dstpu.initialize(
+                loss_fn=llama.loss_fn(cfg), params=params,
+                config={"train_micro_batch_size_per_gpu": 4,
+                        "gradient_accumulation_steps": 2,
+                        "gradient_clipping": 0.5,
+                        "zero_optimization": zero,
+                        "optimizer": {"type": "adamw",
+                                      "params": {"lr": 3e-3}},
+                        "bf16": {"enabled": True}})
+            return engine
+
+        plain, inf = mk(False), mk(True)
+        lp = [float(plain.train_batch(batch)) for _ in range(4)]
+        li = [float(inf.train_batch(batch)) for _ in range(4)]
+        np.testing.assert_allclose(li, lp, rtol=2e-3, atol=2e-3)
