@@ -566,18 +566,10 @@ def initialize(args=None, *, loss_fn: Callable, params: Any,
                 "the config block and drop param_specs/has_aux")
         engine = InfinityEngine(loss_fn, params, config, mesh=mesh,
                                 lr_scheduler=lr_scheduler)
-        dataloader = None
-        if training_data is not None:
-            from deepspeed_tpu.data.loader import DataLoader
-
-            dataloader = DataLoader(training_data,
-                                    batch_size=config.train_batch_size,
-                                    seed=config.seed)
-        return engine, engine.optimizer, dataloader, engine.lr_schedule
-
-    engine = TrainingEngine(loss_fn, params, config, mesh=mesh,
-                            optimizer=optimizer, lr_scheduler=lr_scheduler,
-                            param_specs=param_specs, has_aux=has_aux)
+    else:
+        engine = TrainingEngine(loss_fn, params, config, mesh=mesh,
+                                optimizer=optimizer, lr_scheduler=lr_scheduler,
+                                param_specs=param_specs, has_aux=has_aux)
     dataloader = None
     if training_data is not None:
         from deepspeed_tpu.data.loader import DataLoader

@@ -1,0 +1,326 @@
+"""Continuous-batching serving loop (ref: deepspeed/inference/engine.py's
+generate path and the DeepSpeed-FastGen / inference-v2 direction —
+dynamic admission, paged KV, iteration-level scheduling).
+
+TPU design.  The compiled programs are STATIC-shape and know nothing
+about requests:
+
+  prefill(params, [1, Tbucket] tokens, cache-view)   one admission
+  decode (params, [B, 1] tokens, cache)              one token for ALL slots
+
+The host-side :class:`ServingEngine` owns everything dynamic — a FIFO of
+requests, a slot table (batch row ↔ request), the
+:class:`~deepspeed_tpu.inference.kernels.PageAllocator` free list, and
+per-slot sequence lengths.  Iteration-level scheduling as in FastGen:
+each ``step()`` admits as many queued requests as slots+pages allow
+(one bucketed prefill each), then runs ONE batched decode for every
+active slot.  Completed sequences free their pages immediately; when the
+pool runs dry, the youngest sequence is preempted vLLM-style (pages
+released, request requeued for recompute-from-scratch).
+
+Static-shape tricks worth noting:
+- prompt lengths are padded to ``prefill_bucket`` multiples → bounded
+  compile count; the padded tail's K/V lands beyond the row's seq_len
+  and is never attended to (then overwritten as decode advances).
+- inactive slots' table rows point at a reserved TRASH page: the decode
+  step structurally writes a token for every row, and aiming dead rows
+  at a sacrificial page keeps them from corrupting live sequences.
+- the decode jit donates the cache, so pages update in place in HBM.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.kernels import PagedKVCache, PageAllocator
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclasses.dataclass
+class Request:
+    req_id: Any
+    tokens: List[int]                  # prompt
+    max_new_tokens: int = 32
+    temperature: float = 0.0           # 0 → greedy
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Request
+    seq_len: int                       # tokens resident in the KV cache
+    generated: List[int]
+    rng: jax.Array
+    seq_id: int = -1                   # PageAllocator owner key
+
+
+class ServingEngine:
+    """Host scheduler driving jitted prefill/decode over a paged cache.
+
+    model_fns: ``(prefill_fn, decode_fn)`` with the
+    :func:`~deepspeed_tpu.models.llama.forward_paged` contract
+    ``(params, tokens, cache) -> (logits, cache)``; built automatically
+    for llama via :func:`llama_serving_engine`.
+    """
+
+    def __init__(self, params, prefill_fn, decode_fn, *,
+                 n_layers: int, n_kv: int, head_dim: int,
+                 max_batch: int = 4, page_size: int = 16,
+                 num_pages: int = 128, max_seq: int = 256,
+                 prefill_bucket: int = 32, eos_token_id: Optional[int] = None,
+                 cache_dtype=jnp.bfloat16, seed: int = 0):
+        self.params = params
+        self.eos = eos_token_id
+        self.page_size = page_size
+        self.max_batch = max_batch
+        self.max_seq = max_seq
+        self.prefill_bucket = prefill_bucket
+        self.max_pages_per_seq = -(-max_seq // page_size)
+
+        # last page is the sacrificial target for inactive-slot writes
+        self.trash_page = num_pages - 1
+        self.allocator = PageAllocator(num_pages - 1)
+        self.cache = PagedKVCache(
+            k=jnp.zeros((n_layers, n_kv, num_pages, page_size, head_dim),
+                        cache_dtype),
+            v=jnp.zeros((n_layers, n_kv, num_pages, page_size, head_dim),
+                        cache_dtype),
+            table=jnp.full((max_batch, self.max_pages_per_seq),
+                           self.trash_page, jnp.int32),
+            seq_lens=jnp.zeros((max_batch,), jnp.int32),
+            page_size=page_size)
+
+        self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
+        self._decode = jax.jit(decode_fn, donate_argnums=(2,))
+        self._table_host = np.full((max_batch, self.max_pages_per_seq),
+                                   self.trash_page, np.int32)
+        self.slots: List[Optional[_Slot]] = [None] * max_batch
+        self.queue: "collections.deque[Request]" = collections.deque()
+        self._seq_counter = 0
+        self._rng = jax.random.PRNGKey(seed)
+        self.finished: Dict[Any, List[int]] = {}
+        self._newly_finished: List[Any] = []
+        self.stats = {"admitted": 0, "preempted": 0, "decode_steps": 0}
+
+    # ------------------------------------------------------------- requests
+    def submit(self, req_id, tokens, max_new_tokens: int = 32,
+               temperature: float = 0.0) -> None:
+        tokens = list(map(int, tokens))
+        if not tokens:
+            raise ValueError(f"request {req_id}: empty prompt")
+        if len(tokens) + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"request {req_id}: prompt {len(tokens)} + "
+                f"{max_new_tokens} new > max_seq {self.max_seq}")
+        lifetime_pages = self._pages_needed(len(tokens) + max_new_tokens)
+        usable = self.trash_page  # pool size minus the reserved page
+        if lifetime_pages > usable:
+            raise ValueError(
+                f"request {req_id}: needs {lifetime_pages} pages at full "
+                f"length but the pool has {usable} — it could never "
+                "complete even alone")
+        self.queue.append(Request(req_id, tokens, max_new_tokens,
+                                  temperature))
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(s is not None for s in self.slots)
+
+    # ----------------------------------------------------------- scheduling
+    def _sync_tables(self, rows: List[int]) -> None:
+        t = self.cache.table
+        for b in rows:
+            t = t.at[b].set(jnp.asarray(self._table_host[b]))
+        self.cache = self.cache._replace(table=t)
+
+    def _set_seq_lens(self) -> None:
+        lens = np.zeros((self.max_batch,), np.int32)
+        for b, s in enumerate(self.slots):
+            if s is not None:
+                lens[b] = s.seq_len
+        self.cache = self.cache._replace(seq_lens=jnp.asarray(lens))
+
+    def _free_slot(self) -> Optional[int]:
+        for b, s in enumerate(self.slots):
+            if s is None:
+                return b
+        return None
+
+    def _pages_needed(self, tokens: int) -> int:
+        return -(-tokens // self.page_size)
+
+    def _admit_one(self) -> bool:
+        """Try to admit the head request; returns True if admitted."""
+        if not self.queue:
+            return False
+        b = self._free_slot()
+        if b is None:
+            return False
+        req = self.queue[0]
+        T = len(req.tokens)
+        bkt = self.prefill_bucket
+        # bucket-pad for a bounded compile count, clamped to the table
+        # width (a prompt near max_seq must not pad past the row)
+        Tpad = min(-(-T // bkt) * bkt,
+                   self.max_pages_per_seq * self.page_size)
+        need = self._pages_needed(max(Tpad, T + 1))
+        if len(self.allocator.free) < need:
+            return False
+        self.queue.popleft()
+        seq_id = self._seq_counter
+        self._seq_counter += 1
+        pages = self.allocator.allocate(seq_id, need)
+        self._table_host[b, :] = self.trash_page
+        self._table_host[b, :need] = pages
+        self._sync_tables([b])
+
+        toks = np.full((1, Tpad), 0, np.int32)
+        toks[0, :T] = req.tokens
+        # table row from the HOST copy: a [b:b+1] device slice can alias
+        # the live table buffer (full-range slice), which prefill's cache
+        # donation would then delete out from under the decode path
+        view = PagedKVCache(
+            k=self.cache.k, v=self.cache.v,
+            table=jnp.asarray(self._table_host[b:b + 1]),
+            seq_lens=jnp.zeros((1,), jnp.int32), page_size=self.page_size)
+        logits, view = self._prefill(self.params, jnp.asarray(toks), view)
+        self.cache = self.cache._replace(k=view.k, v=view.v)
+
+        self._rng, rng = jax.random.split(self._rng)
+        slot = _Slot(req=req, seq_len=T, generated=[], rng=rng,
+                     seq_id=seq_id)
+        self.slots[b] = slot
+        self.stats["admitted"] += 1
+        # first generated token comes from the REAL last prompt position
+        self._append_token(b, self._sample(logits[0, T - 1], slot))
+        return True
+
+    def _preempt_youngest(self) -> None:
+        """vLLM-style recompute preemption: release the youngest slot's
+        pages and requeue prompt+generated as a fresh request."""
+        cand = [(len(s.generated), b) for b, s in enumerate(self.slots)
+                if s is not None]
+        if not cand:
+            raise MemoryError("out of KV pages with no slot to preempt")
+        _, b = min(cand)
+        s = self.slots[b]
+        logger.warning("serving: preempting request %r (%d generated)",
+                       s.req.req_id, len(s.generated))
+        self.allocator.release(s.seq_id)
+        self._table_host[b, :] = self.trash_page
+        self._sync_tables([b])
+        self.slots[b] = None
+        req = s.req
+        # requeue prompt+generated for recompute; the finished output is
+        # simply tokens+generated of the FINAL incarnation, which already
+        # contains everything produced before preemption
+        self.queue.appendleft(Request(
+            req.req_id, req.tokens + s.generated,
+            req.max_new_tokens - len(s.generated), req.temperature))
+        self.stats["preempted"] += 1
+
+    def _sample(self, logits_row, slot: _Slot) -> int:
+        from deepspeed_tpu.inference.generation import sample_logits
+
+        slot.rng, r = jax.random.split(slot.rng)
+        tok = sample_logits(logits_row[None], r,
+                            temperature=slot.req.temperature)
+        return int(tok[0])
+
+    def _append_token(self, b: int, tok: int) -> None:
+        s = self.slots[b]
+        s.generated.append(tok)
+        done = (self.eos is not None and tok == self.eos) or \
+            len(s.generated) >= s.req.max_new_tokens
+        if done:
+            self.finished[s.req.req_id] = list(s.req.tokens) + s.generated
+            self._newly_finished.append(s.req.req_id)
+            self.allocator.release(s.seq_id)
+            self._table_host[b, :] = self.trash_page
+            self._sync_tables([b])
+            self.slots[b] = None
+
+    def _grow_pages(self) -> None:
+        """Before a decode write: any slot whose frontier enters a new page
+        needs that page mapped; preempt when the pool is dry."""
+        rows = []
+        for b, s in enumerate(self.slots):
+            if s is None:
+                continue
+            slot_idx = s.seq_len // self.page_size
+            if s.seq_len % self.page_size == 0 and \
+                    self._table_host[b, slot_idx] == self.trash_page:
+                while not self.allocator.free:
+                    self._preempt_youngest()
+                    if self.slots[b] is None:   # we preempted ourselves
+                        break
+                if self.slots[b] is None:
+                    continue
+                pg = self.allocator.allocate(s.seq_id, 1)[0]
+                self._table_host[b, slot_idx] = pg
+                rows.append(b)
+        if rows:
+            self._sync_tables(rows)
+
+    # ------------------------------------------------------------------ step
+    def step(self) -> List[Any]:
+        """One scheduling iteration: admit → batched decode.  Returns
+        request ids that finished during this step."""
+        self._newly_finished = []
+        while self._admit_one():
+            pass
+        active = [(b, s) for b, s in enumerate(self.slots) if s is not None]
+        if active:
+            self._grow_pages()
+            active = [(b, s) for b, s in enumerate(self.slots)
+                      if s is not None]
+        if active:
+            self._set_seq_lens()
+            toks = np.zeros((self.max_batch, 1), np.int32)
+            for b, s in active:
+                toks[b, 0] = s.generated[-1] if s.generated \
+                    else s.req.tokens[-1]
+            logits, cache = self._decode(self.params, jnp.asarray(toks),
+                                         self.cache)
+            # host truth overrides the structural +1 (inactive rows too)
+            self.cache = cache
+            for b, s in active:
+                s.seq_len += 1
+            self._set_seq_lens()
+            self.stats["decode_steps"] += 1
+            for b, s in active:
+                self._append_token(b, self._sample(logits[b, -1], s))
+        return list(self._newly_finished)
+
+    def run(self, max_steps: int = 10_000) -> Dict[Any, List[int]]:
+        """Drive until every submitted request completes."""
+        steps = 0
+        while self.has_work:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError("serving loop did not converge")
+        return dict(self.finished)
+
+    def drain_finished(self) -> Dict[Any, List[int]]:
+        """Hand over and forget completed outputs (long-running servers
+        call this instead of letting ``finished`` grow unboundedly)."""
+        out, self.finished = self.finished, {}
+        return out
+
+
+def llama_serving_engine(params, cfg, **kw) -> ServingEngine:
+    """ServingEngine over models/llama.py's paged forward."""
+    from deepspeed_tpu.models import llama
+
+    def step(params, tokens, cache):
+        return llama.forward_paged(params, tokens, cfg, cache)
+
+    return ServingEngine(
+        params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, **kw)

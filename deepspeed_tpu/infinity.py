@@ -365,7 +365,9 @@ class InfinityEngine:
         return self._last_metrics
 
     def get_lr(self):
-        return [float(self.lr_schedule(jnp.int32(self.global_steps)))]
+        # _opt_steps, not global_steps: the schedule position must match
+        # what group_update actually applied (skipped steps don't advance)
+        return [float(self.lr_schedule(jnp.int32(self._opt_steps)))]
 
     @property
     def train_batch_size(self):
