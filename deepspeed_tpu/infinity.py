@@ -1,18 +1,31 @@
-"""ZeRO-Infinity: optimizer-state streaming scheduled around the step loop.
+"""ZeRO-Infinity: rank-partitioned optimizer-state streaming scheduled
+around the step loop.
 
 Reference: deepspeed/runtime/swap_tensor/partitioned_optimizer_swapper.py +
-partitioned_param_swapper.py — optimizer state (f32 master + moments)
-lives on NVMe (or host RAM), streamed through pinned buffers around each
-sub-group's update, double-buffered so IO overlaps compute.
+partitioned_param_swapper.py — each rank owns a 1/dp PARTITION of the f32
+optimizer state (master + moments), swaps only its partition to NVMe (or
+host RAM), and streams it through pinned buffers around each sub-group's
+update, double-buffered so IO overlaps compute.
 
 TPU design.  The jitted programs never see the tiers — IO cannot live
-inside XLA.  Instead the HOST schedules two compiled programs per step:
+inside XLA.  Instead the HOST schedules two compiled programs per step,
+and the ZeRO partitioning is a GSPMD sharding over the ``data`` mesh
+axis:
 
-    grad_step:    bf16 compute params (resident in HBM) + batch → grads
-    group_update: (master_k, mu_k, nu_k, grads_k, step) → new state_k
-                  + fresh bf16 compute leaves for group k
+    grad_step:    bf16 compute params (replicated in HBM) + sharded batch
+                  → loss + flat grad shards.  Every leaf is raveled,
+                  padded, and reshaped to ``[dp, chunk]`` with an output
+                  sharding of ``P("data")`` — XLA therefore emits a
+                  REDUCE-SCATTER (not an all-reduce): each device ends
+                  the program holding only its 1/dp gradient slice.
+    group_update: (master_k, mu_k, nu_k, grad_k, step) — all
+                  ``[dp, chunk]`` arrays sharded ``P("data")`` — runs the
+                  elementwise Adam math fully parallel over dp, keeps the
+                  new state sharded, and ALL-GATHERS only the fresh bf16
+                  compute leaves back to replicated.
 
-and streams state sub-groups through the C++ aio pool between them::
+Between the two programs the host streams state sub-groups through the
+C++ aio pool::
 
     submit read(k+1)          # into host buffer B[(k+1)%2]
     wait  read(k)             # B[k%2] ready
@@ -21,10 +34,15 @@ and streams state sub-groups through the C++ aio pool between them::
 
 Reads and writes use ALTERNATING aio pools (the pool's wait() fences
 everything it has, so slot-parity pools give per-group fencing and keep
-one group of IO in flight both directions).  HBM residency per step:
-bf16 params + grads + TWO sub-groups of f32 state — the full 12N bytes
-of master+moments never exists on-chip, which is the ZeRO-Infinity
-"peak params per chip" story (BASELINE.json).
+one group of IO in flight both directions).
+
+Each process's tier holds ONLY the rows of the ``[dp, chunk]`` layout
+whose devices it addresses — per-host IO and host RAM are 12N/dp·(local
+devices), exactly the reference's partitioned swapper contract.  Per-chip
+HBM residency per step: 2N bf16 params + 4N/dp grad shard + TWO
+sub-groups of f32 state at 12·N_group/dp — the full 12N bytes of
+master+moments never exists on-chip OR on any single host, which is the
+ZeRO-Infinity "peak params per chip" story (BASELINE.json).
 
 The ``cpu`` tier keeps state as host numpy arrays (no files, same
 schedule).  It is also the CI-testable path: unlike the pinned_host
@@ -34,6 +52,7 @@ engine runs the identical orchestration on the CPU backend.
 
 from __future__ import annotations
 
+import math
 import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -41,6 +60,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu import lr_schedules, precision
 from deepspeed_tpu.config import Config
@@ -48,9 +68,11 @@ from deepspeed_tpu.ops.optim import AdamState, adam, default_lr
 from deepspeed_tpu.topology import MeshSpec
 from deepspeed_tpu.utils.logging import logger
 
+_LANE = 128  # chunk alignment: keep per-device rows lane-aligned
+
 
 class _Tier:
-    """Where the f32 state lives between steps."""
+    """Where this process's f32 state partition lives between steps."""
 
     def put(self, name: str, arr: np.ndarray) -> None:
         raise NotImplementedError
@@ -78,7 +100,7 @@ class _RamTier(_Tier):
 
 
 class _NvmeTier(_Tier):
-    """Flat file per leaf; alternating aio pools for per-slot fencing."""
+    """Flat file per leaf shard; alternating aio pools for per-slot fencing."""
 
     def __init__(self, path: str, n_threads: int = 4):
         from deepspeed_tpu.io.aio import AioHandle
@@ -137,7 +159,7 @@ class _NvmeTier(_Tier):
 
 
 class InfinityEngine:
-    """Host-scheduled ZeRO-Infinity training engine.
+    """Host-scheduled, rank-partitioned ZeRO-Infinity training engine.
 
     Same call surface as :class:`~deepspeed_tpu.engine.TrainingEngine`
     for the common path (``train_batch``, ``global_steps``, ``get_lr``),
@@ -154,6 +176,8 @@ class InfinityEngine:
         config.resolve_batch_sizes(self.mesh.dp_world)
         off = config.zero.offload_optimizer or {}
         self.device_tier = off.get("device", "cpu")
+        dp = self._dp = self.mesh.size("data")
+        self.state_sharding = self.mesh.sharding(P("data"))
 
         opt_type = config.optimizer.type.lower()
         if opt_type not in ("adam", "adamw", "fusedadam"):
@@ -176,21 +200,40 @@ class InfinityEngine:
         self.optimizer = adam(lr=self.lr_schedule, adamw=adamw_mode,
                               **oparams)
 
-        # ---- sub-groups: leaves bucketed to ~sub_group_size elements
-        # (ref: zero config sub_group_size, default 1e9; ours smaller so a
-        # handful of groups exist even for test models)
-        sub_elems = int(config.zero.sub_group_size or 2 ** 24)
+        # ---- partitioned flat layout: each leaf raveled and padded to
+        # [dp, chunk] so P("data") gives every device an equal, contiguous,
+        # lane-aligned 1/dp slice (the GSPMD analogue of the reference's
+        # flat-buffer partitioning in partition_parameters.py)
         flat = jax.tree_util.tree_flatten_with_path(params)
         self._treedef = flat[1]
         self._names: List[str] = []
         self._shapes: List[tuple] = []
+        self._sizes: List[int] = []
+        self._chunks: List[int] = []
         leaves = []
         for path, leaf in flat[0]:
             self._names.append("g" + jax.tree_util.keystr(path)
                                .replace("/", "_"))
             arr = np.asarray(leaf, np.float32)
             self._shapes.append(arr.shape)
+            self._sizes.append(arr.size)
+            self._chunks.append(
+                math.ceil(arr.size / (dp * _LANE)) * _LANE)
             leaves.append(arr)
+
+        # rows of the [dp, chunk] layout this process addresses (multi-host:
+        # a strict subset; single-controller: all of them)
+        idx_map = self.state_sharding.devices_indices_map((dp, 1))
+        pid = jax.process_index()
+        self._local_rows = sorted({
+            (idx[0].start or 0) for dev, idx in idx_map.items()
+            if dev.process_index == pid})
+        n_local = len(self._local_rows)
+
+        # ---- sub-groups: leaves bucketed to ~sub_group_size elements
+        # (ref: zero config sub_group_size, default 1e9; ours smaller so a
+        # handful of groups exist even for test models)
+        sub_elems = int(config.zero.sub_group_size or 2 ** 24)
         groups: List[List[int]] = [[]]
         acc = 0
         for i, arr in enumerate(leaves):
@@ -201,16 +244,21 @@ class InfinityEngine:
             acc += arr.size
         self.groups = groups
 
-        # ---- tiers
+        # ---- tiers (hold ONLY this process's rows: [n_local, chunk])
         if self.device_tier == "nvme":
-            self.tier: _Tier = _NvmeTier(
-                off.get("nvme_path", "/tmp/dstpu_nvme_swap"))
+            # per-process subdir: each process's tier holds a DIFFERENT
+            # row-partition now, so co-hosted processes sharing an
+            # nvme_path must not write the same leaf files
+            self.tier: _Tier = _NvmeTier(os.path.join(
+                off.get("nvme_path", "/tmp/dstpu_nvme_swap"),
+                f"proc{jax.process_index()}"))
         else:
             self.tier = _RamTier()
-        for name, arr in zip(self._names, leaves):
-            self.tier.put(name, arr)
+        for i, (name, arr) in enumerate(zip(self._names, leaves)):
+            rows = self._partition_host(arr, i)
+            self.tier.put(name, rows)
             for kind in ("m", "v"):
-                self.tier.put(kind + name, np.zeros_like(arr))
+                self.tier.put(kind + name, np.zeros_like(rows))
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
 
@@ -226,6 +274,7 @@ class InfinityEngine:
         grad_dtype = jnp.bfloat16 if off.get("bf16_grads") else jnp.float32
         accum = config.gradient_accumulation_steps
         clip = config.gradient_clipping
+        sizes, chunks = self._sizes, self._chunks
 
         def grad_step(params_c_list, batch):
             p = jax.tree_util.tree_unflatten(self._treedef, params_c_list)
@@ -265,14 +314,31 @@ class InfinityEngine:
 
                 g, _ = clip_by_global_norm(g, clip)
             gl = jax.tree.leaves(g)
-            return loss, ok, [x.astype(grad_dtype) for x in gl]
+            # ravel+pad each leaf to [dp, chunk]; the P("data") output
+            # sharding turns the implicit grad all-reduce into a
+            # reduce-scatter (ref: stage_1_and_2.py reduce_scatter_gradients)
+            out = []
+            for x, n, c in zip(gl, sizes, chunks):
+                f = x.reshape(-1).astype(grad_dtype)
+                f = jnp.concatenate(
+                    [f, jnp.zeros(dp * c - n, grad_dtype)]) \
+                    if dp * c > n else f
+                out.append(f.reshape(dp, c))
+            return loss, ok, out
 
+        # params_c donated: every entry is replaced from group_update
+        # outputs before the next call, and freeing them here keeps grads
+        # from coexisting with two param copies in HBM (round-2 weak #2)
         self._grad_fn = jax.jit(
-            grad_step, in_shardings=(None, self.batch_sharding))
+            grad_step,
+            in_shardings=(None, self.batch_sharding),
+            out_shardings=(None, None,
+                           [self.state_sharding] * len(leaves)),
+            donate_argnums=(0,))
 
         cdt = self._compute_dtype
 
-        def group_update(master, mu, nu, grads, step, ok):
+        def group_update(k, master, mu, nu, grads, step, ok):
             st = AdamState(step, mu, nu)
             grads = [g.astype(jnp.float32) for g in grads]
             updates, new_st = self.optimizer.update(grads, st, master)
@@ -282,10 +348,34 @@ class InfinityEngine:
                               master)
             new_mu = keep(new_st.mu, mu)
             new_nu = keep(new_st.nu, nu)
-            compute = [p.astype(cdt) for p in new_master]
+            # fresh compute leaves: unpad, reshape, cast — the replicated
+            # output sharding below makes this the bf16 param all-gather
+            compute = [
+                m.reshape(-1)[:self._sizes[i]]
+                .reshape(self._shapes[i]).astype(cdt)
+                for m, i in zip(new_master, self.groups[k])]
             return new_master, new_mu, new_nu, compute
 
-        self._update_fn = jax.jit(group_update, donate_argnums=(0, 1, 2, 3))
+        def _upd_out_shardings(k):
+            g = [self.state_sharding] * len(self.groups[k])
+            return (g, g, g, [self.mesh.replicated()] * len(self.groups[k]))
+
+        self._update_fns = [
+            jax.jit(lambda m, mu, nu, gr, s, ok, _k=k: group_update(
+                _k, m, mu, nu, gr, s, ok),
+                out_shardings=_upd_out_shardings(k),
+                # grads excluded: no output matches their shape/sharding,
+                # so donating them only trips the unusable-donation warning
+                donate_argnums=(0, 1, 2))
+            for k in range(len(groups))]
+
+        # per-leaf unpad/reshape/cast restorers for the failure-recovery
+        # path, built once so repeated recoveries hit the jit cache
+        self._restore_fns = [
+            jax.jit(lambda a, _i=i: a.reshape(-1)[:sizes[_i]]
+                    .reshape(self._shapes[_i]).astype(cdt),
+                    out_shardings=repl)
+            for i in range(len(leaves))]
 
         self.global_steps = 0
         self._opt_steps = 0            # advances only on finite steps
@@ -293,60 +383,127 @@ class InfinityEngine:
         self._last_metrics: Dict[str, Any] = {}
         self.step_times: List[float] = []
         logger.info(
-            "InfinityEngine: tier=%s groups=%d (%s elems) params=%d",
-            self.device_tier, len(groups), sub_elems,
-            sum(int(np.prod(s)) for s in self._shapes))
+            "InfinityEngine: tier=%s dp=%d local_rows=%d groups=%d "
+            "(%s elems) params=%d",
+            self.device_tier, dp, n_local, len(groups), sub_elems,
+            sum(self._sizes))
+
+    # -------------------------------------------------- partition helpers
+    def _partition_host(self, arr: np.ndarray, i: int) -> np.ndarray:
+        """Full leaf (host) → this process's rows of the [dp, chunk] layout."""
+        c = self._chunks[i]
+        flat = np.zeros(self._dp * c, np.float32)
+        flat[:arr.size] = arr.reshape(-1)
+        return np.ascontiguousarray(flat.reshape(self._dp, c)[self._local_rows])
+
+    def _rows_to_device(self, rows: np.ndarray, i: int) -> jax.Array:
+        """Local host rows → global [dp, chunk] array sharded P("data")."""
+        return jax.make_array_from_process_local_data(
+            self.state_sharding, np.ascontiguousarray(rows),
+            (self._dp, self._chunks[i]))
+
+    @staticmethod
+    def _rows_to_host(arr: jax.Array) -> np.ndarray:
+        """Sharded [dp, chunk] array → this process's rows (np, row order)."""
+        rows: Dict[int, np.ndarray] = {}
+        for s in arr.addressable_shards:
+            r = s.index[0].start or 0
+            if r not in rows:
+                rows[r] = np.asarray(s.data)
+        return np.concatenate([rows[r] for r in sorted(rows)], axis=0)
+
+    def _assemble(self, rows: np.ndarray, i: int) -> np.ndarray:
+        """Local rows → full unpadded leaf.  Single-controller only (every
+        row local); multi-host consolidation would need a cross-host
+        gather, which checkpoint/export callers should do via the sharded
+        arrays instead."""
+        if len(self._local_rows) != self._dp:
+            raise NotImplementedError(
+                "consolidating a partitioned tier across processes")
+        return rows.reshape(-1)[:self._sizes[i]].reshape(self._shapes[i])
 
     # ------------------------------------------------------------------ step
     def _submit_group_read(self, k: int):
-        """Begin fetching group k's (master, mu, nu) from the tier."""
+        """Begin fetching group k's (master, mu, nu) rows from the tier."""
         bufs = []
+        n_local = len(self._local_rows)
         for i in self.groups[k]:
-            n, s = self._names[i], self._shapes[i]
-            bufs.append((self.tier.get_submit(n, s, np.float32),
-                         self.tier.get_submit("m" + n, s, np.float32),
-                         self.tier.get_submit("v" + n, s, np.float32)))
+            n, shape = self._names[i], (n_local, self._chunks[i])
+            bufs.append((self.tier.get_submit(n, shape, np.float32),
+                         self.tier.get_submit("m" + n, shape, np.float32),
+                         self.tier.get_submit("v" + n, shape, np.float32)))
         return bufs
+
+    def _restore_params_from_tier(self) -> None:
+        """Rebuild the compute-param leaves from the tier's master rows.
+
+        Recovery path for a mid-step failure: ``_grad_fn`` donated the old
+        ``params_c`` buffers, so an exception between it and the last
+        group update would otherwise leave the engine pointing at deleted
+        arrays.  Each leaf is restored from whatever the tier coherently
+        holds (groups already written this step keep their new values)."""
+        if isinstance(self.tier, _NvmeTier):
+            self.tier.fence_all()
+        n_local = len(self._local_rows)
+        for i, n in enumerate(self._names):
+            rows = self.tier.get_submit(
+                n, (n_local, self._chunks[i]), np.float32)
+            self.tier.fence_reads()
+            self.params_c[i] = self._restore_fns[i](
+                self._rows_to_device(np.array(rows), i))
+        if isinstance(self.tier, _NvmeTier):
+            self.tier.fence_all()
 
     def train_batch(self, batch) -> jnp.ndarray:
         t0 = time.perf_counter()
         nvme = isinstance(self.tier, _NvmeTier)
-        loss, ok, grads = self._grad_fn(self.params_c, batch)  # async
-        step = jnp.int32(self._opt_steps)
+        try:
+            loss, ok, grads = self._grad_fn(self.params_c, batch)  # async
+            step = jnp.int32(self._opt_steps)
+            pending = self._submit_group_read(0)
+            for k, group in enumerate(self.groups):
+                if nvme:
+                    self.tier.fence_reads()  # group k's buffers are ready
+                    self.tier.next_read_slot()
+                bufs = pending
+                if k + 1 < len(self.groups):
+                    pending = self._submit_group_read(k + 1)  # overlap read
+                master = [self._rows_to_device(b[0], i)
+                          for b, i in zip(bufs, group)]
+                mu = [self._rows_to_device(b[1], i)
+                      for b, i in zip(bufs, group)]
+                nu = [self._rows_to_device(b[2], i)
+                      for b, i in zip(bufs, group)]
+                g_k = [grads[i] for i in group]
+                new_master, new_mu, new_nu, compute = self._update_fns[k](
+                    master, mu, nu, g_k, step, ok)
+                for j, i in enumerate(group):
+                    self.params_c[i] = compute[j]
+                # device → host (async), then async write to the tier
+                for t in (new_master, new_mu, new_nu):
+                    for x in t:
+                        x.copy_to_host_async()
+                if nvme:
+                    # reuse of this write slot two groups on: fence it
+                    self.tier.fence_writes()
+                for j, i in enumerate(group):
+                    n = self._names[i]
+                    self.tier.put(n, self._rows_to_host(new_master[j]))
+                    self.tier.put("m" + n, self._rows_to_host(new_mu[j]))
+                    self.tier.put("v" + n, self._rows_to_host(new_nu[j]))
+                if nvme:
+                    self.tier.next_write_slot()
 
-        pending = self._submit_group_read(0)
-        for k, group in enumerate(self.groups):
             if nvme:
-                self.tier.fence_reads()      # group k's buffers are ready
-                self.tier.next_read_slot()
-            bufs = pending
-            if k + 1 < len(self.groups):
-                pending = self._submit_group_read(k + 1)   # overlap read
-            master = [jnp.asarray(b[0]) for b in bufs]
-            mu = [jnp.asarray(b[1]) for b in bufs]
-            nu = [jnp.asarray(b[2]) for b in bufs]
-            g_k = [grads[i] for i in group]
-            new_master, new_mu, new_nu, compute = self._update_fn(
-                master, mu, nu, g_k, step, ok)
-            for j, i in enumerate(group):
-                self.params_c[i] = compute[j]
-            # device → host (async), then async write to the tier
-            for t in (new_master, new_mu, new_nu):
-                for x in t:
-                    x.copy_to_host_async()
-            if nvme:
-                # reuse of this write slot two groups from now: fence it
-                self.tier.fence_writes()
-            for j, i in enumerate(group):
-                n = self._names[i]
-                self.tier.put(n, np.asarray(new_master[j]))
-                self.tier.put("m" + n, np.asarray(new_mu[j]))
-                self.tier.put("v" + n, np.asarray(new_nu[j]))
-            if nvme:
-                self.tier.next_write_slot()
-
-        if nvme:
-            self.tier.fence_all()   # read-after-write safety for next step
+                self.tier.fence_all()  # read-after-write for next step
+        except BaseException:
+            # params_c were donated to _grad_fn; rebuild them so the
+            # engine stays usable after a caught IO error or an
+            # interrupt (KeyboardInterrupt is a BaseException).  Also
+            # covers a retry whose _grad_fn call itself trips over
+            # already-deleted arrays from a previous failure.
+            self._restore_params_from_tier()
+            raise
         self.global_steps += 1
         ok_host = bool(ok)
         if ok_host:
@@ -376,27 +533,36 @@ class InfinityEngine:
     def hbm_state_bytes(self) -> int:
         """Bytes of persistent train state resident on device: just the
         compute-dtype param copy (2N for bf16).  The f32 master + moments
-        (12N) live on the tier and only ~2 sub-groups of them transit HBM
-        during a step — that delta is the streaming contract."""
+        (12N) live dp-partitioned on the tier and only ~2 sub-groups of
+        1/dp slices transit HBM during a step — that delta is the
+        streaming contract."""
         return sum(x.nbytes for x in self.params_c)
+
+    def tier_local_bytes(self) -> int:
+        """Bytes of f32 state this PROCESS's tier holds (12N·local/dp)."""
+        n_local = len(self._local_rows)
+        return sum(12 * n_local * c for c in self._chunks)
 
     # ---------------------------------------------------------- checkpoint
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None):
         """Persist the tier + counters (ref: the reference swaps state to
-        NVMe but still checkpoints through the engine; ours writes one
-        npz — the tier already holds everything as host arrays)."""
+        NVMe but still checkpoints through the engine).  Leaves are saved
+        CONSOLIDATED and unpadded so checkpoints restore across different
+        dp widths."""
         import json
 
         tag = tag or f"global_step{self.global_steps}"
         d = os.path.join(save_dir, tag)
         os.makedirs(d, exist_ok=True)
+        n_local = len(self._local_rows)
         arrays = {}
-        for n, s in zip(self._names, self._shapes):
+        for i, n in enumerate(self._names):
             for kind in ("", "m", "v"):
-                buf = self.tier.get_submit(kind + n, s, np.float32)
+                buf = self.tier.get_submit(
+                    kind + n, (n_local, self._chunks[i]), np.float32)
                 self.tier.fence_reads()
-                arrays[kind + n] = np.array(buf)
+                arrays[kind + n] = self._assemble(np.array(buf), i)
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
         np.savez(os.path.join(d, "infinity_state.npz"), **arrays)
@@ -422,8 +588,8 @@ class InfinityEngine:
         repl = self.mesh.replicated()
         for i, n in enumerate(self._names):
             for kind in ("", "m", "v"):
-                self.tier.put(kind + n, np.ascontiguousarray(
-                    arrays[kind + n]))
+                self.tier.put(kind + n, self._partition_host(
+                    np.ascontiguousarray(arrays[kind + n]), i))
             self.params_c[i] = jax.device_put(
                 jnp.asarray(arrays[n], self._compute_dtype), repl)
         if isinstance(self.tier, _NvmeTier):
@@ -436,12 +602,14 @@ class InfinityEngine:
         return d, meta.get("client_state", {})
 
     def master_params(self) -> Any:
-        """Consolidated f32 master pytree (reads the whole tier)."""
+        """Consolidated f32 master pytree (reads the whole local tier)."""
+        n_local = len(self._local_rows)
         out = []
-        for n, s in zip(self._names, self._shapes):
-            buf = self.tier.get_submit(n, s, np.float32)
+        for i, n in enumerate(self._names):
+            buf = self.tier.get_submit(
+                n, (n_local, self._chunks[i]), np.float32)
             self.tier.fence_reads()
-            out.append(np.array(buf))
+            out.append(self._assemble(np.array(buf), i))
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
         return jax.tree_util.tree_unflatten(self._treedef, out)

@@ -64,6 +64,7 @@ class TestInfinityEngine:
         ln = [float(nvme.train_batch(batch)) for _ in range(4)]
         np.testing.assert_allclose(ln, lr_, rtol=1e-6, atol=1e-6)
 
+    @pytest.mark.slow
     def test_multi_group_double_buffer_matches_single_group(self, devices):
         cfg, params, batch = tiny_setup()
         one = build(cfg, params, {
@@ -148,6 +149,32 @@ class TestInfinityEngine:
         l4b = float(inf2.train_batch(batch))
         np.testing.assert_allclose(l4b, l4, rtol=1e-6)
 
+    def test_state_is_partitioned_over_dp(self, devices):
+        # ref partitioned_optimizer_swapper.py: each RANK owns 1/dp of the
+        # f32 state and swaps only its partition.  Here: the tier holds
+        # [dp_local, chunk] rows, and on-device state arrays place exactly
+        # one row per data-axis device.
+        cfg, params, batch = tiny_setup()
+        inf = build(cfg, params, {"device": "cpu", "scheduled": True})
+        dp = inf._dp
+        assert dp == 8
+        inf.train_batch(batch)
+        rows = inf.tier.get_submit(
+            inf._names[0], (len(inf._local_rows), inf._chunks[0]),
+            np.float32)
+        assert rows.shape == (dp, inf._chunks[0])
+        arr = inf._rows_to_device(rows, 0)
+        shard_shapes = {s.data.shape for s in arr.addressable_shards}
+        assert shard_shapes == {(1, inf._chunks[0])}
+        # per-process tier bytes = 12N_padded / dp * local rows
+        assert inf.tier_local_bytes() == sum(
+            12 * dp * c for c in inf._chunks)  # single-controller: all rows
+        # round-trip through the partitioned layout is exact
+        leaf0 = np.asarray(jax.tree.leaves(params)[0], np.float32)
+        np.testing.assert_array_equal(
+            inf._assemble(inf._partition_host(leaf0, 0), 0), leaf0)
+
+    @pytest.mark.slow
     def test_accum_and_clipping_match_plain_engine(self, devices):
         cfg, params, batch = tiny_setup()
 

@@ -57,6 +57,7 @@ class TestLlama:
         losses = [float(engine.train_batch({"tokens": toks})) for _ in range(10)]
         assert losses[-1] < losses[0] * 0.8
 
+    @pytest.mark.slow
     def test_tp_matches_single(self, devices):
         """TP=2 + ZeRO-3 forward/backward == replicated run."""
         cfg = llama.LlamaConfig.tiny(dim=64)
@@ -100,6 +101,7 @@ class TestLlama:
 
 
 class TestGPT2:
+    @pytest.mark.slow
     def test_forward_and_train(self, devices):
         cfg = gpt2.GPT2Config.tiny()
         params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
@@ -117,6 +119,7 @@ class TestGPT2:
 
 
 class TestCNN:
+    @pytest.mark.slow
     def test_cifar_train(self, devices):
         params = cnn.init_params(jax.random.PRNGKey(0))
         rng = np.random.default_rng(0)
@@ -131,6 +134,7 @@ class TestCNN:
         assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_graft_entry(devices):
     sys_path_hack = __import__("sys").path
     if "/root/repo" not in sys_path_hack:

@@ -178,6 +178,7 @@ def test_top2_combine_normalized():
     np.testing.assert_allclose(w[dispatched], 1.0, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_layer_runs_and_shards():
     ms = MeshSpec.build({"expert": 4, "data": 2})
     cfg = MoEConfig(enabled=True, num_experts=4, top_k=2,

@@ -1,6 +1,7 @@
 """End-to-end pipeline parallelism on tiny llama: PP=2 must match DP-only
 (SURVEY.md §4 parallel-equivalence strategy)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -32,6 +33,7 @@ def test_llama_pipelined_forward_matches():
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_llama_pp2_training_matches_dp():
     cfg = llama.LlamaConfig.tiny(attn_impl="reference")
     params = llama.init_params(jax.random.PRNGKey(0), cfg)

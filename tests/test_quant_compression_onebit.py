@@ -72,6 +72,7 @@ def test_quantized_all_gather():
     assert out.shape == (8, 16)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=0.05)
 
+@pytest.mark.slow
 def test_quantized_reduce_scatter_matches_psum_scatter():
     mesh = _mesh8()
     rng = np.random.RandomState(4)

@@ -40,6 +40,7 @@ PROMPTS = {
 
 
 class TestServing:
+    @pytest.mark.slow
     def test_staggered_arrivals_match_offline_greedy(self, model, devices):
         cfg, params = model
         eng = llama_serving_engine(
@@ -59,6 +60,7 @@ class TestServing:
             assert outs[rid] == want, \
                 f"{rid}: served {outs[rid]} != offline {want}"
 
+    @pytest.mark.slow
     def test_more_requests_than_slots(self, model, devices):
         cfg, params = model
         eng = llama_serving_engine(

@@ -225,6 +225,7 @@ class TestRuntimeExtras:
                                jax.random.PRNGKey(0), deterministic=True)
         np.testing.assert_allclose(out, x * 3)
 
+    @pytest.mark.slow
     def test_apply_layer_drop_unbiased_at_intermediate_p(self):
         # E[out] over rng must be x + f(x) for 0<p<1 (advisor r1: the old
         # impl scaled the identity path too, giving x/p + f(x)/p when kept)

@@ -18,6 +18,7 @@ def _mesh(sizes):
     return MeshSpec.build(sizes)
 
 
+@pytest.mark.slow
 def test_pytree_specs_through_engine_stages(devices):
     """A dict-of-PartitionSpec (gpt2.param_specs) through TrainingEngine."""
     cfg = gpt2.GPT2Config.tiny()
