@@ -80,9 +80,9 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None):
 
 def consolidate_to_fp32(engine):
     """Gather a replicated float32 param pytree (ref: zero_to_fp32.py)."""
-    from deepspeed_tpu import zero
-
-    params = zero.unshard_params(engine.state.params, engine.mesh)
+    # module_params handles every state layout (ZeRO sharded leaves, the
+    # qwZ flat [world, chunk] buffer, ...)
+    params = engine.module_params()
     return jax.tree.map(lambda p: np.asarray(p, np.float32)
                         if np.issubdtype(np.asarray(p).dtype, np.floating)
                         else np.asarray(p), params)

@@ -27,6 +27,14 @@ Two modes, both selected purely from the user config:
   ``sign(momentum)`` int8 + group scales travel (≈32× compression),
   with per-device error feedback carried in engine state as a
   ``[world, ...]`` stacked buffer (each device owns its slice).
+* ``qwz``  — ``zero_optimization.zero_quantized_weights: true`` (requires
+  stage 3).  A manual ZeRO-3: the f32 master params live as ONE flat
+  ``[world, chunk]`` buffer with each device owning its row; every step
+  the row is group-quantized and all-gathered as int8(+scales) — the
+  ZeRO++ qwZ weight collective — dequantized into compute-dtype model
+  leaves for the local grad computation, and the flat gradient is
+  reduce-scattered back to the owner row (quantized too when qgZ is
+  also enabled) for an elementwise local optimizer update.
 
 Mesh gate: compression needs the data axis to be the ONLY partitioned
 axis (pipe/model/seq/expert all 1) — inside ``shard_map`` every named
@@ -63,9 +71,12 @@ def resolve_mode(config, ms: MeshSpec, optimizer_name: str,
     name = optimizer_name.lower()
     wants_onebit = name.startswith("onebit") or name.startswith("zeroone")
     wants_qgz = bool(config.zero.zeropp_quantized_gradients)
-    if not (wants_onebit or wants_qgz):
+    wants_qwz = bool(config.zero.zeropp_quantized_weights)
+    if not (wants_onebit or wants_qgz or wants_qwz):
         return None
-    what = "1-bit optimizer" if wants_onebit else "ZeRO++ quantized gradients"
+    what = ("1-bit optimizer" if wants_onebit
+            else "ZeRO++ quantized weights" if wants_qwz
+            else "ZeRO++ quantized gradients")
 
     others = [a for a in ("pipe", "model", "seq", "expert") if ms.size(a) > 1]
     if others:
@@ -82,6 +93,10 @@ def resolve_mode(config, ms: MeshSpec, optimizer_name: str,
             "compress, running the plain path", what)
         return None
     if wants_onebit:
+        if wants_qwz:
+            raise ValueError(
+                "1-bit optimizers cannot combine with zero_quantized_weights "
+                "(1-bit needs stage 0; qwZ is a stage-3 feature)")
         if config.zero.stage > 0:
             raise ValueError(
                 "1-bit optimizers are incompatible with ZeRO stages >= 1 "
@@ -93,11 +108,29 @@ def resolve_mode(config, ms: MeshSpec, optimizer_name: str,
                 "loss scaling would interact with frozen variance); use "
                 '"bf16": {"enabled": true}')
         return "onebit"
+    if wants_qwz:
+        if config.zero.stage != 3:
+            raise ValueError(
+                "zero_quantized_weights is a stage-3 feature (it compresses "
+                "the stage-3 param all-gather, ref ZeRO++ qwZ); set "
+                "zero_optimization.stage: 3 or drop the flag")
+        if config.precision.is_fp16:
+            raise ValueError(
+                "zero_quantized_weights requires bf16/fp32 (the flat-shard "
+                'step has no fp16 loss-scaling path); use "bf16": '
+                '{"enabled": true}')
+        if not any(n in name for n in
+                   ("adam", "lion", "sgd", "adagrad", "momentum")):
+            raise ValueError(
+                f"zero_quantized_weights runs the optimizer on flat 1/dp "
+                f"shards, which needs elementwise update math; {name!r} "
+                f"(per-tensor trust ratios etc.) is not supported")
+        return "qwz"
     if config.zero.stage >= 3:
         raise ValueError(
-            "zero_quantized_gradients supports stages 0-2 (stage 3 params "
-            "are data-sharded and would need a manual all-gather inside "
-            "the compressed region)")
+            "zero_quantized_gradients alone supports stages 0-2; for "
+            "stage 3 also enable zero_quantized_weights — the combined "
+            "qwZ step carries int8 both directions")
     return "qgz"
 
 
@@ -181,6 +214,24 @@ def accumulate_local_grads(grad_fn: Callable, params: Any, batch: Any,
         return jax.tree.map(lambda g: g / accum, grads), lsum / accum
     grads, loss = grad_fn(params, batch)
     return jax.tree.map(lambda g: g.astype(jnp.float32), grads), loss
+
+
+# ------------------------------------------------ qwZ weight collective
+def quantized_weight_gather(row: jnp.ndarray, axis_name: str = AXIS,
+                            bits: int = 8) -> jnp.ndarray:
+    """ZeRO++ qwZ: materialize the full flat param buffer from each
+    device's 1/world row with int8(+scales) on the wire (call under
+    shard_map).  ``row``: this device's ``[chunk]`` master shard, chunk a
+    multiple of ``_GROUP``.  Returns the dequantized ``[world*chunk]``
+    flat buffer (lossy: the forward sees group-quantized weights, same
+    trade the reference makes, ref zero_quantized_weights)."""
+    from deepspeed_tpu.ops.quant import dequantize, quantize
+
+    q, s, _ = quantize(row, bits=bits, num_groups=row.shape[0] // _GROUP)
+    qg = jax.lax.all_gather(q, axis_name)                       # int8 wire
+    sg = jax.lax.all_gather(s, axis_name)
+    full = jax.vmap(lambda qq, ss: dequantize(qq, ss, bits=bits))(qg, sg)
+    return full.reshape(-1)
 
 
 # ----------------------------------------------------- local-grad harness

@@ -42,6 +42,34 @@ class TrainState(NamedTuple):
     scaler: precision.ScalerState
 
 
+def accum_split(batch: Any, accum: int, dp_world: int) -> Any:
+    """[B, ...] → [accum, B/accum, ...] microbatch split with NO
+    cross-device movement.
+
+    A naive reshape takes CONTIGUOUS row blocks as microbatches, which
+    under a data-sharded batch makes XLA all-gather the whole batch onto
+    every device (measured: +2 all-gathers per step at dp=8 accum=4,
+    see ACCUM_AUDIT.json / tools/accum_reshard_audit.py).  Any partition
+    of rows into microbatches is an equally valid accumulation split —
+    the accumulated gradient is the mean over ALL rows either way — so
+    split each device's LOCAL rows instead: view [dp, accum, mb_local],
+    swap to microbatch-major.  The sharded leading dim is only
+    relabeled, and XLA compiles the whole split to zero collectives.
+    """
+    def f(x):
+        B = x.shape[0]
+        if dp_world <= 1 or B % (dp_world * accum):
+            # undersized/odd batches (smaller than the configured global
+            # batch) keep the naive split — correctness over comms
+            return x.reshape((accum, B // accum) + x.shape[1:])
+        mb = B // (dp_world * accum)
+        y = x.reshape((dp_world, accum, mb) + x.shape[1:])
+        y = jnp.swapaxes(y, 0, 1)
+        return y.reshape((accum, B // accum) + x.shape[1:])
+
+    return jax.tree.map(f, batch)
+
+
 def global_norm(tree: Any) -> jnp.ndarray:
     leaves = [jnp.sum(jnp.square(l.astype(jnp.float32)))
               for l in jax.tree.leaves(tree)]
@@ -49,10 +77,16 @@ def global_norm(tree: Any) -> jnp.ndarray:
 
 
 def clip_by_global_norm(tree: Any, max_norm: float) -> Tuple[Any, jnp.ndarray]:
-    """ref: deepspeed/runtime/utils.py clip_grad_norm_."""
+    """ref: deepspeed/runtime/utils.py clip_grad_norm_.
+
+    The factor multiply preserves each leaf's dtype: an f32 scalar times
+    a bf16 tree would type-promote the WHOLE tree to f32 — a transient
+    full-size copy that defeats bf16-grad memory budgets (the norm
+    itself is still accumulated in f32 by global_norm)."""
     norm = global_norm(tree)
     factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
-    return jax.tree.map(lambda g: g * factor, tree), norm
+    return jax.tree.map(
+        lambda g: g * factor.astype(g.dtype), tree), norm
 
 
 class TrainingEngine:
@@ -155,6 +189,14 @@ class TrainingEngine:
             lambda p: jnp.asarray(p, mdt)
             if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
             params)
+        if self.grad_comm_mode == "qwz":
+            if config.zero.offload_param or config.zero.offload_optimizer:
+                raise ValueError(
+                    "zero_quantized_weights does not compose with offload "
+                    "(the flat-shard step owns the param layout); use the "
+                    "scheduled Infinity engine or drop the qwZ flag")
+            self._setup_qwz_state(params, mdt)
+            return self._finish_init()
         self.param_shardings = zero.param_shardings(
             params, self.mesh, stage, param_specs)
         opt_state_shape = jax.eval_shape(self.optimizer.init, params)
@@ -186,7 +228,11 @@ class TrainingEngine:
                 scaler=precision.scaler_init(config.precision)),
             out_shardings=self.state_shardings)
         self.state = init_fn(params)
+        self._finish_init()
 
+    def _finish_init(self) -> None:
+        """Shared __init__ tail: compile the step fns, host bookkeeping."""
+        config = self.config
         # ---- the compiled step.  The batch sharding (a pytree prefix — one
         # NamedSharding broadcast to every leaf) splits the batch dim over
         # the data axes so each chip receives only its slice.
@@ -216,10 +262,154 @@ class TrainingEngine:
         self._skipped_acc = jnp.zeros([], jnp.int32)
         self._skipped_base = 0
         logger.info(
-            "TrainingEngine: zero=%d mesh=%s micro=%d accum=%d global=%d dtype=%s",
-            stage, self.mesh.sizes, config.train_micro_batch_size_per_gpu,
+            "TrainingEngine: zero=%d mesh=%s micro=%d accum=%d global=%d "
+            "dtype=%s comm=%s",
+            config.zero.stage, self.mesh.sizes,
+            config.train_micro_batch_size_per_gpu,
             config.gradient_accumulation_steps, config.train_batch_size,
-            config.precision.dtype)
+            config.precision.dtype, self.grad_comm_mode or "exact")
+
+    # ------------------------------------------------------- qwZ flat state
+    def _setup_qwz_state(self, params, mdt) -> None:
+        """ZeRO++ qwZ layout (ref zero_quantized_weights): master params as
+        ONE flat ``[world, chunk]`` f32 buffer, each data-axis device
+        owning a row.  The step all-gathers the rows as int8(+scales) to
+        rebuild compute-dtype model leaves, so the param collective
+        carries ~1/2 the bytes of the bf16 all-gather GSPMD would emit
+        for plain stage 3 (and ~1/4 of f32)."""
+        import numpy as _np
+
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu import comm_compress
+
+        leaves, self._qwz_treedef = jax.tree.flatten(params)
+        self._qwz_shapes = [l.shape for l in leaves]
+        self._qwz_sizes = [int(_np.prod(l.shape)) if l.ndim else 1
+                           for l in leaves]
+        total = sum(self._qwz_sizes)
+        W = self.mesh.size("data")
+        unit = comm_compress._GROUP
+        self._qwz_chunk = -(-total // (W * unit)) * unit
+        sh = self.mesh.sharding(P("data"))
+        repl = self.mesh.replicated()
+        flat_shape = (W, self._qwz_chunk)
+        opt_shape = jax.eval_shape(
+            self.optimizer.init, jax.ShapeDtypeStruct(flat_shape, mdt))
+        self.param_shardings = sh
+        self.opt_shardings = jax.tree.map(
+            lambda x: sh if getattr(x, "ndim", 0) == 2 else repl, opt_shape)
+        self.state_shardings = TrainState(
+            step=repl, params=sh, opt_state=self.opt_shardings,
+            scaler=precision.ScalerState(repl, repl))
+
+        def make_state(p):
+            flat = self._qwz_flatten(p, mdt).reshape(flat_shape)
+            return TrainState(
+                step=jnp.zeros([], jnp.int32), params=flat,
+                opt_state=self.optimizer.init(flat),
+                scaler=precision.scaler_init(self.config.precision))
+
+        self.state = jax.jit(
+            make_state, out_shardings=self.state_shardings)(params)
+
+    def _qwz_flatten(self, tree, dtype):
+        """Ravel a params-shaped pytree into the padded flat buffer."""
+        leaves = jax.tree.leaves(tree)
+        flat = jnp.concatenate([jnp.ravel(l).astype(dtype) for l in leaves])
+        pad = self.mesh.size("data") * self._qwz_chunk - flat.shape[0]
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros(pad, dtype)])
+        return flat
+
+    def _qwz_unflatten(self, flat, dtype):
+        """Flat buffer (unpadded prefix) → params-shaped pytree."""
+        out, off = [], 0
+        for shape, n in zip(self._qwz_shapes, self._qwz_sizes):
+            out.append(flat[off:off + n].reshape(shape).astype(dtype))
+            off += n
+        return jax.tree_util.tree_unflatten(self._qwz_treedef, out)
+
+    def _qwz_train_step(self, state: TrainState, batch, accum: int):
+        """Manual ZeRO-3 with compressed collectives, all under shard_map
+        over the data axis: int8 param all-gather (qwZ) → local grads →
+        gradient reduce-scatter back to the owner row (int8 all-to-all
+        when qgZ is also on, exact psum-scatter otherwise) → elementwise
+        optimizer update on the local 1/world shard."""
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu import comm_compress
+
+        ms = self.mesh
+        cfg = self.config
+        W = ms.size("data")
+        C = self._qwz_chunk
+        cdt = precision.compute_dtype(cfg.precision)
+        qgz_wire = bool(cfg.zero.zeropp_quantized_gradients)
+        clip = cfg.gradient_clipping
+
+        def f(pflat, opt_state, mb):
+            row = pflat[0]                          # [C] f32 master shard
+            full = comm_compress.quantized_weight_gather(row)
+            params = self._qwz_unflatten(full, cdt)
+
+            def local_gf(p, m):
+                loss, g = jax.value_and_grad(
+                    lambda pp: self._loss_for(pp, m)[0])(p)
+                return g, loss
+
+            grads, loss = comm_compress.accumulate_local_grads(
+                local_gf, params, mb, accum)
+            gflat = self._qwz_flatten(grads, jnp.float32)     # [W*C]
+            if qgz_wire:
+                from deepspeed_tpu.ops.quant import quantized_reduce_scatter
+
+                gshard = quantized_reduce_scatter(
+                    gflat, comm_compress.AXIS,
+                    groups_per_shard=C // comm_compress._GROUP)
+            else:
+                gshard = jax.lax.psum_scatter(
+                    gflat, comm_compress.AXIS, scatter_dimension=0,
+                    tiled=True) / W
+            # global consensus: a nan lands in exactly one owner row
+            ok = jax.lax.pmin(
+                precision.finite_all(gshard).astype(jnp.int32),
+                comm_compress.AXIS).astype(bool)
+            # EXACT global norm (unlike 1-bit): grads are fully reduced
+            gnorm = jnp.sqrt(jax.lax.psum(
+                jnp.sum(jnp.square(gshard)), comm_compress.AXIS))
+            if clip > 0:
+                gshard = gshard * jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            row_of = lambda t: jax.tree.map(
+                lambda x: x[0] if getattr(x, "ndim", 0) == 2 else x, t)
+            stack = lambda t: jax.tree.map(
+                lambda x: x[None] if getattr(x, "ndim", 0) == 1 else x, t)
+            opt_local = row_of(opt_state)
+            updates, new_opt = self.optimizer.update(gshard, opt_local, row)
+            keep = lambda n, o: jax.tree.map(
+                lambda a, b: jnp.where(ok, a, b), n, o)
+            new_row = keep(row + updates.astype(row.dtype), row)
+            new_opt = stack(keep(new_opt, opt_local))
+            return (new_row[None], new_opt,
+                    jax.lax.pmean(loss, comm_compress.AXIS), gnorm, ok)
+
+        opt_specs = jax.tree.map(
+            lambda x: P("data") if getattr(x, "ndim", 0) == 2 else P(),
+            state.opt_state)
+        new_pflat, new_opt, loss, gnorm, ok = jax.shard_map(
+            f, mesh=ms.mesh,
+            in_specs=(P("data"), opt_specs,
+                      jax.tree.map(lambda _: P("data"), batch)),
+            out_specs=(P("data"), opt_specs, P(), P(), P()),
+            check_vma=False)(state.params, state.opt_state, batch)
+        new_state = TrainState(
+            step=state.step + jnp.where(ok, 1, 0).astype(jnp.int32),
+            params=new_pflat, opt_state=new_opt, scaler=state.scaler)
+        metrics = {"loss": loss, "grad_norm": gnorm,
+                   "overflow": (~ok).astype(jnp.int32),
+                   "lr": self.lr_schedule(state.step + 1),
+                   "loss_scale": state.scaler.scale}
+        return new_state, metrics
 
     # ------------------------------------------------------------------ step
     def _loss_for(self, params, batch):
@@ -254,6 +444,8 @@ class TrainingEngine:
 
         if self.grad_comm_mode == "onebit":
             return self._onebit_train_step(state, batch, accum)
+        if self.grad_comm_mode == "qwz":
+            return self._qwz_train_step(state, batch, accum)
         if self.grad_comm_mode == "qgz":
             from deepspeed_tpu import comm_compress
 
@@ -279,9 +471,7 @@ class TrainingEngine:
 
         if accum > 1:
             # [global_batch, ...] -> [accum, micro_global, ...]
-            mbatch = jax.tree.map(
-                lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
-                batch)
+            mbatch = accum_split(batch, accum, self.mesh.dp_world)
             zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
                                  state.params)
             zeros = zero.grad_constraint(zeros, self.mesh, stage,
@@ -414,7 +604,14 @@ class TrainingEngine:
         from deepspeed_tpu import topology as _topo
 
         _topo.set_current_mesh(self.mesh)
-        loss, aux = self._loss_for(state.params, batch)
+        params = state.params
+        if self.grad_comm_mode == "qwz":
+            # flat [world, chunk] master → model leaves (GSPMD inserts the
+            # gather; eval is exact, not int8-quantized)
+            params = self._qwz_unflatten(
+                params.reshape(-1),
+                precision.master_dtype(self.config.precision))
+        loss, aux = self._loss_for(params, batch)
         return loss if aux is None else (loss, aux)
 
     # ----------------------------------------------------------- public API
@@ -504,6 +701,20 @@ class TrainingEngine:
         m = self._last_metrics.get("grad_norm")
         return float(m) if m is not None else 0.0
 
+    def comms_digest(self, batch, link_gbps: float = 45.0):
+        """Per-collective count/bytes digest of the compiled train step
+        (ref: deepspeed/comm/comm.py comms_logger — theirs counts NCCL
+        calls at runtime; ours reads the collectives GSPMD actually
+        emitted from the compiled HLO).  Writes to the monitor when one
+        is enabled; returns the digest dict."""
+        from deepspeed_tpu.comm.digest import digest_compiled, log_digest
+
+        compiled = self._step_fn.lower(self.state, batch).compile()
+        d = digest_compiled(compiled, link_gbps)
+        if self.monitor.enabled:
+            log_digest(self.monitor, d, self.global_steps)
+        return d
+
     @property
     def train_batch_size(self):
         return self.config.train_batch_size
@@ -514,6 +725,14 @@ class TrainingEngine:
 
     def module_params(self):
         """Replicated (gathered) view of params for export."""
+        if self.grad_comm_mode == "qwz":
+            mdt = precision.master_dtype(self.config.precision)
+            repl = self.mesh.replicated()
+            out_sh = jax.tree_util.tree_unflatten(
+                self._qwz_treedef, [repl] * len(self._qwz_shapes))
+            return jax.jit(
+                lambda flat: self._qwz_unflatten(flat.reshape(-1), mdt),
+                out_shardings=out_sh)(self.state.params)
         return zero.unshard_params(self.state.params, self.mesh)
 
     # ---------------------------------------------------------- checkpointing

@@ -26,6 +26,14 @@ Static-shape tricks worth noting:
   step structurally writes a token for every row, and aiming dead rows
   at a sacrificial page keeps them from corrupting live sequences.
 - the decode jit donates the cache, so pages update in place in HBM.
+
+Host-sync discipline (the part that makes this a TPU serving loop and
+not a CPU one): the decode inner loop performs exactly ONE device→host
+transfer per step — the batched sampled tokens.  Sampling runs on-device
+for all rows at once (per-row temperature, greedy = argmax), the page
+table and seq_lens upload only when the slot composition changed
+(dirty flags), and between composition changes the device-side
+structural ``seq_lens + 1`` of the decode step is simply trusted.
 """
 
 from __future__ import annotations
@@ -40,6 +48,19 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.inference.kernels import PagedKVCache, PageAllocator
 from deepspeed_tpu.utils.logging import logger
+
+
+@jax.jit
+def _sample_rows(logits: jnp.ndarray, keys: jnp.ndarray,
+                 temps: jnp.ndarray) -> jnp.ndarray:
+    """Batched per-row sampling: [B, V] logits + [B] keys + [B] temps →
+    [B] tokens.  temperature 0 rows take the argmax; others sample
+    categorically at their temperature.  One jit, one result array — the
+    serving loop fetches it with a single device→host transfer."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled)
+    return jnp.where(temps == 0.0, greedy, sampled.astype(jnp.int32))
 
 
 @dataclasses.dataclass
@@ -99,6 +120,10 @@ class ServingEngine:
         self._decode = jax.jit(decode_fn, donate_argnums=(2,))
         self._table_host = np.full((max_batch, self.max_pages_per_seq),
                                    self.trash_page, np.int32)
+        # dirty flags: device table/seq_lens re-upload only when the slot
+        # composition changed since the last decode
+        self._table_dirty = True
+        self._lens_dirty = True
         self.slots: List[Optional[_Slot]] = [None] * max_batch
         self.queue: "collections.deque[Request]" = collections.deque()
         self._seq_counter = 0
@@ -132,18 +157,21 @@ class ServingEngine:
         return bool(self.queue) or any(s is not None for s in self.slots)
 
     # ----------------------------------------------------------- scheduling
-    def _sync_tables(self, rows: List[int]) -> None:
-        t = self.cache.table
-        for b in rows:
-            t = t.at[b].set(jnp.asarray(self._table_host[b]))
-        self.cache = self.cache._replace(table=t)
-
-    def _set_seq_lens(self) -> None:
-        lens = np.zeros((self.max_batch,), np.int32)
-        for b, s in enumerate(self.slots):
-            if s is not None:
-                lens[b] = s.seq_len
-        self.cache = self.cache._replace(seq_lens=jnp.asarray(lens))
+    def _upload_dirty(self) -> None:
+        """One batched host→device upload of whatever changed (the whole
+        table is [max_batch, pages_per_seq] int32 — tiny; uploading it
+        wholesale beats per-row ``.at[b].set`` device updates)."""
+        if self._table_dirty:
+            self.cache = self.cache._replace(
+                table=jnp.asarray(self._table_host))
+            self._table_dirty = False
+        if self._lens_dirty:
+            lens = np.zeros((self.max_batch,), np.int32)
+            for b, s in enumerate(self.slots):
+                if s is not None:
+                    lens[b] = s.seq_len
+            self.cache = self.cache._replace(seq_lens=jnp.asarray(lens))
+            self._lens_dirty = False
 
     def _free_slot(self) -> Optional[int]:
         for b, s in enumerate(self.slots):
@@ -177,7 +205,7 @@ class ServingEngine:
         pages = self.allocator.allocate(seq_id, need)
         self._table_host[b, :] = self.trash_page
         self._table_host[b, :need] = pages
-        self._sync_tables([b])
+        self._table_dirty = self._lens_dirty = True
 
         toks = np.full((1, Tpad), 0, np.int32)
         toks[0, :T] = req.tokens
@@ -213,7 +241,7 @@ class ServingEngine:
                        s.req.req_id, len(s.generated))
         self.allocator.release(s.seq_id)
         self._table_host[b, :] = self.trash_page
-        self._sync_tables([b])
+        self._table_dirty = self._lens_dirty = True
         self.slots[b] = None
         req = s.req
         # requeue prompt+generated for recompute; the finished output is
@@ -242,13 +270,12 @@ class ServingEngine:
             self._newly_finished.append(s.req.req_id)
             self.allocator.release(s.seq_id)
             self._table_host[b, :] = self.trash_page
-            self._sync_tables([b])
+            self._table_dirty = self._lens_dirty = True
             self.slots[b] = None
 
     def _grow_pages(self) -> None:
         """Before a decode write: any slot whose frontier enters a new page
         needs that page mapped; preempt when the pool is dry."""
-        rows = []
         for b, s in enumerate(self.slots):
             if s is None:
                 continue
@@ -263,9 +290,7 @@ class ServingEngine:
                     continue
                 pg = self.allocator.allocate(s.seq_id, 1)[0]
                 self._table_host[b, slot_idx] = pg
-                rows.append(b)
-        if rows:
-            self._sync_tables(rows)
+                self._table_dirty = True
 
     # ------------------------------------------------------------------ step
     def step(self) -> List[Any]:
@@ -280,21 +305,26 @@ class ServingEngine:
             active = [(b, s) for b, s in enumerate(self.slots)
                       if s is not None]
         if active:
-            self._set_seq_lens()
+            self._upload_dirty()
             toks = np.zeros((self.max_batch, 1), np.int32)
+            temps = np.zeros((self.max_batch,), np.float32)
             for b, s in active:
                 toks[b, 0] = s.generated[-1] if s.generated \
                     else s.req.tokens[-1]
-            logits, cache = self._decode(self.params, jnp.asarray(toks),
-                                         self.cache)
-            # host truth overrides the structural +1 (inactive rows too)
-            self.cache = cache
+                temps[b] = s.req.temperature
+            logits, self.cache = self._decode(
+                self.params, jnp.asarray(toks), self.cache)
+            # trust the decode's structural seq_lens+1 between composition
+            # changes (inactive rows drift but are rebuilt on next change)
             for b, s in active:
                 s.seq_len += 1
-            self._set_seq_lens()
             self.stats["decode_steps"] += 1
+            self._rng, r = jax.random.split(self._rng)
+            keys = jax.random.split(r, self.max_batch)
+            next_toks = np.asarray(_sample_rows(        # the ONE host sync
+                logits[:, -1], keys, jnp.asarray(temps)))
             for b, s in active:
-                self._append_token(b, self._sample(logits[b, -1], s))
+                self._append_token(b, int(next_toks[b]))
         return list(self._newly_finished)
 
     def run(self, max_steps: int = 10_000) -> Dict[Any, List[int]]:

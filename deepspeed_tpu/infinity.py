@@ -284,9 +284,9 @@ class InfinityEngine:
                     lambda pp: loss_fn(pp, mb).astype(jnp.float32))(p)
 
             if accum > 1:
-                mbatch = jax.tree.map(
-                    lambda x: x.reshape((accum, x.shape[0] // accum)
-                                        + x.shape[1:]), batch)
+                from deepspeed_tpu.engine import accum_split
+
+                mbatch = accum_split(batch, accum, self.mesh.dp_world)
 
                 def micro(carry, mb):
                     gacc, lacc = carry
@@ -303,7 +303,12 @@ class InfinityEngine:
                 loss = lsum / accum
             else:
                 loss, g = one(batch)
-                g = jax.tree.map(lambda x: x.astype(jnp.float32), g)
+                if grad_dtype == jnp.float32:
+                    g = jax.tree.map(lambda x: x.astype(jnp.float32), g)
+                # bf16_grads: keep the tree in bf16 — materializing a
+                # full f32 copy doubles the transient grad HBM (the 1.4B
+                # on-chip demo OOM'd exactly there); clipping's norm
+                # still accumulates in f32 per-leaf
 
             # whole-tree work happens HERE, where the whole tree exists:
             # nonfinite consensus + global-norm clipping (the sub-group
@@ -458,7 +463,12 @@ class InfinityEngine:
         t0 = time.perf_counter()
         nvme = isinstance(self.tier, _NvmeTier)
         try:
-            loss, ok, grads = self._grad_fn(self.params_c, batch)  # async
+            loss, ok, grads = self._grad_fn(self.params_c, batch)
+            # fence the grad program before streaming state through HBM:
+            # its transient peak (activations + grad tree) must not
+            # coexist with the first groups' device_puts, or a model
+            # sized to the streaming budget OOMs on the overlap
+            ok_host = bool(ok)
             step = jnp.int32(self._opt_steps)
             pending = self._submit_group_read(0)
             for k, group in enumerate(self.groups):
@@ -505,7 +515,6 @@ class InfinityEngine:
             self._restore_params_from_tier()
             raise
         self.global_steps += 1
-        ok_host = bool(ok)
         if ok_host:
             self._opt_steps += 1
         else:

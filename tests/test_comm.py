@@ -107,3 +107,100 @@ def test_mesh_all_reduce(devices):
     out = comm.mesh_all_reduce(jnp.asarray(x), ms.mesh)
     assert out.shape == (1, 4)
     np.testing.assert_allclose(np.asarray(out), np.full((1, 4), 8.0))
+
+
+class TestCommsDigest:
+    """ref deepspeed/comm/comm.py comms_logger: per-collective accounting."""
+
+    def _build(self, zero):
+        import deepspeed_tpu as dstpu
+
+        def loss(params, batch):
+            pred = batch["x"] @ params["w"]
+            return jnp.mean((pred - batch["y"]) ** 2)
+
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (64, 512))}
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=loss, params=params,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "mesh": {"data": 8},
+                    "zero_optimization": zero,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}}})
+        rng = np.random.default_rng(0)
+        batch = {"x": jnp.asarray(rng.normal(size=(16, 64)), jnp.float32),
+                 "y": jnp.asarray(rng.normal(size=(16, 512)), jnp.float32)}
+        return engine, batch
+
+    def test_stage0_all_reduce_accounted(self, devices):
+        engine, batch = self._build({"stage": 0})
+        d = engine.comms_digest(batch)
+        assert d["total_collectives"] > 0
+        assert "all-reduce" in d["per_kind"]
+        # grads are f32 [64, 512]-ish: the all-reduce payload must be at
+        # least that order of magnitude
+        assert d["per_kind"]["all-reduce"]["bytes"] >= 4 * 64 * 512 / 8
+        assert d["est_wire_ms"] > 0
+
+    def test_stage3_has_gather_or_scatter_traffic(self, devices):
+        engine, batch = self._build({"stage": 3})
+        d = engine.comms_digest(batch)
+        kinds = set(d["per_kind"])
+        assert kinds & {"all-gather", "reduce-scatter", "all-to-all",
+                        "collective-permute"}, kinds
+
+    def test_digest_feeds_monitor_csv(self, devices, tmp_path):
+        import deepspeed_tpu as dstpu
+
+        def loss(params, batch):
+            return jnp.mean((batch["x"] @ params["w"]) ** 2)
+
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (32, 64))}
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=loss, params=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "mesh": {"data": 8},
+                    "zero_optimization": {"stage": 2},
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                    "csv_monitor": {"enabled": True,
+                                    "output_path": str(tmp_path),
+                                    "job_name": "digesttest"}})
+        batch = {"x": jnp.ones((8, 32), jnp.float32)}
+        engine.comms_digest(batch)
+        engine.monitor.flush()
+        import os
+        found = []
+        for root, _, files in os.walk(tmp_path):
+            found += [f for f in files if f.endswith(".csv")]
+        assert any("Comms" in f or "total_bytes" in f for f in found), found
+
+    def test_hlo_parser_on_synthetic_text(self):
+        from deepspeed_tpu.comm.digest import analyze_collectives
+
+        txt = """
+  %ar = f32[128,256]{1,0} all-reduce(f32[128,256]{1,0} %g), replica_groups={}
+  %ag.1 = bf16[8,64]{1,0} all-gather(bf16[1,64]{1,0} %p), dimensions={0}
+  %a2a = (s8[8,512]{1,0}, s8[8,512]{1,0}) all-to-all(s8[8,512]{1,0} %q, s8[8,512]{1,0} %r)
+  %rs-start = f32[32]{0} reduce-scatter-start(f32[256]{0} %x)
+"""
+        d = analyze_collectives(txt, link_gbps=45.0)
+        assert d["per_kind"]["all-reduce"] == {
+            "count": 1, "bytes": 4 * 128 * 256}
+        assert d["per_kind"]["all-gather"] == {"count": 1, "bytes": 2 * 8 * 64}
+        assert d["per_kind"]["all-to-all"] == {
+            "count": 1, "bytes": 2 * 8 * 512}
+        assert d["per_kind"]["reduce-scatter"] == {"count": 1, "bytes": 4 * 32}
+        assert d["total_bytes"] == (4 * 128 * 256 + 2 * 8 * 64
+                                    + 2 * 8 * 512 + 4 * 32)
+
+    def test_async_start_done_counts_once(self):
+        from deepspeed_tpu.comm.digest import analyze_collectives
+
+        txt = """
+  %ags = bf16[8,64]{1,0} all-gather-start(bf16[1,64]{1,0} %p)
+  %agd = bf16[8,64]{1,0} all-gather-done(bf16[8,64]{1,0} %ags)
+  %ar = f32[16]{0} all-reduce(f32[16]{0} %g)
+"""
+        d = analyze_collectives(txt)
+        assert d["per_kind"]["all-gather"] == {"count": 1, "bytes": 2 * 8 * 64}
+        assert d["per_kind"]["all-reduce"] == {"count": 1, "bytes": 64}
+        assert d["total_collectives"] == 2
