@@ -526,6 +526,16 @@ class InfinityEngine:
         return loss
 
     # ----------------------------------------------------------- inspection
+    def comms_digest(self, batch, link_gbps: float = 45.0):
+        """Per-collective digest of the compiled grad program (the only
+        collective-carrying program in this engine: the group updates are
+        elementwise on local shards plus a param all-gather).  See
+        TrainingEngine.comms_digest / comm/digest.py."""
+        from deepspeed_tpu.comm.digest import digest_compiled
+
+        compiled = self._grad_fn.lower(self.params_c, batch).compile()
+        return digest_compiled(compiled, link_gbps)
+
     @property
     def metrics(self):
         return self._last_metrics

@@ -198,3 +198,13 @@ class TestInfinityEngine:
         lp = [float(plain.train_batch(batch)) for _ in range(4)]
         li = [float(inf.train_batch(batch)) for _ in range(4)]
         np.testing.assert_allclose(li, lp, rtol=2e-3, atol=2e-3)
+
+    def test_comms_digest_shows_grad_reduce_scatter(self, devices):
+        cfg, params, batch = tiny_setup()
+        inf = build(cfg, params, {"device": "cpu", "scheduled": True})
+        d = inf.comms_digest(batch)
+        # dp=8 flat-shard grads: SOME cross-device reduction must appear
+        assert d["total_collectives"] > 0
+        kinds = set(d["per_kind"])
+        assert kinds & {"reduce-scatter", "all-reduce", "all-to-all",
+                        "collective-permute"}, kinds
