@@ -179,6 +179,12 @@ class InfinityEngine:
         dp = self._dp = self.mesh.size("data")
         self.state_sharding = self.mesh.sharding(P("data"))
 
+        self.update_mode = off.get("update", "device")
+        if self.update_mode not in ("device", "host"):
+            raise ValueError(
+                f"offload_optimizer.update must be 'device' or 'host', "
+                f"got {self.update_mode!r}")
+
         opt_type = config.optimizer.type.lower()
         if opt_type not in ("adam", "adamw", "fusedadam"):
             raise ValueError(
@@ -199,6 +205,14 @@ class InfinityEngine:
             oparams["betas"] = tuple(oparams["betas"])
         self.optimizer = adam(lr=self.lr_schedule, adamw=adamw_mode,
                               **oparams)
+        # hyperparams mirrored for the host (CPU-Adam) update path
+        self._hyp = {
+            "betas": tuple(oparams.get("betas", (0.9, 0.999))),
+            "eps": float(oparams.get("eps", 1e-8)),
+            "wd": float(oparams.get("weight_decay", 0.0)),
+            "adamw": bool(adamw_mode),
+            "bias_correction": bool(oparams.get("bias_correction", True)),
+        }
 
         # ---- partitioned flat layout: each leaf raveled and padded to
         # [dp, chunk] so P("data") gives every device an equal, contiguous,
@@ -459,8 +473,110 @@ class InfinityEngine:
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
 
+    def _host_adam_group(self, g, m, v, p, lr, t):
+        """In-place numpy Adam on one leaf's local rows (f32), mirroring
+        ops/optim.adam exactly (ref: DeepSpeedCPUAdam — the reference's
+        offload optimizer updates on the HOST so only bf16 params/grads
+        ever cross the host↔device link, which is the whole viability
+        argument for offload on a thin link)."""
+        b1, b2 = self._hyp["betas"]
+        eps, wd = self._hyp["eps"], self._hyp["wd"]
+        if wd and not self._hyp["adamw"]:
+            g = g + wd * p
+        m *= b1
+        m += (1.0 - b1) * g
+        v *= b2
+        v += (1.0 - b2) * (g * g)
+        if self._hyp["bias_correction"]:
+            c1 = 1.0 - b1 ** t
+            c2 = 1.0 - b2 ** t
+        else:
+            c1 = c2 = 1.0
+        u = (m / c1) / (np.sqrt(v / c2) + eps)
+        if wd and self._hyp["adamw"]:
+            u = u + wd * p
+        p -= lr * u
+        return p, m, v
+
+    def _train_batch_host(self, batch, t0: float) -> jnp.ndarray:
+        """CPU-Adam step: grads come DOWN in the grad dtype, fresh
+        compute params go UP in the compute dtype; the f32 state never
+        transits the device (2+2 bytes/param on the link vs 12+12 for
+        the device-update path)."""
+        nvme = isinstance(self.tier, _NvmeTier)
+        # ml_dtypes registers bf16/f8 with numpy, so this maps ANY
+        # configured compute dtype (bf16/f16/f32) to its host twin —
+        # the uploaded rows must already be in compute dtype so only
+        # 2 bytes/param cross the link
+        cdt_np = np.dtype(self._compute_dtype)
+        try:
+            loss, ok, grads = self._grad_fn(self.params_c, batch)
+            ok_host = bool(ok)
+            if not ok_host:
+                # skipped step: params_c were donated — rebuild unchanged.
+                # Drop the grad slab first: restore's replicated allocs
+                # must not overlap it (same headroom rule as the
+                # exception path).
+                grads = None
+                self._restore_params_from_tier()
+                self.global_steps += 1
+                self.skipped_steps += 1
+                loss = jnp.asarray(loss)
+                self._last_metrics = {"loss": loss, "overflow": jnp.int32(1)}
+                self.step_times.append(time.perf_counter() - t0)
+                return loss
+            t = self._opt_steps + 1
+            lr = float(self.lr_schedule(jnp.int32(t)))
+
+            pending = self._submit_group_read(0)
+            for k, group in enumerate(self.groups):
+                if nvme:
+                    self.tier.fence_reads()
+                    self.tier.next_read_slot()
+                bufs = pending
+                if k + 1 < len(self.groups):
+                    pending = self._submit_group_read(k + 1)
+                for j, i in enumerate(group):
+                    g = np.asarray(self._rows_to_host(grads[i]),
+                                   np.float32)            # D2H (grad dtype)
+                    grads[i] = None
+                    m = np.asarray(bufs[j][1], np.float32)
+                    v = np.asarray(bufs[j][2], np.float32)
+                    p = np.asarray(bufs[j][0], np.float32)
+                    p, m, v = self._host_adam_group(g, m, v, p, lr, t)
+                    n = self._names[i]
+                    if nvme:
+                        self.tier.fence_writes()
+                    self.tier.put(n, p)
+                    self.tier.put("m" + n, m)
+                    self.tier.put("v" + n, v)
+                    if nvme:
+                        self.tier.next_write_slot()
+                    # H2D: compute-dtype rows only; _restore_fns unpads,
+                    # reshapes and (no-op) casts, gathering on-device
+                    rows_c = np.ascontiguousarray(p.astype(cdt_np))
+                    self.params_c[i] = self._restore_fns[i](
+                        jax.make_array_from_process_local_data(
+                            self.state_sharding, rows_c,
+                            (self._dp, self._chunks[i])))
+                del bufs
+            if nvme:
+                self.tier.fence_all()
+            self.global_steps += 1
+            self._opt_steps += 1
+            loss = jnp.asarray(loss)
+            self._last_metrics = {"loss": loss, "overflow": jnp.int32(0)}
+            self.step_times.append(time.perf_counter() - t0)
+            return loss
+        except BaseException:
+            loss = ok = grads = None
+            self._restore_params_from_tier()
+            raise
+
     def train_batch(self, batch) -> jnp.ndarray:
         t0 = time.perf_counter()
+        if self.update_mode == "host":
+            return self._train_batch_host(batch, t0)
         nvme = isinstance(self.tier, _NvmeTier)
         try:
             loss, ok, grads = self._grad_fn(self.params_c, batch)
@@ -485,8 +601,13 @@ class InfinityEngine:
                 nu = [self._rows_to_device(b[2], i)
                       for b, i in zip(bufs, group)]
                 g_k = [grads[i] for i in group]
+                for i in group:
+                    grads[i] = None   # free each shard as it's consumed:
+                    # holding all groups' grads through the loop adds a
+                    # full grad-size slab to peak HBM (1.4B demo OOM)
                 new_master, new_mu, new_nu, compute = self._update_fns[k](
                     master, mu, nu, g_k, step, ok)
+                del g_k, bufs
                 for j, i in enumerate(group):
                     self.params_c[i] = compute[j]
                 # device → host (async), then async write to the tier
@@ -511,7 +632,14 @@ class InfinityEngine:
             # engine stays usable after a caught IO error or an
             # interrupt (KeyboardInterrupt is a BaseException).  Also
             # covers a retry whose _grad_fn call itself trips over
-            # already-deleted arrays from a previous failure.
+            # already-deleted arrays from a previous failure.  Drop the
+            # failed step's device references first — after an HBM OOM
+            # the restore itself needs room to allocate.  NOT the host
+            # aio buffers (pending/bufs): the native pool holds raw
+            # pointers into them until the restore's fence_all.
+            loss = ok = grads = None
+            master = mu = nu = g_k = None
+            new_master = new_mu = new_nu = compute = None
             self._restore_params_from_tier()
             raise
         self.global_steps += 1

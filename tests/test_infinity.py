@@ -208,3 +208,28 @@ class TestInfinityEngine:
         kinds = set(d["per_kind"])
         assert kinds & {"reduce-scatter", "all-reduce", "all-to-all",
                         "collective-permute"}, kinds
+
+    def test_host_update_matches_device_update(self, devices):
+        # ref DeepSpeedCPUAdam: the host-side numpy Adam must walk the
+        # same trajectory as the on-device sharded update
+        cfg, params, batch = tiny_setup()
+        dev = build(cfg, params, {"device": "cpu", "scheduled": True})
+        host = build(cfg, params, {"device": "cpu", "scheduled": True,
+                                   "update": "host"})
+        ld = [float(dev.train_batch(batch)) for _ in range(5)]
+        lh = [float(host.train_batch(batch)) for _ in range(5)]
+        np.testing.assert_allclose(lh, ld, rtol=2e-3, atol=2e-3)
+        assert lh[-1] < lh[0]
+
+    def test_host_update_nvme_tier(self, devices):
+        import tempfile
+        cfg, params, batch = tiny_setup()
+        eng = build(cfg, params, {
+            "device": "nvme", "update": "host",
+            "nvme_path": tempfile.mkdtemp(prefix="dstpu_hostup_")},
+            sub_group=8192)
+        assert len(eng.groups) > 2
+        l0 = float(eng.train_batch(batch))
+        l1 = float(eng.train_batch(batch))
+        l2 = float(eng.train_batch(batch))
+        assert l2 < l0, (l0, l1, l2)

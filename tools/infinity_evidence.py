@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""ZeRO-Infinity peak-params-per-chip evidence runner (round-2 verdict
+task 1): produces INFINITY_BENCH.json with BOTH halves of the story on
+the real chip —
+
+  1. the NON-offload ceiling: the plain in-HBM engine at ~1.38B params
+     fails to compile/allocate (the XLA error names the HBM deficit);
+  2. the Infinity engine TRAINS the same model, with only the bf16
+     compute copy resident on-chip and the f32 master+moments streamed
+     from NVMe around host (CPU-Adam) sub-group updates.
+
+    python tools/infinity_evidence.py --steps 2
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "zero_infinity_offload.py")
+
+
+def run_probe(timeout_s: int) -> dict:
+    p = subprocess.run(
+        [sys.executable, EXAMPLE, "--scale", "1p4b", "--probe-plain"],
+        capture_output=True, text=True, timeout=timeout_s)
+    out = p.stdout + p.stderr
+    m = re.search(r"Used [0-9.]+[GM] of [0-9.]+[GM] hbm[^\n]*", out)
+    oom = ("RESOURCE_EXHAUSTED" in out or "Ran out of memory" in out
+           or "ResourceExhausted" in out)
+    return {
+        "outcome": "oom" if (p.returncode != 0 and oom)
+        else ("ran" if p.returncode == 0 else "error"),
+        "returncode": p.returncode,
+        "hbm_detail": m.group(0) if m else None,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=2)
+    ap.add_argument("--probe-timeout", type=int, default=900)
+    ap.add_argument("--run-timeout", type=int, default=7200)
+    ap.add_argument("--json-out",
+                    default=os.path.join(REPO, "INFINITY_BENCH.json"))
+    args = ap.parse_args()
+
+    print("probing the plain in-HBM engine at 1p4b (expected: HBM OOM)…",
+          flush=True)
+    probe = run_probe(args.probe_timeout)
+    print("probe:", probe, flush=True)
+
+    tmp = args.json_out + ".run"
+    print(f"running the Infinity engine for {args.steps} steps…", flush=True)
+    p = subprocess.run(
+        [sys.executable, EXAMPLE, "--scale", "1p4b",
+         "--steps", str(args.steps), "--json-out", tmp],
+        timeout=args.run_timeout)
+    if not os.path.exists(tmp):
+        raise SystemExit(f"infinity run produced no evidence (rc={p.returncode})")
+    with open(tmp) as f:
+        evidence = json.load(f)
+    os.remove(tmp)
+    evidence["plain_engine_probe"] = probe
+    evidence["infinity_run_returncode"] = p.returncode
+    with open(args.json_out, "w") as f:
+        json.dump(evidence, f, indent=1)
+    print(json.dumps(evidence, indent=1))
+
+
+if __name__ == "__main__":
+    main()
