@@ -29,6 +29,8 @@ def main():
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--prompt-len", type=int, default=64)
     ap.add_argument("--new-tokens", type=int, default=128)
+    ap.add_argument("--decode-chunk", type=int, default=8,
+                    help="decode steps per host sync (1 = sync per token)")
     ap.add_argument("--json-out", default=os.path.join(REPO, "SERVING_BENCH.json"))
     args = ap.parse_args()
 
@@ -55,7 +57,8 @@ def main():
     engine = llama_serving_engine(
         params, cfg, max_batch=args.slots, page_size=16,
         num_pages=args.slots * (-(-max_seq // 16)) + 32,
-        max_seq=max_seq, prefill_bucket=args.prompt_len)
+        max_seq=max_seq, prefill_bucket=args.prompt_len,
+        decode_chunk=args.decode_chunk)
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, cfg.vocab_size, args.prompt_len).tolist()
@@ -80,6 +83,7 @@ def main():
         "detail": {
             "backend": jax.default_backend(),
             "model_params": llama.param_count(cfg),
+            "decode_chunk": args.decode_chunk,
             "slots": args.slots,
             "requests": args.requests,
             "prompt_len": args.prompt_len,

@@ -94,8 +94,13 @@ class ServingEngine:
                  max_batch: int = 4, page_size: int = 16,
                  num_pages: int = 128, max_seq: int = 256,
                  prefill_bucket: int = 32, eos_token_id: Optional[int] = None,
-                 cache_dtype=jnp.bfloat16, seed: int = 0):
+                 cache_dtype=jnp.bfloat16, seed: int = 0,
+                 decode_chunk: int = 1):
         self.params = params
+        self.decode_chunk = int(decode_chunk)
+        if self.decode_chunk < 1:
+            raise ValueError(
+                f"decode_chunk must be >= 1, got {decode_chunk}")
         self.eos = eos_token_id
         self.page_size = page_size
         self.max_batch = max_batch
@@ -118,6 +123,26 @@ class ServingEngine:
 
         self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
         self._decode = jax.jit(decode_fn, donate_argnums=(2,))
+
+        # K decode steps in ONE on-device scan: each step's sampled token
+        # feeds the next, so the host syncs once per K tokens.  On a
+        # high-latency link (this container's tunnel: ~90ms RTT per sync,
+        # SERVING_BENCH.json ms_per_decode_step 97.6 unchunked vs 17.4 at
+        # K=8) this is the difference between latency-bound and
+        # compute-bound serving.  Tokens a request emits after its own
+        # EOS within a chunk are discarded by the host (waste < K).
+        # K=1 runs the same path as a length-1 scan.
+        def chunk_fn(params, tok, cache, keys, temps):
+            def one(carry, key_k):
+                t, c = carry
+                logits, c = decode_fn(params, t, c)
+                nxt = _sample_rows(logits[:, -1], key_k, temps)
+                return (nxt[:, None], c), nxt
+
+            (_, cache), toks = jax.lax.scan(one, (tok, cache), keys)
+            return jnp.swapaxes(toks, 0, 1), cache          # [B, K]
+
+        self._decode_chunk_fn = jax.jit(chunk_fn, donate_argnums=(2,))
         self._table_host = np.full((max_batch, self.max_pages_per_seq),
                                    self.trash_page, np.int32)
         # dirty flags: device table/seq_lens re-upload only when the slot
@@ -130,7 +155,8 @@ class ServingEngine:
         self._rng = jax.random.PRNGKey(seed)
         self.finished: Dict[Any, List[int]] = {}
         self._newly_finished: List[Any] = []
-        self.stats = {"admitted": 0, "preempted": 0, "decode_steps": 0}
+        self.stats = {"admitted": 0, "preempted": 0, "decode_steps": 0,
+                      "decode_syncs": 0}
 
     # ------------------------------------------------------------- requests
     def submit(self, req_id, tokens, max_new_tokens: int = 32,
@@ -273,21 +299,29 @@ class ServingEngine:
             self._table_dirty = self._lens_dirty = True
             self.slots[b] = None
 
-    def _grow_pages(self) -> None:
-        """Before a decode write: any slot whose frontier enters a new page
-        needs that page mapped; preempt when the pool is dry."""
+    def _grow_pages(self, ahead: int = 1) -> None:
+        """Before decode writes: map every page the next ``ahead`` token
+        positions will touch (chunked decode provisions its whole window
+        up front); preempt when the pool is dry.  Positions past the
+        request's lifetime are NOT provisioned — their garbage writes
+        clamp into the sequence's own final page, which is released when
+        it finishes."""
+        ps = self.page_size
         for b, s in enumerate(self.slots):
             if s is None:
                 continue
-            slot_idx = s.seq_len // self.page_size
-            if s.seq_len % self.page_size == 0 and \
-                    self._table_host[b, slot_idx] == self.trash_page:
+            lifetime = len(s.req.tokens) + s.req.max_new_tokens
+            last_pos = min(s.seq_len + ahead - 1, lifetime - 1,
+                           self.max_pages_per_seq * ps - 1)
+            for slot_idx in range(s.seq_len // ps, last_pos // ps + 1):
+                if self._table_host[b, slot_idx] != self.trash_page:
+                    continue
                 while not self.allocator.free:
                     self._preempt_youngest()
                     if self.slots[b] is None:   # we preempted ourselves
                         break
                 if self.slots[b] is None:
-                    continue
+                    break
                 pg = self.allocator.allocate(s.seq_id, 1)[0]
                 self._table_host[b, slot_idx] = pg
                 self._table_dirty = True
@@ -299,9 +333,10 @@ class ServingEngine:
         self._newly_finished = []
         while self._admit_one():
             pass
+        K = self.decode_chunk
         active = [(b, s) for b, s in enumerate(self.slots) if s is not None]
         if active:
-            self._grow_pages()
+            self._grow_pages(ahead=K)
             active = [(b, s) for b, s in enumerate(self.slots)
                       if s is not None]
         if active:
@@ -312,19 +347,25 @@ class ServingEngine:
                 toks[b, 0] = s.generated[-1] if s.generated \
                     else s.req.tokens[-1]
                 temps[b] = s.req.temperature
-            logits, self.cache = self._decode(
-                self.params, jnp.asarray(toks), self.cache)
-            # trust the decode's structural seq_lens+1 between composition
-            # changes (inactive rows drift but are rebuilt on next change)
-            for b, s in active:
-                s.seq_len += 1
-            self.stats["decode_steps"] += 1
             self._rng, r = jax.random.split(self._rng)
-            keys = jax.random.split(r, self.max_batch)
-            next_toks = np.asarray(_sample_rows(        # the ONE host sync
-                logits[:, -1], keys, jnp.asarray(temps)))
+            keys = jax.random.split(r, K * self.max_batch).reshape(
+                K, self.max_batch, -1)
+            out, self.cache = self._decode_chunk_fn(
+                self.params, jnp.asarray(toks), self.cache, keys,
+                jnp.asarray(temps))
+            # trust the decode's structural seq_lens+K between
+            # composition changes (inactive rows drift, rebuilt on the
+            # next dirty upload)
             for b, s in active:
-                self._append_token(b, int(next_toks[b]))
+                s.seq_len += K
+            self.stats["decode_steps"] += K
+            self.stats["decode_syncs"] += 1
+            host_toks = np.asarray(out)         # the ONE host sync
+            for b, s in active:
+                for j in range(K):
+                    self._append_token(b, int(host_toks[b, j]))
+                    if self.slots[b] is None:   # finished mid-chunk:
+                        break                   # rest is discard
         return list(self._newly_finished)
 
     def run(self, max_steps: int = 10_000) -> Dict[Any, List[int]]:

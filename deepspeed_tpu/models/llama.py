@@ -377,7 +377,18 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
         else:
             kp, vp = write_token_pages(kp, vp, k[:, 0], v[:, 0],
                                        cache.table, start, ps)
-            pa = (paged_attention_reference if interpret
+            # measured on v5e (KERNEL_BENCH.json paged_decode_vs_gather):
+            # the XLA gather reference beats the pallas kernel ~1.2x at
+            # small/mid shapes; the kernel only pays off when the
+            # gathered K/V transient ([B, KV, mp*ps, Dh] x2) is too big
+            # to materialize per decode step (long context, many slots)
+            mp = cache.table.shape[1]
+            # the reference materializes the gather in cache dtype AND
+            # upcasts to f32 for the einsum: itemsize + 4 bytes per elem
+            gather_bytes = (2 * B * nkv * mp * ps * hd
+                            * (kp.dtype.itemsize + 4))
+            pa = (paged_attention_reference
+                  if interpret or gather_bytes < (1 << 28)
                   else paged_decode_attention)
             attn = pa(q[:, 0], kp, vp, cache.table, start + 1)[:, None]
         x = x + attn.reshape(B, T, nh * hd) @ lp["wo"]
