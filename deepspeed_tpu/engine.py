@@ -42,6 +42,15 @@ class TrainState(NamedTuple):
     scaler: precision.ScalerState
 
 
+def _is_init_thunk(params: Any) -> bool:
+    """True iff ``params`` is a zero-arg init thunk (zero.Init parity)
+    rather than a parameter pytree.  A bare callable (function, lambda,
+    partial) is a pytree LEAF; a callable container (an equinox-style
+    module that flattens into array children) is eager params."""
+    return callable(params) and jax.tree_util.treedef_is_leaf(
+        jax.tree.structure(params))
+
+
 def accum_split(batch: Any, accum: int, dp_world: int) -> Any:
     """[B, ...] → [accum, B/accum, ...] microbatch split with NO
     cross-device movement.
@@ -185,10 +194,30 @@ class TrainingEngine:
 
         # ---- state layout: ZeRO shardings
         mdt = precision.master_dtype(config.precision)
-        params = jax.tree.map(
-            lambda p: jnp.asarray(p, mdt)
-            if jnp.issubdtype(jnp.asarray(p).dtype, jnp.floating) else jnp.asarray(p),
-            params)
+        # zero.Init parity (ref: deepspeed/runtime/zero/partition_parameters
+        # .py Init): ``params`` may be a zero-arg init thunk.  Shardings are
+        # derived from ``eval_shape`` and the thunk runs INSIDE the jitted
+        # state init with sharded out_shardings, so the full parameter tree
+        # is never materialized unsharded on any one device.  Only a bare
+        # callable counts — a callable pytree CONTAINER (e.g. an equinox-
+        # style module whose treedef has children) is still eager params.
+        params_thunk = None
+        if _is_init_thunk(params):
+            params_thunk = params
+            params = jax.eval_shape(params_thunk)
+        cast_dt = lambda dt: mdt if jnp.issubdtype(dt, jnp.floating) else dt
+        if params_thunk is not None:
+            params = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(s.shape, cast_dt(s.dtype)),
+                params)
+            self._cast_thunk = lambda: jax.tree.map(
+                lambda p: p.astype(cast_dt(p.dtype)) if
+                jnp.issubdtype(p.dtype, jnp.floating) else p, params_thunk())
+        else:
+            self._cast_thunk = None
+            params = jax.tree.map(
+                lambda p: jnp.asarray(p, cast_dt(jnp.asarray(p).dtype)),
+                params)
         if self.grad_comm_mode == "qwz":
             if config.zero.offload_param or config.zero.offload_optimizer:
                 raise ValueError(
@@ -220,14 +249,20 @@ class TrainingEngine:
             opt_state=self.opt_shardings,
             scaler=precision.ScalerState(repl, repl))
 
-        init_fn = jax.jit(
-            lambda p: TrainState(
+        def make_state(p):
+            return TrainState(
                 step=jnp.zeros([], jnp.int32),
                 params=p,
                 opt_state=self.optimizer.init(p),
-                scaler=precision.scaler_init(config.precision)),
-            out_shardings=self.state_shardings)
-        self.state = init_fn(params)
+                scaler=precision.scaler_init(config.precision))
+
+        if self._cast_thunk is not None:
+            cast_thunk, self._cast_thunk = self._cast_thunk, None
+            self.state = jax.jit(lambda: make_state(cast_thunk()),
+                                 out_shardings=self.state_shardings)()
+        else:
+            self.state = jax.jit(
+                make_state, out_shardings=self.state_shardings)(params)
         self._finish_init()
 
     def _finish_init(self) -> None:
@@ -310,8 +345,17 @@ class TrainingEngine:
                 opt_state=self.optimizer.init(flat),
                 scaler=precision.scaler_init(self.config.precision))
 
-        self.state = jax.jit(
-            make_state, out_shardings=self.state_shardings)(params)
+        if self._cast_thunk is not None:
+            # zero.Init thunk: flattening is traced, so the thunk runs
+            # inside the jit and lands directly in the [world, chunk] rows.
+            # Drop the reference afterwards — the closure may hold large
+            # host-side arrays that must become collectable.
+            cast_thunk, self._cast_thunk = self._cast_thunk, None
+            self.state = jax.jit(lambda: make_state(cast_thunk()),
+                                 out_shardings=self.state_shardings)()
+        else:
+            self.state = jax.jit(
+                make_state, out_shardings=self.state_shardings)(params)
 
     def _qwz_flatten(self, tree, dtype):
         """Ravel a params-shaped pytree into the padded flat buffer."""
@@ -783,6 +827,10 @@ def initialize(args=None, *, loss_fn: Callable, params: Any,
                 "the ZeRO-Infinity scheduled-offload engine drives its own "
                 "Adam update and parameter layout; pass the optimizer via "
                 "the config block and drop param_specs/has_aux")
+        if _is_init_thunk(params):
+            # zero.Init thunk: the Infinity engine keeps bf16 compute params
+            # resident in HBM regardless, so materialize the thunk eagerly
+            params = params()
         engine = InfinityEngine(loss_fn, params, config, mesh=mesh,
                                 lr_scheduler=lr_scheduler)
     else:

@@ -122,7 +122,6 @@ class ServingEngine:
             page_size=page_size)
 
         self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
-        self._decode = jax.jit(decode_fn, donate_argnums=(2,))
 
         # K decode steps in ONE on-device scan: each step's sampled token
         # feeds the next, so the host syncs once per K tokens.  On a
@@ -311,7 +310,9 @@ class ServingEngine:
             if s is None:
                 continue
             lifetime = len(s.req.tokens) + s.req.max_new_tokens
-            last_pos = min(s.seq_len + ahead - 1, lifetime - 1,
+            # last KV write is at lifetime-2: the final generated token is
+            # appended to the output but never fed back through decode
+            last_pos = min(s.seq_len + ahead - 1, lifetime - 2,
                            self.max_pages_per_seq * ps - 1)
             for slot_idx in range(s.seq_len // ps, last_pos // ps + 1):
                 if self._table_host[b, slot_idx] != self.trash_page:

@@ -140,6 +140,25 @@ def grad_constraint(grads: Any, ms: MeshSpec, stage: int,
             g, ms.sharding(_zero_spec(g, base, ms))), grads, specs)
 
 
+def sharded_init(init_fn: Callable[[], Any], ms: MeshSpec, stage: int,
+                 param_specs: SpecTree = None) -> Any:
+    """Materialize a parameter pytree directly into its ZeRO shardings.
+
+    ref: deepspeed/runtime/zero/partition_parameters.py ``zero.Init`` — the
+    reference intercepts ``Module.__init__`` so each rank only allocates its
+    partition of every parameter.  Here the same guarantee falls out of XLA:
+    ``init_fn`` is jitted with sharded ``out_shardings``, so (with JAX's
+    partitionable threefry PRNG) each device generates and keeps only its
+    own shard; the full tree never exists on one device.
+
+    ``TrainingEngine`` applies this automatically when ``initialize()`` is
+    given a callable ``params``; this helper is the standalone form.
+    """
+    abstract = jax.eval_shape(init_fn)
+    shardings = param_shardings(abstract, ms, stage, param_specs)
+    return jax.jit(init_fn, out_shardings=shardings)()
+
+
 def unshard_params(params: Any, ms: MeshSpec):
     """Gather a stage-3 sharded pytree to replicated (for export/eval).
 

@@ -250,6 +250,31 @@ class TestGates:
             build({"zero_optimization": {
                 "stage": 3, "zero_quantized_gradients": True}})
 
+    def test_qwz_sharded_init_thunk(self, devices):
+        """zero.Init thunk composes with the qwZ flat-shard layout: the
+        thunk is traced into the jitted state init, landing directly in
+        the [world, chunk] rows, and matches eager init exactly."""
+        cfg = {
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "adamw", "params": {"lr": 5e-2}},
+            "mesh": {"data": 8},
+            "zero_optimization": {"stage": 3,
+                                  "zero_quantized_weights": True},
+        }
+        thunk, _, _, _ = dstpu.initialize(
+            loss_fn=mlp_loss, params=make_params, config=dict(cfg))
+        eager, _, _, _ = dstpu.initialize(
+            loss_fn=mlp_loss, params=make_params(), config=dict(cfg))
+        assert thunk.grad_comm_mode == "qwz"
+        assert not thunk.state.params.sharding.is_fully_replicated
+        np.testing.assert_allclose(np.asarray(thunk.state.params),
+                                   np.asarray(eager.state.params),
+                                   rtol=1e-6, atol=1e-7)
+        batch = make_batch()
+        lt = [float(thunk.train_batch(batch)) for _ in range(4)]
+        le = [float(eager.train_batch(batch)) for _ in range(4)]
+        np.testing.assert_allclose(lt, le, rtol=1e-6)
+
     def test_qwz_rejects_non_stage3(self, devices):
         with pytest.raises(ValueError, match="stage-3"):
             build({"zero_optimization": {
