@@ -36,6 +36,14 @@ def init_inference(*args, **kwargs):
     return _ii(*args, **kwargs)
 
 
+def init_hybrid_engine(engine, model_cfg, **kw):
+    """Build a train+generate :class:`~deepspeed_tpu.hybrid.HybridEngine`
+    for RLHF loops (ref: deepspeed/runtime/hybrid_engine.py)."""
+    from deepspeed_tpu.hybrid import llama_hybrid_engine
+
+    return llama_hybrid_engine(engine, model_cfg, **kw)
+
+
 def add_config_arguments(parser):
     """Add ``--deepspeed``-style CLI args (ref: deepspeed/__init__.py)."""
     group = parser.add_argument_group("DeepSpeed-TPU", "configuration")

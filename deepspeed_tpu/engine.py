@@ -272,6 +272,7 @@ class TrainingEngine:
         # NamedSharding broadcast to every leaf) splits the batch dim over
         # the data axes so each chip receives only its slice.
         batch_sharding = self.mesh.sharding(self.mesh.batch_spec())
+        self._batch_sharding = batch_sharding
         self._step_fn = jax.jit(
             self._train_step,
             in_shardings=(self.state_shardings, batch_sharding),
@@ -689,6 +690,20 @@ class TrainingEngine:
                 self.global_steps)
             self.monitor.flush()
 
+    def _align_batch(self, batch):
+        """Re-place committed device arrays whose sharding disagrees with
+        the step's batch sharding (host arrays are untouched — jit already
+        shards those on transfer).  Lets rollouts generated on-device (the
+        hybrid-engine RLHF loop) feed straight back into train_batch."""
+        def fix(x):
+            if isinstance(x, jax.Array) and \
+                    not x.sharding.is_equivalent_to(self._batch_sharding,
+                                                    x.ndim):
+                return jax.device_put(x, self._batch_sharding)
+            return x
+
+        return jax.tree.map(fix, batch)
+
     def train_batch(self, batch) -> jnp.ndarray:
         """Run one full optimizer step on a global batch; returns the loss.
 
@@ -697,21 +712,21 @@ class TrainingEngine:
         timed = self.monitor.enabled
         if timed:
             self.tput_timer.start()
-        self.state, metrics = self._step_fn(self.state, batch)
+        self.state, metrics = self._step_fn(self.state, self._align_batch(batch))
         if timed:
             self.tput_timer.stop()
         self._post_step(metrics)
         return metrics["loss"]
 
     def eval_batch(self, batch):
-        return self._eval_fn(self.state, batch)
+        return self._eval_fn(self.state, self._align_batch(batch))
 
     # torch-idiom compatibility shims (ref: engine.__call__/backward/step)
     def __call__(self, batch):
         # State is committed immediately — the step donates the old buffers,
         # so holding them in a "pending" slot would leave self.state pointing
         # at deleted arrays.  backward()/step() validate call order only.
-        new_state, metrics = self._step_fn(self.state, batch)
+        new_state, metrics = self._step_fn(self.state, self._align_batch(batch))
         self.state = new_state
         self._pending = metrics
         self._last_metrics = metrics

@@ -112,38 +112,52 @@ class Generator:
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                  rng: Optional[jax.Array] = None, max_seq: Optional[int] = None):
         """tokens: [B, T] prompt → [B, T + max_new_tokens] (eos-padded)."""
-        tokens = jnp.asarray(tokens, jnp.int32)
-        B, T = tokens.shape
-        total = max_seq or (T + max_new_tokens)
-        cache = self._alloc(B, total)
-        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        return generate_loop(
+            self.params, self._prefill, self._decode, self._alloc, tokens,
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, rng=rng, max_seq=max_seq, eos=self.eos)
 
-        logits, cache = self._prefill(self.params, tokens, cache)
-        out = [tokens]
-        rng, step_rng = jax.random.split(rng)
-        next_tok = sample_logits(logits[:, -1], step_rng, temperature,
-                                 top_k, top_p)[:, None]
-        done = jnp.zeros((B,), bool)
-        for _ in range(max_new_tokens - 1):
-            if self.eos is not None:
-                done = done | (next_tok[:, 0] == self.eos)
-            out.append(next_tok)
-            if self.eos is not None and bool(done.all()):
-                break
-            logits, cache = self._decode(self.params, next_tok, cache)
-            rng, step_rng = jax.random.split(rng)
-            nxt = sample_logits(logits[:, -1], step_rng, temperature,
-                                top_k, top_p)[:, None]
-            if self.eos is not None:
-                nxt = jnp.where(done[:, None], jnp.int32(self.eos), nxt)
-            next_tok = nxt
+
+def generate_loop(params, prefill, decode, alloc_cache, tokens,
+                  max_new_tokens: int = 32, temperature: float = 0.0,
+                  top_k: int = 0, top_p: float = 1.0,
+                  rng: Optional[jax.Array] = None,
+                  max_seq: Optional[int] = None, eos: Optional[int] = None):
+    """The host-side autoregressive loop shared by :class:`Generator` and
+    the hybrid engine: prefill once, then decode one token at a time with
+    on-device sampling.  ``prefill``/``decode`` must already be jitted."""
+    tokens = jnp.asarray(tokens, jnp.int32)
+    B, T = tokens.shape
+    total = max_seq or (T + max_new_tokens)
+    cache = alloc_cache(B, total)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    logits, cache = prefill(params, tokens, cache)
+    out = [tokens]
+    rng, step_rng = jax.random.split(rng)
+    next_tok = sample_logits(logits[:, -1], step_rng, temperature,
+                             top_k, top_p)[:, None]
+    done = jnp.zeros((B,), bool)
+    for _ in range(max_new_tokens - 1):
+        if eos is not None:
+            done = done | (next_tok[:, 0] == eos)
         out.append(next_tok)
-        return jnp.concatenate(out, axis=1)
+        if eos is not None and bool(done.all()):
+            break
+        logits, cache = decode(params, next_tok, cache)
+        rng, step_rng = jax.random.split(rng)
+        nxt = sample_logits(logits[:, -1], step_rng, temperature,
+                            top_k, top_p)[:, None]
+        if eos is not None:
+            nxt = jnp.where(done[:, None], jnp.int32(eos), nxt)
+        next_tok = nxt
+    out.append(next_tok)
+    return jnp.concatenate(out, axis=1)
 
 
-def llama_generator(params, cfg, eos_token_id: Optional[int] = None,
-                    cache_dtype=jnp.bfloat16) -> Generator:
-    """Build a :class:`Generator` for models/llama.py weights."""
+def llama_step_alloc(cfg, cache_dtype=jnp.bfloat16):
+    """The (step, alloc_cache) pair for models/llama.py weights — shared
+    by :func:`llama_generator` and the hybrid engine."""
     from deepspeed_tpu.models import llama
 
     def alloc(batch, max_seq):
@@ -151,9 +165,15 @@ def llama_generator(params, cfg, eos_token_id: Optional[int] = None,
                              cfg.head_dim, dtype=cache_dtype)
 
     def step(params, tokens, cache):
-        logits, cache = llama.forward_with_cache(params, tokens, cfg, cache)
-        return logits, cache
+        return llama.forward_with_cache(params, tokens, cfg, cache)
 
+    return step, alloc
+
+
+def llama_generator(params, cfg, eos_token_id: Optional[int] = None,
+                    cache_dtype=jnp.bfloat16) -> Generator:
+    """Build a :class:`Generator` for models/llama.py weights."""
+    step, alloc = llama_step_alloc(cfg, cache_dtype)
     return Generator(params, step, step, alloc, eos_token_id=eos_token_id)
 
 
