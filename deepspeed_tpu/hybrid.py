@@ -111,15 +111,7 @@ class HybridEngine:
         reads ``engine.state.params`` live)."""
         if max_seq is None and self.max_out_tokens is not None:
             max_seq = self.max_out_tokens
-        T = jnp.asarray(tokens).shape[1]
-        if max_seq is not None and T + max_new_tokens > max_seq:
-            # dynamic_update_slice CLAMPS out-of-bounds cache writes, so an
-            # overrun would silently corrupt the rollout instead of failing
-            raise ValueError(
-                f"prompt ({T}) + max_new_tokens ({max_new_tokens}) exceeds "
-                f"the KV cache budget ({max_seq}; hybrid_engine."
-                "max_out_tokens) — raise max_out_tokens or shorten the "
-                "prompt")
+        # overrun vs the cache budget raises inside generate_loop
         return generate_loop(
             self.engine.state.params, self._prefill, self._decode,
             self._alloc, tokens, max_new_tokens=max_new_tokens,

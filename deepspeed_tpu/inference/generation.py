@@ -125,10 +125,22 @@ def generate_loop(params, prefill, decode, alloc_cache, tokens,
                   max_seq: Optional[int] = None, eos: Optional[int] = None):
     """The host-side autoregressive loop shared by :class:`Generator` and
     the hybrid engine: prefill once, then decode one token at a time with
-    on-device sampling.  ``prefill``/``decode`` must already be jitted."""
+    on-device sampling.  ``prefill``/``decode`` must already be jitted.
+
+    Always returns ``[B, T + max_new_tokens]`` — early all-eos exits pad
+    with eos so callers (jitted train steps, slicing code) see one static
+    shape regardless of where generation stopped.
+    """
     tokens = jnp.asarray(tokens, jnp.int32)
     B, T = tokens.shape
     total = max_seq or (T + max_new_tokens)
+    if T + max_new_tokens > total:
+        # dynamic_update_slice CLAMPS out-of-bounds cache writes, so an
+        # overrun would silently corrupt the rollout instead of failing
+        raise ValueError(
+            f"prompt ({T}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"KV cache budget (max_seq={total}) — raise max_seq or shorten "
+            "the prompt")
     cache = alloc_cache(B, total)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
@@ -138,11 +150,15 @@ def generate_loop(params, prefill, decode, alloc_cache, tokens,
     next_tok = sample_logits(logits[:, -1], step_rng, temperature,
                              top_k, top_p)[:, None]
     done = jnp.zeros((B,), bool)
-    for _ in range(max_new_tokens - 1):
+    for produced in range(1, max_new_tokens + 1):
+        out.append(next_tok)
         if eos is not None:
             done = done | (next_tok[:, 0] == eos)
-        out.append(next_tok)
-        if eos is not None and bool(done.all()):
+            if produced < max_new_tokens and bool(done.all()):
+                out.append(jnp.full((B, max_new_tokens - produced), eos,
+                                    jnp.int32))
+                break
+        if produced == max_new_tokens:
             break
         logits, cache = decode(params, next_tok, cache)
         rng, step_rng = jax.random.split(rng)
@@ -151,7 +167,6 @@ def generate_loop(params, prefill, decode, alloc_cache, tokens,
         if eos is not None:
             nxt = jnp.where(done[:, None], jnp.int32(eos), nxt)
         next_tok = nxt
-    out.append(next_tok)
     return jnp.concatenate(out, axis=1)
 
 

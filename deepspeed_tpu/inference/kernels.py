@@ -165,7 +165,63 @@ def write_prompt_pages(pages_k, pages_v, new_k, new_v, table,
     return upd(pages_k, new_k), upd(pages_v, new_v)
 
 
+def write_chunk_pages(pages_k, pages_v, new_k, new_v, table, start,
+                      page_size: int):
+    """Write a mid-sequence chunk's K/V ([B, C, KV, Dh]) at each row's
+    frontier ``start`` ([B] i32) — the chunked-prefill generalization of
+    :func:`write_prompt_pages` (arbitrary, per-row, non-page-aligned
+    offsets) built from the :func:`write_token_pages` scatter, vectorized
+    over the chunk axis.  Positions past a row's capacity are dropped."""
+    B, C, KV, Dh = new_k.shape
+    max_pages = table.shape[1]
+    num_pages = pages_k.shape[1]
+    capacity = max_pages * page_size
+    pos = start[:, None] + jnp.arange(C, dtype=jnp.int32)[None]   # [B, C]
+    valid = pos < capacity
+    page_slot = jnp.minimum(pos // page_size, max_pages - 1)
+    in_page = pos % page_size
+    page_id = jnp.take_along_axis(table, page_slot, axis=1)       # [B, C]
+    page_id = jnp.where(valid, page_id, num_pages)  # out-of-range → drop
+
+    def upd(store, new):
+        # store: [KV, P, ps, Dh]; new: [B, C, KV, Dh] → [KV, B*C, Dh]
+        vals = new.transpose(2, 0, 1, 3).reshape(KV, B * C, Dh)
+        return store.at[:, page_id.reshape(-1), in_page.reshape(-1)].set(
+            vals.astype(store.dtype), mode="drop")
+
+    return upd(pages_k, new_k), upd(pages_v, new_v)
+
+
 # -------------------------------------------------------- numerics oracle
+def paged_chunk_attention_reference(q, k_pages, v_pages, table, start,
+                                    scale: Optional[float] = None):
+    """Chunked-prefill attention: q [B, C, H, Dh] at positions
+    ``start + 0..C-1`` attends causally over the gathered pages (which
+    must already contain the chunk's own K/V).  Returns [B, C, H, Dh].
+
+    This is the split-fuse read path: history + chunk in one masked
+    gather, so a long prompt can be absorbed ``C`` tokens per iteration
+    between decode steps."""
+    B, C, H, Dh = q.shape
+    KV, _, ps, _ = k_pages.shape
+    G = H // KV
+    scale = scale if scale is not None else Dh ** -0.5
+    mp = table.shape[1]
+    kg = k_pages[:, table].transpose(1, 0, 2, 3, 4).reshape(
+        B, KV, mp * ps, Dh)
+    vg = v_pages[:, table].transpose(1, 0, 2, 3, 4).reshape(
+        B, KV, mp * ps, Dh)
+    qg = q.reshape(B, C, KV, G, Dh)
+    s = jnp.einsum("bckgd,bksd->bckgs", qg.astype(jnp.float32),
+                   kg.astype(jnp.float32)) * scale
+    kpos = jnp.arange(mp * ps)[None, None]                  # [1, 1, S]
+    qpos = (start[:, None] + jnp.arange(C)[None])[:, :, None]  # [B, C, 1]
+    s = jnp.where((kpos <= qpos)[:, :, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bckgs,bksd->bckgd", p, vg.astype(jnp.float32))
+    return out.reshape(B, C, H, Dh).astype(q.dtype)
+
+
 def paged_attention_reference(q, k_pages, v_pages, table, seq_lens,
                               scale: Optional[float] = None):
     """q: [B, H, Dh]; k/v_pages: [KV, P, ps, Dh]; table: [B, max_pages];

@@ -78,6 +78,11 @@ class _Slot:
     generated: List[int]
     rng: jax.Array
     seq_id: int = -1                   # PageAllocator owner key
+    prefill_done: int = -1             # chunked prefill progress; -1 = done
+
+    @property
+    def prefilling(self) -> bool:
+        return 0 <= self.prefill_done < len(self.req.tokens)
 
 
 class ServingEngine:
@@ -95,12 +100,23 @@ class ServingEngine:
                  num_pages: int = 128, max_seq: int = 256,
                  prefill_bucket: int = 32, eos_token_id: Optional[int] = None,
                  cache_dtype=jnp.bfloat16, seed: int = 0,
-                 decode_chunk: int = 1):
+                 decode_chunk: int = 1, prefill_chunk: int = 0,
+                 chunk_prefill_fn=None):
         self.params = params
         self.decode_chunk = int(decode_chunk)
         if self.decode_chunk < 1:
             raise ValueError(
                 f"decode_chunk must be >= 1, got {decode_chunk}")
+        # FastGen split-fuse scheduling: prompts are absorbed
+        # prefill_chunk tokens per iteration BETWEEN decode steps, so one
+        # long admission never stalls every in-flight decode.  0 = whole
+        # prompt in one bucketed prefill at admission (the classic path).
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk and chunk_prefill_fn is None:
+            raise ValueError(
+                "prefill_chunk > 0 needs chunk_prefill_fn — a "
+                "(params, tokens, cache) step that attends over history + "
+                "chunk (forward_paged(..., continuation=True))")
         self.eos = eos_token_id
         self.page_size = page_size
         self.max_batch = max_batch
@@ -122,6 +138,8 @@ class ServingEngine:
             page_size=page_size)
 
         self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
+        self._chunk_prefill = (jax.jit(chunk_prefill_fn, donate_argnums=(2,))
+                               if chunk_prefill_fn is not None else None)
 
         # K decode steps in ONE on-device scan: each step's sampled token
         # feeds the next, so the host syncs once per K tokens.  On a
@@ -155,7 +173,7 @@ class ServingEngine:
         self.finished: Dict[Any, List[int]] = {}
         self._newly_finished: List[Any] = []
         self.stats = {"admitted": 0, "preempted": 0, "decode_steps": 0,
-                      "decode_syncs": 0}
+                      "decode_syncs": 0, "prefill_chunks": 0}
 
     # ------------------------------------------------------------- requests
     def submit(self, req_id, tokens, max_new_tokens: int = 32,
@@ -185,15 +203,24 @@ class ServingEngine:
     def _upload_dirty(self) -> None:
         """One batched host→device upload of whatever changed (the whole
         table is [max_batch, pages_per_seq] int32 — tiny; uploading it
-        wholesale beats per-row ``.at[b].set`` device updates)."""
+        wholesale beats per-row ``.at[b].set`` device updates).
+
+        Rows still mid-chunked-prefill upload as TRASH with len 0: the
+        batched decode writes a token structurally for every row, and a
+        half-prefilled row must not take that write into its real pages
+        (its chunk forwards use a private host-built view instead)."""
+        pending = [b for b, s in enumerate(self.slots)
+                   if s is not None and s.prefilling]
         if self._table_dirty:
-            self.cache = self.cache._replace(
-                table=jnp.asarray(self._table_host))
+            up = self._table_host.copy()
+            for b in pending:
+                up[b, :] = self.trash_page
+            self.cache = self.cache._replace(table=jnp.asarray(up))
             self._table_dirty = False
         if self._lens_dirty:
             lens = np.zeros((self.max_batch,), np.int32)
             for b, s in enumerate(self.slots):
-                if s is not None:
+                if s is not None and not s.prefilling:
                     lens[b] = s.seq_len
             self.cache = self.cache._replace(seq_lens=jnp.asarray(lens))
             self._lens_dirty = False
@@ -216,7 +243,7 @@ class ServingEngine:
             return False
         req = self.queue[0]
         T = len(req.tokens)
-        bkt = self.prefill_bucket
+        bkt = self.prefill_chunk or self.prefill_bucket
         # bucket-pad for a bounded compile count, clamped to the table
         # width (a prompt near max_seq must not pad past the row)
         Tpad = min(-(-T // bkt) * bkt,
@@ -232,6 +259,15 @@ class ServingEngine:
         self._table_host[b, :need] = pages
         self._table_dirty = self._lens_dirty = True
 
+        self._rng, rng = jax.random.split(self._rng)
+        if self.prefill_chunk:
+            # split-fuse: defer the prompt to per-iteration chunks; the
+            # slot is not decode-ready until prefill_done reaches T
+            self.slots[b] = _Slot(req=req, seq_len=0, generated=[],
+                                  rng=rng, seq_id=seq_id, prefill_done=0)
+            self.stats["admitted"] += 1
+            return True
+
         toks = np.full((1, Tpad), 0, np.int32)
         toks[0, :T] = req.tokens
         # table row from the HOST copy: a [b:b+1] device slice can alias
@@ -244,7 +280,6 @@ class ServingEngine:
         logits, view = self._prefill(self.params, jnp.asarray(toks), view)
         self.cache = self.cache._replace(k=view.k, v=view.v)
 
-        self._rng, rng = jax.random.split(self._rng)
         slot = _Slot(req=req, seq_len=T, generated=[], rng=rng,
                      seq_id=seq_id)
         self.slots[b] = slot
@@ -252,6 +287,44 @@ class ServingEngine:
         # first generated token comes from the REAL last prompt position
         self._append_token(b, self._sample(logits[0, T - 1], slot))
         return True
+
+    def _advance_prefill(self, b: int, s: "_Slot") -> None:
+        """Absorb the next ``prefill_chunk`` prompt tokens of slot ``b``
+        (one fixed-shape continuation forward: history + chunk).  On the
+        final chunk, sample the first generated token from the last REAL
+        prompt position and flip the slot decode-ready."""
+        C = self.prefill_chunk
+        T = len(s.req.tokens)
+        done = s.prefill_done
+        take = min(C, T - done)
+        toks = np.zeros((1, C), np.int32)
+        toks[0, :take] = s.req.tokens[done:done + take]
+        # slice the view table to the live history: the chunk attention
+        # gathers every page the table names, so handing it the full
+        # max_seq row would make each chunk cost O(max_seq) rather than
+        # O(done + C).  Power-of-two bucketing bounds the compile count.
+        np_live = -(-(done + C) // self.page_size)
+        np_bkt = 1
+        while np_bkt < np_live:
+            np_bkt *= 2
+        np_bkt = min(np_bkt, self.max_pages_per_seq)
+        view = PagedKVCache(
+            k=self.cache.k, v=self.cache.v,
+            table=jnp.asarray(self._table_host[b:b + 1, :np_bkt]),
+            seq_lens=jnp.full((1,), done, jnp.int32),
+            page_size=self.page_size)
+        logits, view = self._chunk_prefill(self.params, jnp.asarray(toks),
+                                           view)
+        self.cache = self.cache._replace(k=view.k, v=view.v)
+        s.prefill_done = done + take
+        s.seq_len = s.prefill_done
+        self.stats["prefill_chunks"] += 1
+        if s.prefill_done >= T:
+            s.prefill_done = -1
+            # decode-ready: the device table/lens row must flip from
+            # trash to the real pages before the next decode
+            self._table_dirty = self._lens_dirty = True
+            self._append_token(b, self._sample(logits[0, take - 1], s))
 
     def _preempt_youngest(self) -> None:
         """vLLM-style recompute preemption: release the youngest slot's
@@ -307,7 +380,8 @@ class ServingEngine:
         it finishes."""
         ps = self.page_size
         for b, s in enumerate(self.slots):
-            if s is None:
+            if s is None or s.prefilling:
+                # chunk writes land in the pages reserved at admission
                 continue
             lifetime = len(s.req.tokens) + s.req.max_new_tokens
             # last KV write is at lifetime-2: the final generated token is
@@ -334,12 +408,18 @@ class ServingEngine:
         self._newly_finished = []
         while self._admit_one():
             pass
+        # split-fuse: absorb ONE chunk per pending-prefill slot, then run
+        # the batched decode for every ready slot in the same iteration
+        for b, s in list(enumerate(self.slots)):
+            if s is not None and s.prefilling:
+                self._advance_prefill(b, s)
         K = self.decode_chunk
-        active = [(b, s) for b, s in enumerate(self.slots) if s is not None]
+        ready = lambda: [(b, s) for b, s in enumerate(self.slots)
+                         if s is not None and not s.prefilling]
+        active = ready()
         if active:
             self._grow_pages(ahead=K)
-            active = [(b, s) for b, s in enumerate(self.slots)
-                      if s is not None]
+            active = ready()
         if active:
             self._upload_dirty()
             toks = np.zeros((self.max_batch, 1), np.int32)
@@ -393,6 +473,10 @@ def llama_serving_engine(params, cfg, **kw) -> ServingEngine:
     def step(params, tokens, cache):
         return llama.forward_paged(params, tokens, cfg, cache)
 
+    def chunk_step(params, tokens, cache):
+        return llama.forward_paged(params, tokens, cfg, cache,
+                                   continuation=True)
+
     return ServingEngine(
         params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
-        head_dim=cfg.head_dim, **kw)
+        head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, **kw)

@@ -46,6 +46,7 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     remat: str = "none"                    # none | full | save_dots
+    loss_chunk: int = 0                    # >0: fused chunked-vocab CE
     attn_impl: str = "auto"                # auto | flash | reference | ring
 
     def __post_init__(self):
@@ -245,14 +246,11 @@ def _block(cfg: LlamaConfig, x, layer_params, cos, sin, segment_ids):
     return x
 
 
-def forward(params, tokens, cfg: LlamaConfig, positions=None,
-            segment_ids=None, n_micro: Optional[int] = None):
-    """tokens: [B, T] int32 → logits [B, T, V] (f32).
-
-    ``n_micro``: with a ``pipe`` axis in the ambient mesh, the block stack
-    runs as a pipeline of n_micro microbatches (parallel/pipeline.py);
-    embed/head stay under plain GSPMD on either side.
-    """
+def forward_hidden(params, tokens, cfg: LlamaConfig, positions=None,
+                   segment_ids=None, n_micro: Optional[int] = None):
+    """tokens: [B, T] int32 → final-norm hidden states [B, T, d] (the
+    pre-LM-head activations; :func:`forward` adds the head projection,
+    the chunked loss consumes these directly)."""
     B, T = tokens.shape
     x = params["embed"][tokens]  # [B, T, d]
     if positions is None:
@@ -275,9 +273,24 @@ def forward(params, tokens, cfg: LlamaConfig, positions=None,
             block = jax.checkpoint(block, policy=remat_policy(cfg.remat))
         x, _ = jax.lax.scan(block, x, params["blocks"])
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return jnp.einsum("btd,dv->btv", x, head,
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def lm_head(params, cfg: LlamaConfig):
+    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+
+
+def forward(params, tokens, cfg: LlamaConfig, positions=None,
+            segment_ids=None, n_micro: Optional[int] = None):
+    """tokens: [B, T] int32 → logits [B, T, V] (f32).
+
+    ``n_micro``: with a ``pipe`` axis in the ambient mesh, the block stack
+    runs as a pipeline of n_micro microbatches (parallel/pipeline.py);
+    embed/head stay under plain GSPMD on either side.
+    """
+    x = forward_hidden(params, tokens, cfg, positions=positions,
+                       segment_ids=segment_ids, n_micro=n_micro)
+    return jnp.einsum("btd,dv->btv", x, lm_head(params, cfg),
                       preferred_element_type=jnp.float32)
 
 
@@ -324,17 +337,22 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache):
 
 
 def forward_paged(params, tokens, cfg: LlamaConfig, cache,
-                  interpret: Optional[bool] = None):
+                  interpret: Optional[bool] = None,
+                  continuation: bool = False):
     """Forward over a paged KV cache (ref: the reference's inference
     kernels' workspace contract, modernised to vLLM-style page tables).
 
     Prefill (T > 1, empty cache): dense causal attention over the prompt,
     K/V bulk-written into pages.  Decode (T == 1): pallas paged attention
-    streaming only the live pages.  tokens: [B, T] → (logits, cache).
+    streaming only the live pages.  ``continuation=True`` (T > 1,
+    non-empty cache): chunked prefill — the chunk's K/V scatter in at
+    each row's frontier and attention runs over history + chunk (the
+    FastGen split-fuse read path).  tokens: [B, T] → (logits, cache).
     """
     from deepspeed_tpu.inference.kernels import (
-        paged_attention_reference, paged_decode_attention,
-        write_prompt_pages, write_token_pages)
+        paged_attention_reference, paged_chunk_attention_reference,
+        paged_decode_attention, write_chunk_pages, write_prompt_pages,
+        write_token_pages)
     from deepspeed_tpu.ops.attention import flash_attention
     from deepspeed_tpu.ops.fused_ops import swiglu
 
@@ -349,16 +367,16 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
     # batching rotate each row by ITS seq_len, not row 0's
     positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
     cos, sin = rope_tables(cfg, positions)
-    prefill = T > 1
+    prefill = T > 1 and not continuation
     if prefill:
         # bulk page writes start at slot 0 and attention is prompt-local:
-        # only valid on an empty cache (no chunked prefill)
+        # only valid on an empty cache (chunked prefill passes
+        # continuation=True instead)
         try:
             if int(jnp.max(start)) != 0:
                 raise ValueError(
                     "forward_paged prefill (T>1) requires an empty cache; "
-                    "chunked prefill is not supported — decode token by "
-                    "token past the first chunk")
+                    "pass continuation=True for chunked prefill")
         except (jax.errors.TracerArrayConversionError,
                 jax.errors.ConcretizationTypeError):
             pass  # traced: caller's responsibility
@@ -371,7 +389,11 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
         v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        if prefill:
+        if T > 1 and continuation:
+            kp, vp = write_chunk_pages(kp, vp, k, v, cache.table, start, ps)
+            attn = paged_chunk_attention_reference(
+                q, kp, vp, cache.table, start)
+        elif prefill:
             attn = flash_attention(q, k, v, causal=True)
             kp, vp = write_prompt_pages(kp, vp, k, v, cache.table, ps)
         else:
@@ -416,17 +438,19 @@ def loss_fn(cfg: LlamaConfig, n_micro: Optional[int] = None):
     """
 
     def f(params, batch):
+        from deepspeed_tpu.ops.losses import chunked_lm_loss
+
         tokens = batch["tokens"]
-        logits = forward(params, tokens[:, :-1], cfg,
-                         segment_ids=batch.get("segment_ids"),
-                         n_micro=n_micro)
         targets = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         mask = batch.get("loss_mask")
-        if mask is None:
-            return jnp.mean(nll)
-        mask = mask[:, 1:].astype(jnp.float32)
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        if mask is not None:
+            mask = mask[:, 1:].astype(jnp.float32)
+        x = forward_hidden(params, tokens[:, :-1], cfg,
+                           segment_ids=batch.get("segment_ids"),
+                           n_micro=n_micro)
+        # loss_chunk=0 → dense path inside chunked_lm_loss (chunk >= V);
+        # >0 → fused head+CE, the [B,T,V] f32 logits never hit HBM
+        return chunked_lm_loss(x, lm_head(params, cfg), targets, mask=mask,
+                               chunk=cfg.loss_chunk or cfg.vocab_size)
 
     return f

