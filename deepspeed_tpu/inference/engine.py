@@ -29,11 +29,26 @@ class InferenceEngine:
     def __init__(self, apply_fn: Callable, params: Any,
                  mesh: Optional[MeshSpec] = None,
                  param_specs: SpecTree = None,
-                 dtype: str = "bfloat16"):
+                 dtype: str = "bfloat16", quant_group_size: int = 128):
         self.mesh = mesh or default_mesh()
+        if dtype == "int8":
+            # weight-only quantization (ref: init_inference(dtype=int8)):
+            # int8 codes + group scales resident in HBM, dequant traced
+            # into the forward so it fuses with each weight's consumer
+            if param_specs is not None:
+                raise ValueError(
+                    "param_specs (TP shardings) do not compose with "
+                    "weight-only int8 yet — quantize after sharding or "
+                    "drop one of the two")
+            from deepspeed_tpu.inference.quantized import (
+                quantize_for_inference)
+
+            params, apply_fn = quantize_for_inference(
+                params, apply_fn, group_size=quant_group_size)
+        else:
+            pcfg = PrecisionConfig(dtype=dtype)
+            params = precision.cast_for_compute(params, pcfg)
         self.apply_fn = apply_fn
-        pcfg = PrecisionConfig(dtype=dtype)
-        params = precision.cast_for_compute(params, pcfg)
         shardings = param_shardings(params, self.mesh, stage=0,
                                     param_specs=param_specs)
         self.params = jax.jit(lambda p: p, out_shardings=shardings)(params)
@@ -59,7 +74,8 @@ def init_inference(model: Any = None, *, apply_fn: Optional[Callable] = None,
                    params: Any = None, config: Any = None,
                    mesh: Optional[MeshSpec] = None,
                    param_specs: SpecTree = None,
-                   dtype: str = "bfloat16", **_compat) -> InferenceEngine:
+                   dtype: str = "bfloat16", quant_group_size: int = 128,
+                   **_compat) -> InferenceEngine:
     """ref: deepspeed.init_inference(model, config…) → engine.
 
     ``model`` may be an object with ``.apply``/``.params`` (flax-style) or
@@ -75,4 +91,5 @@ def init_inference(model: Any = None, *, apply_fn: Optional[Callable] = None,
     if params is None:
         raise ValueError("init_inference requires params")
     return InferenceEngine(apply_fn, params, mesh=mesh,
-                           param_specs=param_specs, dtype=dtype)
+                           param_specs=param_specs, dtype=dtype,
+                           quant_group_size=quant_group_size)

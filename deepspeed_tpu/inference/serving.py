@@ -466,8 +466,14 @@ class ServingEngine:
         return out
 
 
-def llama_serving_engine(params, cfg, **kw) -> ServingEngine:
-    """ServingEngine over models/llama.py's paged forward."""
+def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
+                         quant_group_size: int = 128, **kw) -> ServingEngine:
+    """ServingEngine over models/llama.py's paged forward.
+
+    ``weight_dtype="int8"``: weight-only quantized serving (ref:
+    init_inference(dtype=int8)) — int8 codes + group scales in HBM
+    (half the bf16 weight residency), dequant traced into the forward.
+    """
     from deepspeed_tpu.models import llama
 
     def step(params, tokens, cache):
@@ -476,6 +482,14 @@ def llama_serving_engine(params, cfg, **kw) -> ServingEngine:
     def chunk_step(params, tokens, cache):
         return llama.forward_paged(params, tokens, cfg, cache,
                                    continuation=True)
+
+    if weight_dtype != "bfloat16":
+        from deepspeed_tpu.inference.quantized import quantize_for_inference
+
+        # raises on anything but "int8" — never silently serve unquantized
+        params, step, chunk_step = quantize_for_inference(
+            params, step, chunk_step, weight_dtype=weight_dtype,
+            group_size=quant_group_size)
 
     return ServingEngine(
         params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
