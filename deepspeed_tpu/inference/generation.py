@@ -170,25 +170,41 @@ def generate_loop(params, prefill, decode, alloc_cache, tokens,
     return jnp.concatenate(out, axis=1)
 
 
-def llama_step_alloc(cfg, cache_dtype=jnp.bfloat16):
-    """The (step, alloc_cache) pair for models/llama.py weights — shared
-    by :func:`llama_generator` and the hybrid engine."""
-    from deepspeed_tpu.models import llama
-
+def cached_step_alloc(forward_with_cache, cfg, cache_dtype=jnp.bfloat16):
+    """The (step, alloc_cache) pair over any model's
+    ``forward_with_cache(params, tokens, cfg, cache)`` — shared by the
+    generators and the hybrid engine so the cache wiring lives once."""
     def alloc(batch, max_seq):
         return KVCache.alloc(cfg.n_layers, batch, max_seq, cfg.n_kv_heads,
                              cfg.head_dim, dtype=cache_dtype)
 
     def step(params, tokens, cache):
-        return llama.forward_with_cache(params, tokens, cfg, cache)
+        return forward_with_cache(params, tokens, cfg, cache)
 
     return step, alloc
+
+
+def llama_step_alloc(cfg, cache_dtype=jnp.bfloat16):
+    from deepspeed_tpu.models import llama
+
+    return cached_step_alloc(llama.forward_with_cache, cfg, cache_dtype)
 
 
 def llama_generator(params, cfg, eos_token_id: Optional[int] = None,
                     cache_dtype=jnp.bfloat16) -> Generator:
     """Build a :class:`Generator` for models/llama.py weights."""
     step, alloc = llama_step_alloc(cfg, cache_dtype)
+    return Generator(params, step, step, alloc, eos_token_id=eos_token_id)
+
+
+def mixtral_generator(params, cfg, eos_token_id: Optional[int] = None,
+                      cache_dtype=jnp.bfloat16) -> Generator:
+    """MoE text generation (ref: DeepSpeed-MoE inference): cached
+    attention + capacity-free dense top-k expert combine."""
+    from deepspeed_tpu.models import mixtral
+
+    step, alloc = cached_step_alloc(mixtral.forward_with_cache, cfg,
+                                    cache_dtype)
     return Generator(params, step, step, alloc, eos_token_id=eos_token_id)
 
 

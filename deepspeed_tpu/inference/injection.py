@@ -125,23 +125,77 @@ def _llama_weights(sd: Dict[str, np.ndarray], cfg):
     return params
 
 
-def _register_builtin():
-    from deepspeed_tpu.models import llama
+def _mixtral_config(hf: Dict[str, Any]):
+    from deepspeed_tpu.models.mixtral import MixtralConfig
 
-    register_policy(InjectionPolicy(
-        arch="llama",
-        build_config=_llama_config,
-        convert_weights=_llama_weights,
-        apply_fn=lambda p, tokens, cfg: llama.forward(p, tokens, cfg),
-        param_specs=lambda cfg: llama.param_specs(cfg),
-    ))
-    register_policy(InjectionPolicy(
-        arch="llamaforcausallm",
-        build_config=_llama_config,
-        convert_weights=_llama_weights,
-        apply_fn=lambda p, tokens, cfg: llama.forward(p, tokens, cfg),
-        param_specs=lambda cfg: llama.param_specs(cfg),
-    ))
+    return MixtralConfig(
+        vocab_size=hf.get("vocab_size", 32000),
+        dim=hf.get("hidden_size", 4096),
+        n_layers=hf.get("num_hidden_layers", 32),
+        n_heads=hf.get("num_attention_heads", 32),
+        n_kv_heads=hf.get("num_key_value_heads", 8),
+        ffn_dim=hf.get("intermediate_size"),
+        num_experts=hf.get("num_local_experts", 8),
+        top_k=hf.get("num_experts_per_tok", 2),
+        max_seq_len=hf.get("max_position_embeddings", 4096),
+        rope_theta=hf.get("rope_theta", 1e6),
+        norm_eps=hf.get("rms_norm_eps", 1e-5),
+    )
+
+
+def _mixtral_weights(sd: Dict[str, np.ndarray], cfg):
+    """HF Mixtral layout → stacked [L, ...] / [L, E, ...] pytree."""
+    L, E = cfg.n_layers, cfg.num_experts
+    t = lambda name: np.asarray(sd[name]).T
+    stack = lambda fmt: np.stack([t(fmt.format(i)) for i in range(L)])
+    stack_raw = lambda fmt: np.stack(
+        [np.asarray(sd[fmt.format(i)]) for i in range(L)])
+    estack = lambda fmt: np.stack(
+        [np.stack([t(fmt.format(i, e)) for e in range(E)])
+         for i in range(L)])
+    moe = "model.layers.{}.block_sparse_moe"
+    return {
+        "embed": np.asarray(sd["model.embed_tokens.weight"]),
+        "blocks": {
+            "attn_norm": stack_raw("model.layers.{}.input_layernorm.weight"),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "mlp_norm": stack_raw(
+                "model.layers.{}.post_attention_layernorm.weight"),
+            "gate": stack(moe + ".gate.weight"),
+            "w1": estack(moe + ".experts.{}.w1.weight"),
+            "w3": estack(moe + ".experts.{}.w3.weight"),
+            "w2": estack(moe + ".experts.{}.w2.weight"),
+        },
+        "final_norm": np.asarray(sd["model.norm.weight"]),
+        "lm_head": np.asarray(sd["lm_head.weight"]).T,
+    }
+
+
+def _register_builtin():
+    from deepspeed_tpu.models import llama, mixtral
+
+    for arch in ("llama", "llamaforcausallm"):
+        register_policy(InjectionPolicy(
+            arch=arch,
+            build_config=_llama_config,
+            convert_weights=_llama_weights,
+            apply_fn=lambda p, tokens, cfg: llama.forward(p, tokens, cfg),
+            param_specs=lambda cfg: llama.param_specs(cfg),
+        ))
+    for arch in ("mixtral", "mixtralforcausallm"):
+        register_policy(InjectionPolicy(
+            arch=arch,
+            build_config=_mixtral_config,
+            convert_weights=_mixtral_weights,
+            # eval forward: capacity-free dense top-k combine — injected
+            # inference must never drop tokens on router imbalance
+            apply_fn=lambda p, tokens, cfg: mixtral.forward_eval(
+                p, tokens, cfg),
+            param_specs=lambda cfg: mixtral.param_specs(cfg),
+        ))
 
 
 _register_builtin()

@@ -210,6 +210,112 @@ def forward(params, tokens, cfg: MixtralConfig, positions=None):
     return logits, aux
 
 
+def _moe_ffn_dense(cfg: MixtralConfig, x, lp):
+    """Capacity-free exact top-k MoE for the inference path (ref:
+    DeepSpeed-MoE inference, deepspeed/moe/sharded_moe.py at eval).
+
+    Training uses the capacity-limited dispatch (token drops are part of
+    the reference's ``drop_tokens=True`` semantics under load); inference
+    must not drop.  Every expert evaluates all tokens and outputs combine
+    by the renormalized top-k gate probs — E/k× the top-k FFN FLOPs, but
+    for EXACT no-drop routing that is already optimal among dense
+    formulations: a capacity dispatch only guarantees zero drops at
+    factor >= E/k, where its expert FLOPs equal the dense path's and its
+    [N, E, N·k/E·factor] dispatch tensor adds O(N²·k) on top.  (A ragged
+    sort-based dispatch — Megablocks-style — is the only cheaper exact
+    option; candidate for a pallas kernel later.)  At decode (N = a few
+    tokens) the overhead is noise either way.
+    """
+    from deepspeed_tpu.ops.fused_ops import swiglu
+
+    B, T, d = x.shape
+    E, k = cfg.num_experts, cfg.top_k
+    h = x.reshape(-1, d)
+    # router math in f32 like the training gate — bf16 logits could flip
+    # a near-tied top-k choice and diverge from the trained routing
+    logits = h.astype(jnp.float32) @ lp["gate"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    _, topi = jax.lax.top_k(logits, k)                              # [N, k]
+    w = jnp.take_along_axis(probs, topi, axis=-1)
+    if k > 1:
+        # same renormalization as the training gate (top2gating)
+        w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-9)
+    ys = jax.vmap(lambda p1, p3, p2: swiglu(h, p1, p3) @ p2)(
+        lp["w1"], lp["w3"], lp["w2"])                               # [E, N, d]
+    wfull = jnp.sum(jax.nn.one_hot(topi, E, dtype=w.dtype)
+                    * w[..., None], axis=1)                         # [N, E]
+    y = jnp.einsum("ne,end->nd", wfull, ys.astype(w.dtype))
+    return y.reshape(B, T, d).astype(x.dtype)
+
+
+def forward_eval(params, tokens, cfg: MixtralConfig, positions=None):
+    """Cache-free inference forward: the training attention path with the
+    capacity-free dense MoE combine (no token drops).  This is what
+    kernel injection serves — the reference's eval-mode contract, where
+    generation quality must not depend on router load balance."""
+    lcfg = cfg.llama_view()
+    B, T = tokens.shape
+    x = params["embed"][tokens]
+    if positions is None:
+        positions = jnp.arange(T, dtype=jnp.int32)
+    cos, sin = _llama.rope_tables(lcfg, positions)
+
+    def block(x, lp):
+        h = _llama.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+        q = (h @ lp["wq"]).reshape(B, T, nh, hd)
+        k = (h @ lp["wk"]).reshape(B, T, nkv, hd)
+        v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
+        q = _llama.apply_rope(q, cos, sin)
+        k = _llama.apply_rope(k, cos, sin)
+        attn = _llama._attention(q, k, v, lcfg).reshape(B, T, nh * hd)
+        x = x + attn @ lp["wo"]
+        h = _llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        return x + _moe_ffn_dense(cfg, h, lp), None
+
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+    x = _llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return jnp.einsum("btd,dv->btv", x, params["lm_head"],
+                      preferred_element_type=jnp.float32)
+
+
+def forward_with_cache(params, tokens, cfg: MixtralConfig, cache):
+    """Incremental MoE forward for generation (DeepSpeed-MoE inference
+    parity): llama-style cached attention + capacity-free dense top-k
+    expert combine.  tokens: [B, T] → (logits [B, T, V] f32, cache)."""
+    from deepspeed_tpu.inference.generation import cached_attention
+
+    lcfg = cfg.llama_view()
+    B, T = tokens.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    start = cache.length
+    x = params["embed"][tokens]
+    positions = start + jnp.arange(T, dtype=jnp.int32)
+    cos, sin = _llama.rope_tables(lcfg, positions)
+
+    def block(x, layer):
+        lp, kc, vc = layer
+        h = _llama.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, T, nh, hd)
+        k = (h @ lp["wk"]).reshape(B, T, nkv, hd)
+        v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
+        q = _llama.apply_rope(q, cos, sin)
+        k = _llama.apply_rope(k, cos, sin)
+        attn, kc, vc = cached_attention(q, kc, vc, k, v, start)
+        x = x + attn.reshape(B, T, nh * hd) @ lp["wo"]
+        h = _llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + _moe_ffn_dense(cfg, h, lp)
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(block, x,
+                                     (params["blocks"], cache.k, cache.v))
+    x = _llama.rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    cache = cache._replace(k=new_k, v=new_v, length=start + T)
+    return logits, cache
+
+
 def loss_fn(cfg: MixtralConfig):
     """Next-token CE + MoE aux losses; returns (loss, aux)."""
 

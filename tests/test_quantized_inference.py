@@ -37,13 +37,15 @@ class TestQuantizeParams:
         np.testing.assert_array_equal(np.asarray(qp["final_norm"]),
                                       np.asarray(params["final_norm"]))
 
-    def test_memory_halves(self, model):
+    def test_memory_halves_vs_bf16(self, model):
         cfg, params = model
-        qp = quantize_params(params)
-        orig = sum(l.size * jnp.asarray(l).dtype.itemsize
-                   for l in jax.tree.leaves(params))
+        bf16 = jax.tree.map(lambda l: jnp.asarray(l, jnp.bfloat16), params)
+        qp = quantize_params(bf16)
+        orig = sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(bf16))
         quant = sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(qp))
-        assert quant < 0.62 * orig  # bf16→int8 on weights + f32 scales
+        # int8 codes ≈ half the bf16 bytes; group scales (f32, 1/128 of
+        # elements) add ~3% — anything past 0.56 means grouping regressed
+        assert quant < 0.56 * orig, quant / orig
 
 
 class TestInt8Inference:
