@@ -25,6 +25,21 @@ def _ckpt_dir(save_dir: str, tag: str) -> str:
     return os.path.join(os.path.abspath(save_dir), tag)
 
 
+def _resolve_tag(load_dir: str, tag: Optional[str],
+                 required: bool) -> Optional[str]:
+    """Tag from the ``latest`` file when not given explicitly."""
+    if tag is not None:
+        return tag
+    latest = os.path.join(os.path.abspath(load_dir), "latest")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            return f.read().strip()
+    if required:
+        raise FileNotFoundError(
+            f"no 'latest' file under {load_dir}; pass tag= explicitly")
+    return None
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None) -> str:
     """ref: DeepSpeedEngine.save_checkpoint(save_dir, tag, client_state)."""
@@ -58,12 +73,9 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None):
     """
     import orbax.checkpoint as ocp
 
+    tag = _resolve_tag(load_dir, tag, required=False)
     if tag is None:
-        latest = os.path.join(os.path.abspath(load_dir), "latest")
-        if not os.path.exists(latest):
-            return None, {}
-        with open(latest) as f:
-            tag = f.read().strip()
+        return None, {}
     path = _ckpt_dir(load_dir, tag)
     ckptr = ocp.StandardCheckpointer()
     target = jax.tree.map(
@@ -86,3 +98,63 @@ def consolidate_to_fp32(engine):
     return jax.tree.map(lambda p: np.asarray(p, np.float32)
                         if np.issubdtype(np.asarray(p).dtype, np.floating)
                         else np.asarray(p), params)
+
+
+# ------------------------------------------------------------ offline CLI
+def zero_to_fp32(ckpt_dir: str, output: str, tag: Optional[str] = None):
+    """Offline checkpoint → consolidated fp32 params file, engine-free
+    (ref: deepspeed/utils/zero_to_fp32.py, which users run on a saved
+    checkpoint directory without building the model).
+
+    Orbax already stores global (unsharded) array values, so unlike the
+    reference there is no rank-shard stitching — just load, take the
+    ``params`` subtree, cast, and write one ``.npz`` keyed by pytree path.
+    (Known cost: stable orbax has no partial-subtree restore, so the full
+    TrainState — params + optimizer moments — is materialized before the
+    non-param subtrees are dropped; peak RAM is ~3× the param bytes.)
+    """
+    import orbax.checkpoint as ocp
+
+    tag = _resolve_tag(ckpt_dir, tag, required=True)
+    meta_path = os.path.join(_ckpt_dir(ckpt_dir, tag), "meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            cfg = json.load(f).get("config", {})
+        if (cfg.get("zero_optimization") or {}).get(
+                "zero_quantized_weights"):
+            raise ValueError(
+                "this checkpoint was written by the qwZ engine: its "
+                "params are one flat [world, chunk] buffer, not a module "
+                "pytree — consolidate in-process via "
+                "engine.module_params() / consolidate_to_fp32(engine)")
+    state_path = os.path.join(_ckpt_dir(ckpt_dir, tag), "state")
+    restored = ocp.StandardCheckpointer().restore(state_path)
+    params = restored["params"] if "params" in restored else restored
+    flat = {}
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in keypath)
+        arr = np.asarray(leaf)
+        flat[name] = arr.astype(np.float32) if \
+            np.issubdtype(arr.dtype, np.floating) else arr
+    np.savez(output, **flat)
+    logger.info("wrote %d fp32 tensors to %s", len(flat), output)
+    return flat
+
+
+def main(argv=None):
+    """``dstpu-zero-to-fp32 <checkpoint_dir> <output.npz> [--tag TAG]``"""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Consolidate a deepspeed_tpu checkpoint into one "
+                    "fp32 .npz (ref: zero_to_fp32.py)")
+    ap.add_argument("checkpoint_dir")
+    ap.add_argument("output")
+    ap.add_argument("--tag", default=None)
+    args = ap.parse_args(argv)
+    zero_to_fp32(args.checkpoint_dir, args.output, tag=args.tag)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,161 @@
+"""Offline dataset analysis for curriculum learning (ref:
+deepspeed/runtime/data_pipeline/data_sampling/data_analyzer.py
+DataAnalyzer / DistributedDataAnalyzer).
+
+The reference maps metric functions over the training set ahead of time
+(sharded across workers, merged into index files) so the curriculum
+sampler can order samples by measured difficulty instead of a schedule
+proxy.  Same here: host-side numpy over dataset shards — this is IO/CPU
+work with no accelerator involvement — with per-worker shard files and
+an explicit merge, feeding
+:class:`~deepspeed_tpu.data.curriculum.DifficultyIndexer`.
+
+Built-in metrics (the reference's two standard ones):
+
+- ``seqlen``: non-pad token count per sample.
+- ``vocab_rarity``: mean −log p(token) under the corpus unigram
+  distribution (two passes: corpus counts, then per-sample score).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.data.curriculum import DifficultyIndexer
+
+
+def _tokens_of(sample) -> np.ndarray:
+    if isinstance(sample, dict):
+        for key in ("tokens", "input_ids", "text_ids"):
+            if key in sample:
+                return np.asarray(sample[key]).reshape(-1)
+        raise KeyError(
+            f"sample dict has none of tokens/input_ids/text_ids: "
+            f"{list(sample)}")
+    return np.asarray(sample).reshape(-1)
+
+
+def seqlen_metric(pad_token_id: int = 0) -> Callable[[Any], float]:
+    def f(sample):
+        toks = _tokens_of(sample)
+        return float(np.sum(toks != pad_token_id))
+
+    return f
+
+
+class VocabRarity:
+    """Two-pass metric: ``fit`` accumulates corpus token counts, the call
+    scores a sample by mean −log p(token)."""
+
+    def __init__(self, vocab_size: int, pad_token_id: Optional[int] = None):
+        self.counts = np.zeros(vocab_size, np.int64)
+        self.pad = pad_token_id
+        self._logp: Optional[np.ndarray] = None
+
+    def fit(self, dataset: Sequence) -> "VocabRarity":
+        V = len(self.counts)
+        for sample in dataset:
+            toks = _tokens_of(sample)
+            if toks.size and (toks.min() < 0 or toks.max() >= V):
+                raise ValueError(
+                    f"token id {int(toks.min())}..{int(toks.max())} outside "
+                    f"vocab_size {V} — did added special tokens grow the "
+                    "vocab past the size passed to VocabRarity?")
+            self.counts += np.bincount(toks, minlength=V)
+        if self.pad is not None:
+            self.counts[self.pad] = 0
+        total = max(self.counts.sum(), 1)
+        p = self.counts / total
+        # unseen tokens are the HARDEST, not the easiest: floor p at 1e-12
+        # so −log p is large for out-of-corpus ids instead of zero
+        self._logp = np.log(np.maximum(p, 1e-12))
+        return self
+
+    def __call__(self, sample) -> float:
+        if self._logp is None:
+            raise RuntimeError("VocabRarity.fit(dataset) must run first")
+        toks = _tokens_of(sample)
+        if self.pad is not None:
+            toks = toks[toks != self.pad]
+        if toks.size == 0:
+            return 0.0
+        return float(-np.mean(self._logp[toks]))
+
+
+class DataAnalyzer:
+    """Map ``metric_fns`` over (a shard of) the dataset and persist the
+    results (ref: DataAnalyzer.run_map / run_reduce).
+
+    ``worker_id``/``num_workers`` shard by stride so each launcher process
+    analyzes only its slice; :meth:`merge` runs once afterwards to
+    combine shard files into one ``{metric}.npy`` per metric.
+    """
+
+    def __init__(self, metric_fns: Dict[str, Callable[[Any], float]],
+                 save_path: str, worker_id: int = 0, num_workers: int = 1):
+        if not metric_fns:
+            raise ValueError("DataAnalyzer needs at least one metric fn")
+        if not (0 <= worker_id < num_workers):
+            raise ValueError(f"worker_id {worker_id} outside "
+                             f"num_workers {num_workers}")
+        self.metric_fns = dict(metric_fns)
+        self.save_path = save_path
+        self.worker_id = worker_id
+        self.num_workers = num_workers
+        os.makedirs(save_path, exist_ok=True)
+
+    # ------------------------------------------------------------- map
+    def _shard_file(self, metric: str, worker: int) -> str:
+        return os.path.join(self.save_path,
+                            f"{metric}.worker{worker}.npz")
+
+    def run_map(self, dataset: Sequence) -> Dict[str, np.ndarray]:
+        """Score this worker's stride-shard; writes one shard file per
+        metric holding (indices, values)."""
+        idx = np.arange(self.worker_id, len(dataset), self.num_workers)
+        out = {}
+        for name, fn in self.metric_fns.items():
+            vals = np.asarray([fn(dataset[int(i)]) for i in idx], np.float64)
+            np.savez(self._shard_file(name, self.worker_id),
+                     indices=idx, values=vals)
+            out[name] = vals
+        return out
+
+    # ---------------------------------------------------------- reduce
+    def merge(self, dataset_len: int) -> Dict[str, np.ndarray]:
+        """Combine every worker's shard files → ``{metric}.npy`` of
+        length ``dataset_len`` (ref: run_reduce index merge)."""
+        merged = {}
+        for name in self.metric_fns:
+            full = np.full(dataset_len, np.nan)
+            for w in range(self.num_workers):
+                path = self._shard_file(name, w)
+                if not os.path.exists(path):
+                    raise FileNotFoundError(
+                        f"missing shard {path} — worker {w} has not run "
+                        "run_map yet")
+                z = np.load(path)
+                full[z["indices"]] = z["values"]
+            if np.isnan(full).any():
+                raise ValueError(
+                    f"metric {name}: merged index has holes — worker "
+                    "shards do not cover the dataset")
+            np.save(os.path.join(self.save_path, f"{name}.npy"), full)
+            merged[name] = full
+        return merged
+
+    # ------------------------------------------------------------ load
+    @staticmethod
+    def load(save_path: str, metric: str) -> np.ndarray:
+        return np.load(os.path.join(save_path, f"{metric}.npy"))
+
+    @staticmethod
+    def indexer(save_path: str, metric: str,
+                seed: int = 0) -> DifficultyIndexer:
+        """The analysis→sampling handoff: measured difficulties into the
+        curriculum sampler."""
+        return DifficultyIndexer(DataAnalyzer.load(save_path, metric),
+                                 seed=seed)

@@ -146,3 +146,80 @@ def test_watchdog_fires_and_pets():
     time.sleep(0.4)  # stop petting → fires
     assert wd.fired and fired == [1]
     wd.stop()
+
+
+class TestDataAnalyzer:
+    """ref: data_pipeline/data_sampling/data_analyzer.py"""
+
+    def _dataset(self, n=40, seed=0):
+        rng = np.random.default_rng(seed)
+        return [{"tokens": np.concatenate([
+            rng.integers(1, 50, rng.integers(3, 20)),
+            np.zeros(rng.integers(0, 5), np.int64)])} for _ in range(n)]
+
+    def test_sharded_map_then_merge(self, tmp_path):
+        from deepspeed_tpu.data.analyzer import DataAnalyzer, seqlen_metric
+
+        ds = self._dataset()
+        for w in range(3):
+            DataAnalyzer({"seqlen": seqlen_metric(0)}, str(tmp_path),
+                         worker_id=w, num_workers=3).run_map(ds)
+        merged = DataAnalyzer({"seqlen": seqlen_metric(0)},
+                              str(tmp_path), num_workers=3).merge(len(ds))
+        want = [float(np.sum(np.asarray(s["tokens"]) != 0)) for s in ds]
+        np.testing.assert_array_equal(merged["seqlen"], want)
+        # load + indexer handoff
+        idx = DataAnalyzer.indexer(str(tmp_path), "seqlen")
+        easy = idx.eligible(max_difficulty=8)
+        assert all(want[i] <= 8 for i in easy)
+
+    def test_missing_shard_raises(self, tmp_path):
+        from deepspeed_tpu.data.analyzer import DataAnalyzer, seqlen_metric
+
+        ds = self._dataset(10)
+        DataAnalyzer({"seqlen": seqlen_metric()}, str(tmp_path),
+                     worker_id=0, num_workers=2).run_map(ds)
+        with pytest.raises(FileNotFoundError):
+            DataAnalyzer({"seqlen": seqlen_metric()}, str(tmp_path),
+                         num_workers=2).merge(len(ds))
+
+    def test_vocab_rarity_orders_rare_higher(self, tmp_path):
+        from deepspeed_tpu.data.analyzer import VocabRarity
+
+        common = {"tokens": np.full(10, 7)}
+        rare = {"tokens": np.asarray([43, 44, 45])}
+        ds = [common] * 20 + [rare]
+        vr = VocabRarity(vocab_size=64, pad_token_id=0).fit(ds)
+        assert vr(rare) > vr(common)
+
+    def test_curriculum_end_to_end(self, tmp_path):
+        """Analyzer difficulties drive a seqlen curriculum: early batches
+        draw only short samples, late batches see everything."""
+        from deepspeed_tpu.data.analyzer import DataAnalyzer, seqlen_metric
+        from deepspeed_tpu.data.curriculum import (CurriculumConfig,
+                                                   CurriculumScheduler)
+
+        ds = self._dataset(60, seed=1)
+        an = DataAnalyzer({"seqlen": seqlen_metric(0)}, str(tmp_path))
+        an.run_map(ds)
+        an.merge(len(ds))
+        idx = DataAnalyzer.indexer(str(tmp_path), "seqlen")
+        sched = CurriculumScheduler(CurriculumConfig(
+            enabled=True, min_difficulty=5, max_difficulty=20,
+            total_curriculum_step=100))
+        lens = np.asarray([float(np.sum(s["tokens"] != 0)) for s in ds])
+        early = idx.sample(16, sched.get_difficulty(0))
+        late = idx.sample(16, sched.get_difficulty(100))
+        assert lens[early].max() <= 5
+        assert lens[late].max() > 5
+
+    def test_vocab_rarity_unseen_is_hard_and_oob_raises(self):
+        from deepspeed_tpu.data.analyzer import VocabRarity
+
+        ds = [{"tokens": np.full(10, 7)}]
+        vr = VocabRarity(vocab_size=16, pad_token_id=0).fit(ds)
+        seen = vr({"tokens": np.asarray([7, 7])})
+        unseen = vr({"tokens": np.asarray([3, 4])})
+        assert unseen > seen  # out-of-corpus tokens rank hardest
+        with pytest.raises(ValueError, match="vocab_size"):
+            VocabRarity(vocab_size=8).fit([{"tokens": np.asarray([9])}])

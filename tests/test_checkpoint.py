@@ -98,3 +98,48 @@ def test_consolidate_to_fp32(tmp_path):
     mp = e.module_params()
     np.testing.assert_allclose(flat["w"],
                                np.asarray(mp["w"], np.float32), atol=1e-6)
+
+
+def test_zero_to_fp32_offline_cli(tmp_path, devices):
+    """Offline checkpoint consolidation without an engine (ref:
+    deepspeed/utils/zero_to_fp32.py)."""
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu import checkpoint as ckpt
+
+    params = {"layer": {"w": jnp.arange(16, dtype=jnp.float32).reshape(4, 4),
+                        "b": jnp.ones((4,), jnp.bfloat16)}}
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=lambda p, b: jnp.sum(p["layer"]["w"] ** 2),
+        params=params,
+        config={"train_batch_size": 8,
+                "zero_optimization": {"stage": 3},
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}}})
+    ckpt.save_checkpoint(engine, str(tmp_path), tag="t1")
+    out = str(tmp_path / "consolidated.npz")
+    ckpt.main([str(tmp_path), out, "--tag", "t1"])
+    z = np.load(out)
+    assert z["layer/w"].dtype == np.float32
+    np.testing.assert_allclose(z["layer/w"],
+                               np.arange(16, dtype=np.float32).reshape(4, 4))
+    assert z["layer/b"].dtype == np.float32  # bf16 upcast
+    # 'latest' discovery path too
+    ckpt.zero_to_fp32(str(tmp_path), str(tmp_path / "c2.npz"))
+
+
+def test_zero_to_fp32_rejects_qwz(tmp_path, devices):
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu import checkpoint as ckpt
+
+    def loss(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=loss, params={"w": jnp.ones((8, 4))},
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "mesh": {"data": 8},
+                "zero_optimization": {"stage": 3,
+                                      "zero_quantized_weights": True}})
+    ckpt.save_checkpoint(engine, str(tmp_path), tag="q")
+    with pytest.raises(ValueError, match="qwZ"):
+        ckpt.zero_to_fp32(str(tmp_path), str(tmp_path / "o.npz"), tag="q")
