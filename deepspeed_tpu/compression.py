@@ -70,6 +70,53 @@ def head_mask(w: jnp.ndarray, num_heads: int, dense_ratio: float) -> jnp.ndarray
     return jnp.repeat(keep, hd).reshape((1,) * (w.ndim - 1) + (d,))
 
 
+def channel_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Keep output channels (last axis) with top L2 norms (ref:
+    channel_pruning — conv/linear output-channel structured sparsity)."""
+    d = w.shape[-1]
+    norms = jnp.linalg.norm(
+        w.reshape(-1, d).astype(jnp.float32), axis=0)
+    k = max(1, int(round(d * dense_ratio)))
+    thresh = jnp.sort(norms)[d - k]
+    keep = (norms >= thresh).astype(w.dtype)
+    return keep.reshape((1,) * (w.ndim - 1) + (d,))
+
+
+# ------------------------------------------------------------ layer reduction
+def apply_layer_reduction(params: Any, keep_layers: Optional[List[int]] = None,
+                          keep_number: Optional[int] = None,
+                          blocks_key: str = "blocks") -> Any:
+    """Structural layer reduction (ref: compression layer_reduction /
+    ``teacher_layer``): build a student whose block stack keeps only
+    ``keep_layers`` of the teacher's, in order.
+
+    Models here stack per-layer weights as ``[L, ...]`` leaves under one
+    ``blocks`` subtree, so the reference's module surgery is a gather on
+    the leading axis — an init-time transform (shapes change), not part
+    of the jitted step.
+    """
+    import numpy as np
+
+    out = dict(params)
+    blocks = params[blocks_key]
+    L = jax.tree.leaves(blocks)[0].shape[0]
+    if keep_layers is None:
+        if not keep_number:
+            raise ValueError("pass keep_layers or keep_number")
+        # evenly spread over the teacher stack, endpoints included
+        keep_layers = np.unique(np.round(
+            np.linspace(0, L - 1, int(keep_number))).astype(np.int32))
+    idx = np.asarray(keep_layers, np.int32)
+    if idx.ndim != 1 or idx.size == 0:
+        raise ValueError(f"keep_layers must be a non-empty 1-D list: "
+                         f"{keep_layers}")
+    if idx.min() < 0 or idx.max() >= L:
+        raise ValueError(f"keep_layers {list(map(int, idx))} outside the "
+                         f"teacher's {L} layers")
+    out[blocks_key] = jax.tree.map(lambda x: x[idx], blocks)
+    return out
+
+
 # -------------------------------------------------------------------- config
 @dataclasses.dataclass
 class CompressionGroup:
@@ -119,14 +166,37 @@ class CompressionConfig:
         default_factory=CompressionMethod)
     head_pruning: CompressionMethod = dataclasses.field(
         default_factory=CompressionMethod)
+    channel_pruning: CompressionMethod = dataclasses.field(
+        default_factory=CompressionMethod)
+    # layer_reduction is structural (init-time), not a scheduled method
+    layer_reduction_enabled: bool = False
+    keep_layers: List[int] = dataclasses.field(default_factory=list)
+    keep_number_layers: Optional[int] = None  # evenly spread when set
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "CompressionConfig":
         ct = d.get("compression_training", d)
         c = cls()
         for field in dataclasses.fields(cls):
-            if field.name in ct:
-                setattr(c, field.name, _parse_method(ct[field.name], field.name))
+            # annotations are strings (future import); any
+            # CompressionMethod-typed field parses its config block
+            if str(field.type).endswith("CompressionMethod") and \
+                    field.name in ct:
+                setattr(c, field.name,
+                        _parse_method(ct[field.name], field.name))
+        lr = ct.get("layer_reduction", {})
+        if lr.get("enabled"):
+            c.layer_reduction_enabled = True
+            if "teacher_layer" in lr:
+                c.keep_layers = [int(i) for i in lr["teacher_layer"]]
+            elif "keep_number_layers" in lr:
+                # evenly spread over the teacher stack — depth is only
+                # known at apply_layer_reduction time, which resolves this
+                c.keep_number_layers = int(lr["keep_number_layers"])
+            else:
+                raise ValueError(
+                    "layer_reduction needs teacher_layer or "
+                    "keep_number_layers")
         return c
 
 
@@ -152,7 +222,7 @@ class Compressor:
         c = self.config
         return any(m.enabled for m in (
             c.weight_quantization, c.sparse_pruning, c.row_pruning,
-            c.head_pruning))
+            c.head_pruning, c.channel_pruning))
 
     def apply(self, params: Any, step=0) -> Any:
         """params → compressed params; ``step`` may be traced."""
@@ -188,6 +258,9 @@ class Compressor:
                             lambda x, g: x * head_mask(x, g.num_heads,
                                                        g.dense_ratio)
                             if g.num_heads else x, path, out)
+            out = apply_one(c.channel_pruning,
+                            lambda x, g: x * channel_mask(x, g.dense_ratio),
+                            path, out)
             out = apply_one(c.weight_quantization,
                             lambda x, g: fake_quant(x, bits=g.bits,
                                                     num_groups=g.quantize_groups),
@@ -195,6 +268,17 @@ class Compressor:
             return out
 
         return jax.tree_util.tree_map_with_path(leaf, params)
+
+    def reduce_layers(self, params: Any, blocks_key: str = "blocks") -> Any:
+        """Apply the config's ``layer_reduction`` block (init-time
+        structural transform — run ONCE on the teacher params before
+        building the engine; a no-op when the block is absent)."""
+        c = self.config
+        if not c.layer_reduction_enabled:
+            return params
+        return apply_layer_reduction(
+            params, keep_layers=c.keep_layers or None,
+            keep_number=c.keep_number_layers, blocks_key=blocks_key)
 
     def quantize_activation(self, x: jnp.ndarray, step=0) -> jnp.ndarray:
         """Fake-quantize an activation (call inside the model's forward)."""

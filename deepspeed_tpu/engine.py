@@ -273,13 +273,16 @@ class TrainingEngine:
         # the data axes so each chip receives only its slice.
         batch_sharding = self.mesh.sharding(self.mesh.batch_spec())
         self._batch_sharding = batch_sharding
+        # batch placement happens in _align_batch (device_put per leaf, so
+        # scalar batch fields ride along replicated); in_shardings=None
+        # respects those committed placements without re-transfer
         self._step_fn = jax.jit(
             self._train_step,
-            in_shardings=(self.state_shardings, batch_sharding),
+            in_shardings=(self.state_shardings, None),
             out_shardings=(self.state_shardings, None),
             donate_argnums=(0,))
         self._eval_fn = jax.jit(self._eval_step,
-                                in_shardings=(self.state_shardings, batch_sharding))
+                                in_shardings=(self.state_shardings, None))
 
         # host bookkeeping (ref: engine.global_steps / skipped_steps)
         self.global_steps = 0
@@ -691,16 +694,24 @@ class TrainingEngine:
             self.monitor.flush()
 
     def _align_batch(self, batch):
-        """Re-place committed device arrays whose sharding disagrees with
-        the step's batch sharding (host arrays are untouched — jit already
-        shards those on transfer).  Lets rollouts generated on-device (the
-        hybrid-engine RLHF loop) feed straight back into train_batch."""
+        """Place every batch leaf for the step: arrays with a batch dim
+        get the data-sharded placement, scalars ride along replicated.
+        Committed device arrays (e.g. hybrid-engine rollouts) are
+        re-placed only when their sharding disagrees; host arrays are
+        transferred exactly as jit's in_shardings used to."""
+        import numpy as np
+
+        repl = self.mesh.replicated()
+
         def fix(x):
-            if isinstance(x, jax.Array) and \
-                    not x.sharding.is_equivalent_to(self._batch_sharding,
-                                                    x.ndim):
-                return jax.device_put(x, self._batch_sharding)
-            return x
+            if isinstance(x, jax.Array):
+                want = self._batch_sharding if x.ndim >= 1 else repl
+                if not x.sharding.is_equivalent_to(want, x.ndim):
+                    return jax.device_put(x, want)
+                return x
+            a = np.asarray(x)  # one sharded host→device transfer, direct
+            return jax.device_put(
+                a, self._batch_sharding if a.ndim >= 1 else repl)
 
         return jax.tree.map(fix, batch)
 
@@ -768,7 +779,11 @@ class TrainingEngine:
         is enabled; returns the digest dict."""
         from deepspeed_tpu.comm.digest import digest_compiled, log_digest
 
-        compiled = self._step_fn.lower(self.state, batch).compile()
+        # align first: the step jit leaves batch shardings unspecified, so
+        # lowering a raw host batch would digest a differently-sharded
+        # program than train_batch actually runs
+        compiled = self._step_fn.lower(
+            self.state, self._align_batch(batch)).compile()
         d = digest_compiled(compiled, link_gbps)
         if self.monitor.enabled:
             log_digest(self.monitor, d, self.global_steps)

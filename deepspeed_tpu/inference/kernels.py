@@ -345,3 +345,112 @@ def paged_decode_attention(q, k_pages, v_pages, table, seq_lens,
       k_pages.reshape(KV * P, ps, Dh), v_pages.reshape(KV * P, ps, Dh))
     out = out.reshape(B, KV, Gp, Dh)[:, :, :G]
     return out.reshape(B, H, Dh)
+
+
+# ------------------------------------------- pallas chunked-prefill kernel
+def _chunk_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale, page_size, kv_heads,
+                  max_pages, group, chunk):
+    """Chunk rows are flattened [C*G, Dh]; row r is query position
+    r // G of the chunk.  Causal frontier per row: start + r//G."""
+    bk = pl.program_id(0)
+    p = pl.program_id(1)
+    b = bk // kv_heads
+
+    @pl.when(p == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    start = lens_ref[b]
+    # page live iff it holds any position <= start + C - 1
+    @pl.when(p * page_size < start + chunk)
+    def _():
+        q = q_ref[0]                        # [CG, Dh]
+        k = k_ref[0]                        # [ps, Dh]
+        s = jax.lax.dot_general(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [CG, ps]
+        kpos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        qpos = start + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0) // group
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        pr = jnp.exp(s - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(pr, axis=1, keepdims=True)
+        m_scr[:] = m_new
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            pr, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(p == max_pages - 1)
+    def _():
+        l = jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:])
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def paged_chunk_attention(q, k_pages, v_pages, table, start,
+                          scale: Optional[float] = None,
+                          interpret: bool = False):
+    """Pallas chunked-prefill attention — same contract as
+    :func:`paged_chunk_attention_reference` but streaming pages through
+    the DMA engine instead of materializing the gather.
+
+    q: [B, C, H, Dh] at positions ``start + 0..C-1`` (the chunk's K/V
+    must already be written into the pages).  NOTE: correctness is pinned
+    by interpret-mode tests; the on-chip win over the gather reference is
+    to be confirmed in KERNEL_BENCH before this becomes the small-shape
+    default (the decode kernel's measured policy applies meanwhile).
+    """
+    B, C, H, Dh = q.shape
+    KV, P, ps, _ = k_pages.shape
+    G = H // KV
+    mp = table.shape[1]
+    scale = scale if scale is not None else Dh ** -0.5
+    CG = C * G
+    pad = (-CG) % 8                      # sublane alignment
+    qg = q.reshape(B, C, KV, G, Dh).transpose(0, 2, 1, 3, 4) \
+        .reshape(B * KV, CG, Dh)
+    if pad:
+        qg = jnp.concatenate(
+            [qg, jnp.zeros((B * KV, pad, Dh), q.dtype)], axis=1)
+
+    kernel = functools.partial(
+        _chunk_kernel, scale=scale, page_size=ps, kv_heads=KV,
+        max_pages=mp, group=G, chunk=C)
+
+    def kv_map(bk, p, tbl, lens):
+        b = bk // KV
+        pid = jnp.where(p * ps < lens[b] + C, tbl[b, p], 0)
+        return ((bk % KV) * P + pid, 0, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,   # table, start
+            grid=(B * KV, mp),
+            in_specs=[
+                pl.BlockSpec((1, CG + pad, Dh),
+                             lambda bk, p, tbl, lens: (bk, 0, 0)),
+                pl.BlockSpec((1, ps, Dh), kv_map),
+                pl.BlockSpec((1, ps, Dh), kv_map),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, CG + pad, Dh), lambda bk, p, tbl, lens: (bk, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((CG + pad, 1), jnp.float32),
+                pltpu.VMEM((CG + pad, 1), jnp.float32),
+                pltpu.VMEM((CG + pad, Dh), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * KV, CG + pad, Dh), q.dtype),
+        interpret=interpret,
+    )(table, start, qg, k_pages.reshape(KV * P, ps, Dh),
+      v_pages.reshape(KV * P, ps, Dh))
+    out = out[:, :CG].reshape(B, KV, C, G, Dh).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, C, H, Dh)

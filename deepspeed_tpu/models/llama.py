@@ -391,8 +391,21 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
         k = apply_rope(k, cos, sin)
         if T > 1 and continuation:
             kp, vp = write_chunk_pages(kp, vp, k, v, cache.table, start, ps)
-            attn = paged_chunk_attention_reference(
-                q, kp, vp, cache.table, start)
+            # same policy as decode below: the pallas kernel streams pages
+            # instead of materializing the gather; worth it only when the
+            # gathered transient is large (pending an on-chip chunk-shape
+            # microbench, the decode threshold is reused)
+            mp = cache.table.shape[1]
+            gather_bytes = (2 * B * nkv * mp * ps * hd
+                            * (kp.dtype.itemsize + 4))
+            if not interpret and gather_bytes >= (1 << 28):
+                from deepspeed_tpu.inference.kernels import (
+                    paged_chunk_attention)
+
+                attn = paged_chunk_attention(q, kp, vp, cache.table, start)
+            else:
+                attn = paged_chunk_attention_reference(
+                    q, kp, vp, cache.table, start)
         elif prefill:
             attn = flash_attention(q, k, v, causal=True)
             kp, vp = write_prompt_pages(kp, vp, k, v, cache.table, ps)

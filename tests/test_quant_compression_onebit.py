@@ -272,3 +272,80 @@ def test_onebit_lamb_converges_single():
         params, state, l = step(params, state)
         losses.append(float(l))
     assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+
+def test_channel_mask_and_layer_reduction():
+    from deepspeed_tpu.compression import (apply_layer_reduction,
+                                           channel_mask)
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(0, 1, (16, 8)), jnp.float32)
+    m = channel_mask(w, dense_ratio=0.5)
+    assert m.shape == (1, 8) and int(m.sum()) == 4
+    # kept channels are the largest-norm ones
+    norms = np.linalg.norm(np.asarray(w), axis=0)
+    kept = set(np.where(np.asarray(m[0]) > 0)[0])
+    assert kept == set(np.argsort(norms)[-4:])
+
+    params = {"embed": jnp.zeros((10, 4)),
+              "blocks": {"w": jnp.arange(24.0).reshape(6, 2, 2),
+                         "n": jnp.ones((6, 2))},
+              "final_norm": jnp.ones(4)}
+    student = apply_layer_reduction(params, [0, 2, 5])
+    assert student["blocks"]["w"].shape == (3, 2, 2)
+    np.testing.assert_array_equal(np.asarray(student["blocks"]["w"][1]),
+                                  np.asarray(params["blocks"]["w"][2]))
+    assert student["embed"].shape == (10, 4)  # non-block subtrees intact
+    with pytest.raises(ValueError, match="outside"):
+        apply_layer_reduction(params, [7])
+
+
+def test_channel_pruning_config_and_layer_reduction_parse():
+    from deepspeed_tpu.compression import CompressionConfig, init_compression
+
+    cfg = CompressionConfig.from_dict({"compression_training": {
+        "channel_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"cp1": {
+                "params": {"dense_ratio": 0.5}, "modules": ["*"]}}},
+        "layer_reduction": {"enabled": True, "teacher_layer": [0, 2]},
+    }})
+    assert cfg.channel_pruning.enabled
+    assert cfg.layer_reduction_enabled and cfg.keep_layers == [0, 2]
+    comp = init_compression(cfg)
+    assert comp.active
+    w = jnp.asarray(np.random.default_rng(1).normal(0, 1, (8, 8)),
+                    jnp.float32)
+    out = comp.apply({"w": w}, step=1)["w"]
+    cols = np.linalg.norm(np.asarray(out), axis=0)
+    assert int((cols > 0).sum()) == 4
+
+
+def test_layer_reduction_keep_number_spreads():
+    from deepspeed_tpu.compression import (CompressionConfig,
+                                           apply_layer_reduction)
+
+    params = {"blocks": {"w": jnp.arange(24.0).reshape(24, 1)}}
+    s = apply_layer_reduction(params, keep_number=6)
+    kept = np.asarray(s["blocks"]["w"][:, 0], np.int32)
+    assert kept[0] == 0 and kept[-1] == 23        # endpoints included
+    assert len(kept) == 6
+    gaps = np.diff(kept)
+    assert gaps.max() - gaps.min() <= 1           # evenly spread
+    cfg = CompressionConfig.from_dict({"compression_training": {
+        "layer_reduction": {"enabled": True, "keep_number_layers": 6}}})
+    assert cfg.keep_number_layers == 6 and cfg.keep_layers == []
+
+
+def test_compressor_reduce_layers_from_config():
+    from deepspeed_tpu.compression import init_compression
+
+    comp = init_compression({"compression_training": {
+        "layer_reduction": {"enabled": True, "teacher_layer": [1, 3]}}})
+    params = {"blocks": {"w": jnp.arange(8.0).reshape(4, 2)},
+              "head": jnp.ones(2)}
+    s = comp.reduce_layers(params)
+    np.testing.assert_array_equal(np.asarray(s["blocks"]["w"]),
+                                  [[2, 3], [6, 7]])
+    # absent block → identity
+    assert init_compression({}).reduce_layers(params) is params
