@@ -103,6 +103,10 @@ def apply_layer_reduction(params: Any, keep_layers: Optional[List[int]] = None,
     if keep_layers is None:
         if not keep_number:
             raise ValueError("pass keep_layers or keep_number")
+        if int(keep_number) > L:
+            raise ValueError(
+                f"keep_number_layers {keep_number} exceeds the teacher's "
+                f"{L} layers")
         # evenly spread over the teacher stack, endpoints included
         keep_layers = np.unique(np.round(
             np.linspace(0, L - 1, int(keep_number))).astype(np.int32))

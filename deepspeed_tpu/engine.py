@@ -732,6 +732,14 @@ class TrainingEngine:
     def eval_batch(self, batch):
         return self._eval_fn(self.state, self._align_batch(batch))
 
+    def lower_step(self, batch):
+        """Lower the train step against the ALIGNED batch — the program
+        train_batch actually runs.  HLO/memory inspection must go through
+        here: the step jit leaves batch shardings unspecified (placement
+        happens in _align_batch), so lowering a raw host batch would
+        inspect a differently-sharded program."""
+        return self._step_fn.lower(self.state, self._align_batch(batch))
+
     # torch-idiom compatibility shims (ref: engine.__call__/backward/step)
     def __call__(self, batch):
         # State is committed immediately — the step donates the old buffers,
@@ -779,11 +787,7 @@ class TrainingEngine:
         is enabled; returns the digest dict."""
         from deepspeed_tpu.comm.digest import digest_compiled, log_digest
 
-        # align first: the step jit leaves batch shardings unspecified, so
-        # lowering a raw host batch would digest a differently-sharded
-        # program than train_batch actually runs
-        compiled = self._step_fn.lower(
-            self.state, self._align_batch(batch)).compile()
+        compiled = self.lower_step(batch).compile()
         d = digest_compiled(compiled, link_gbps)
         if self.monitor.enabled:
             log_digest(self.monitor, d, self.global_steps)

@@ -248,103 +248,23 @@ def paged_attention_reference(q, k_pages, v_pages, table, seq_lens,
 
 
 # ------------------------------------------------------------ pallas kernel
-def _paged_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, scale, page_size, kv_heads,
-                  max_pages):
-    bk = pl.program_id(0)
-    p = pl.program_id(1)
-    b = bk // kv_heads
-
-    @pl.when(p == 0)
-    def _():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-
-    seq_len = lens_ref[b]
-    # page live iff it holds any position < seq_len
-    @pl.when(p * page_size < seq_len)
-    def _():
-        q = q_ref[0]                        # [G, Dh]
-        k = k_ref[0]                        # [ps, Dh]
-        s = jax.lax.dot_general(
-            q.astype(jnp.float32), k.astype(jnp.float32),
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale      # [G, ps]
-        pos = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        s = jnp.where(pos < seq_len, s, NEG_INF)
-        m_prev = m_scr[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        pr = jnp.exp(s - m_new)
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(pr, axis=1, keepdims=True)
-        m_scr[:] = m_new
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            pr, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-
-    @pl.when(p == max_pages - 1)
-    def _():
-        l = jnp.where(l_scr[:] == 0.0, 1.0, l_scr[:])
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-
-
 def paged_decode_attention(q, k_pages, v_pages, table, seq_lens,
                            scale: Optional[float] = None,
                            interpret: bool = False):
     """Pallas paged decode attention; same contract as the reference fn.
 
     q: [B, H, Dh] (one decode step), k/v_pages: [KV, P, ps, Dh].
+
+    Decode IS the C=1 chunked-prefill case — the query sits at position
+    ``seq_lens - 1`` and attends ``kpos <= seq_lens - 1`` — so one kernel
+    (:func:`_chunk_kernel`) serves both paths and any accumulator fix
+    lands exactly once.  Empty rows (seq_lens == 0) resolve to start -1:
+    every position masks out and the finalize's l==0 guard yields zeros,
+    matching the reference's empty-sequence contract.
     """
-    B, H, Dh = q.shape
-    KV, P, ps, _ = k_pages.shape
-    G = H // KV
-    mp = table.shape[1]
-    scale = scale if scale is not None else Dh ** -0.5
-    Gp = max(G, 8)                       # pad query-head group to a VPU tile
-    qg = q.reshape(B, KV, G, Dh)
-    if Gp != G:
-        qg = jnp.concatenate(
-            [qg, jnp.zeros((B, KV, Gp - G, Dh), q.dtype)], axis=2)
-
-    grid = (B * KV, mp)
-    kernel = functools.partial(_paged_kernel, scale=scale, page_size=ps,
-                               kv_heads=KV, max_pages=mp)
-
-    # K/V flattened to [KV*P, ps, Dh]; the page axis of the grid walks the
-    # page table via scalar prefetch: physical block = kv_head*P + table[b,p].
-    # Dead slots (page beyond seq_len) may hold stale/sentinel ids under a
-    # dynamic allocator — clamp them to page 0; the kernel masks the scores.
-    def kv_map(bk, p, tbl, lens):
-        b = bk // KV
-        pid = jnp.where(p * ps < lens[b], tbl[b, p], 0)
-        return ((bk % KV) * P + pid, 0, 0)
-
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,   # table, seq_lens
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, Gp, Dh), lambda bk, p, tbl, lens: (bk, 0, 0)),
-                pl.BlockSpec((1, ps, Dh), kv_map),
-                pl.BlockSpec((1, ps, Dh), kv_map),
-            ],
-            out_specs=pl.BlockSpec(
-                (1, Gp, Dh), lambda bk, p, tbl, lens: (bk, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((Gp, 1), jnp.float32),
-                pltpu.VMEM((Gp, 1), jnp.float32),
-                pltpu.VMEM((Gp, Dh), jnp.float32),
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct((B * KV, Gp, Dh), q.dtype),
-        interpret=interpret,
-    )(table, seq_lens, qg.reshape(B * KV, Gp, Dh),
-      k_pages.reshape(KV * P, ps, Dh), v_pages.reshape(KV * P, ps, Dh))
-    out = out.reshape(B, KV, Gp, Dh)[:, :, :G]
-    return out.reshape(B, H, Dh)
+    return paged_chunk_attention(
+        q[:, None], k_pages, v_pages, table, seq_lens - 1, scale=scale,
+        interpret=interpret)[:, 0]
 
 
 # ------------------------------------------- pallas chunked-prefill kernel

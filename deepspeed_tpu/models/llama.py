@@ -350,9 +350,9 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
     FastGen split-fuse read path).  tokens: [B, T] → (logits, cache).
     """
     from deepspeed_tpu.inference.kernels import (
-        paged_attention_reference, paged_chunk_attention_reference,
-        paged_decode_attention, write_chunk_pages, write_prompt_pages,
-        write_token_pages)
+        paged_attention_reference, paged_chunk_attention,
+        paged_chunk_attention_reference, paged_decode_attention,
+        write_chunk_pages, write_prompt_pages, write_token_pages)
     from deepspeed_tpu.ops.attention import flash_attention
     from deepspeed_tpu.ops.fused_ops import swiglu
 
@@ -389,42 +389,31 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
         v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
+        # One policy for both paged read paths (decode and chunked
+        # prefill), measured on v5e for decode (KERNEL_BENCH.json
+        # paged_decode_vs_gather): the XLA gather reference wins ~1.2x at
+        # small/mid shapes; the pallas kernel pays off only when the
+        # gathered K/V transient ([B, KV, mp*ps, Dh] x2, in cache dtype
+        # PLUS the f32 upcast for the einsum) is too big to materialize.
+        # Chunk shapes reuse the decode threshold pending their own
+        # on-chip microbench.
+        mp = cache.table.shape[1]
+        gather_bytes = (2 * B * nkv * mp * ps * hd
+                        * (kp.dtype.itemsize + 4))
+        use_pallas = not interpret and gather_bytes >= (1 << 28)
         if T > 1 and continuation:
             kp, vp = write_chunk_pages(kp, vp, k, v, cache.table, start, ps)
-            # same policy as decode below: the pallas kernel streams pages
-            # instead of materializing the gather; worth it only when the
-            # gathered transient is large (pending an on-chip chunk-shape
-            # microbench, the decode threshold is reused)
-            mp = cache.table.shape[1]
-            gather_bytes = (2 * B * nkv * mp * ps * hd
-                            * (kp.dtype.itemsize + 4))
-            if not interpret and gather_bytes >= (1 << 28):
-                from deepspeed_tpu.inference.kernels import (
-                    paged_chunk_attention)
-
-                attn = paged_chunk_attention(q, kp, vp, cache.table, start)
-            else:
-                attn = paged_chunk_attention_reference(
-                    q, kp, vp, cache.table, start)
+            pa = (paged_chunk_attention if use_pallas
+                  else paged_chunk_attention_reference)
+            attn = pa(q, kp, vp, cache.table, start)
         elif prefill:
             attn = flash_attention(q, k, v, causal=True)
             kp, vp = write_prompt_pages(kp, vp, k, v, cache.table, ps)
         else:
             kp, vp = write_token_pages(kp, vp, k[:, 0], v[:, 0],
                                        cache.table, start, ps)
-            # measured on v5e (KERNEL_BENCH.json paged_decode_vs_gather):
-            # the XLA gather reference beats the pallas kernel ~1.2x at
-            # small/mid shapes; the kernel only pays off when the
-            # gathered K/V transient ([B, KV, mp*ps, Dh] x2) is too big
-            # to materialize per decode step (long context, many slots)
-            mp = cache.table.shape[1]
-            # the reference materializes the gather in cache dtype AND
-            # upcasts to f32 for the einsum: itemsize + 4 bytes per elem
-            gather_bytes = (2 * B * nkv * mp * ps * hd
-                            * (kp.dtype.itemsize + 4))
-            pa = (paged_attention_reference
-                  if interpret or gather_bytes < (1 << 28)
-                  else paged_decode_attention)
+            pa = (paged_decode_attention if use_pallas
+                  else paged_attention_reference)
             attn = pa(q[:, 0], kp, vp, cache.table, start + 1)[:, None]
         x = x + attn.reshape(B, T, nh * hd) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)

@@ -56,7 +56,7 @@ def build(config_extra=None, optimizer=None, opt_type="adamw",
 
 
 def compiled_text(engine, batch):
-    return engine._step_fn.lower(engine.state, batch).compile().as_text()
+    return engine.lower_step(batch).compile().as_text()
 
 
 class TestQuantizedAllReduce:

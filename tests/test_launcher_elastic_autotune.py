@@ -228,3 +228,18 @@ def test_autotune_config_end_to_end(tmp_path):
     assert verdict["overrides"]["zero_optimization.stage"] in (0, 2)
     assert verdict["config"]["train_batch_size"] == 16
     assert "zero_optimization" in verdict["config"]
+
+
+def test_env_report(capsys, devices):
+    """ref: ds_report — every section renders and ops probe green."""
+    from deepspeed_tpu import env_report
+
+    r = env_report.report()
+    assert r["versions"]["jax"] not in ("not installed",)
+    assert r["backend"]["name"] == "cpu" and len(r["backend"]["devices"]) == 8
+    assert r["ops"]["pallas"]["ok"] and r["ops"]["pallas"]["mode"] == "interpret"
+    assert r["ops"]["g++"]["ok"]
+    rc = env_report.main([])
+    out = capsys.readouterr().out
+    assert "ds_report" in out and "[OKAY]" in out
+    assert rc in (0, 1)

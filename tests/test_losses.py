@@ -84,6 +84,7 @@ class TestChunkedLmLoss:
 
 
 class TestLlamaLossChunk:
+    @pytest.mark.slow
     def test_llama_trajectory_matches(self, devices):
         """Engine training with loss_chunk on vs off: same losses."""
         import deepspeed_tpu as dstpu

@@ -265,6 +265,7 @@ class TestChunkedPrefill:
     iteration between decode steps (ref: DeepSpeed-FastGen dynamic
     split-fuse)."""
 
+    @pytest.mark.slow
     def test_long_prompt_matches_offline(self, model, devices):
         cfg, params = model
         prompt = list(np.random.default_rng(5).integers(
@@ -311,6 +312,7 @@ class TestChunkedPrefill:
         assert eng.finished["long"] == offline_chunked_expected(
             cfg, params, long_prompt, 4, C=4)
 
+    @pytest.mark.slow
     def test_mixed_with_preemption_pool_pressure(self, model, devices):
         cfg, params = model
         eng = llama_serving_engine(

@@ -70,7 +70,7 @@ def build_engine(remat: str):
 
 def measure(remat: str):
     engine, batch, cfg = build_engine(remat)
-    compiled = engine._step_fn.lower(engine.state, batch).compile()
+    compiled = engine.lower_step(batch).compile()
     ma = compiled.memory_analysis()
     # prove it actually runs, not just compiles
     loss = float(engine.train_batch(batch))
