@@ -40,29 +40,73 @@ def _resolve_tag(load_dir: str, tag: Optional[str],
     return None
 
 
+_async_ckptr = None     # one StandardCheckpointer owns the background save
+_pending_finalize = None  # its in-flight save's meta/latest writer — module
+#                           scope, PAIRED with _async_ckptr: any engine's
+#                           next save/load/wait must finalize it
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
-                    client_state: Optional[dict] = None) -> str:
-    """ref: DeepSpeedEngine.save_checkpoint(save_dir, tag, client_state)."""
+                    client_state: Optional[dict] = None,
+                    async_save: bool = False) -> str:
+    """ref: DeepSpeedEngine.save_checkpoint(save_dir, tag, client_state).
+
+    ``async_save=True`` (ref: the decoupled/async checkpoint engine,
+    FastPersist direction): orbax serializes in the background while
+    training continues; the ``latest`` pointer and meta are only written
+    once the state is durably on disk (wait_for_checkpoint / the next
+    save / load joins the pending write).  Training may mutate
+    ``engine.state`` immediately — orbax snapshots the device buffers
+    before returning, and the engine's step donates+replaces buffers
+    rather than writing in place.
+    """
     import orbax.checkpoint as ocp
 
+    global _async_ckptr, _pending_finalize
     tag = tag or f"global_step{engine.global_steps}"
     path = _ckpt_dir(save_dir, tag)
-    ckptr = ocp.StandardCheckpointer()
+    if _async_ckptr is None:
+        _async_ckptr = ocp.StandardCheckpointer()
+    ckptr = _async_ckptr
+    # at most one in-flight save — and the PREVIOUS async save's meta/
+    # latest finalizer must run, not be dropped, before starting this one
+    wait_for_checkpoint(engine)
     ckptr.save(os.path.join(path, "state"), engine.state, force=True)
-    ckptr.wait_until_finished()
     meta = {
         "global_steps": engine.global_steps,
         "skipped_steps": engine.skipped_steps,
         "client_state": client_state or {},
         "config": engine.config.raw,
     }
-    if jax.process_index() == 0:
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f)
-        with open(os.path.join(os.path.abspath(save_dir), "latest"), "w") as f:
-            f.write(tag)
-    logger.info("saved checkpoint %s", path)
+
+    def finalize():
+        if jax.process_index() == 0:
+            with open(os.path.join(path, "meta.json"), "w") as f:
+                json.dump(meta, f)
+            with open(os.path.join(os.path.abspath(save_dir),
+                                   "latest"), "w") as f:
+                f.write(tag)
+        logger.info("saved checkpoint %s", path)
+
+    if async_save:
+        _pending_finalize = finalize
+        return path
+    ckptr.wait_until_finished()
+    finalize()
     return path
+
+
+def wait_for_checkpoint(engine=None) -> None:
+    """Join a pending ``async_save`` (any engine's next save/load also
+    calls this).  The finalizer is cleared BEFORE the join: if the
+    background write failed, ``latest`` must never point at the broken
+    checkpoint — the error propagates and the previous good tag stands."""
+    global _pending_finalize
+    fin, _pending_finalize = _pending_finalize, None
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
+    if fin is not None:
+        fin()
 
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None):
@@ -73,6 +117,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None):
     """
     import orbax.checkpoint as ocp
 
+    wait_for_checkpoint(engine)          # join any pending async save
     tag = _resolve_tag(load_dir, tag, required=False)
     if tag is None:
         return None, {}

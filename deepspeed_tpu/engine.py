@@ -815,10 +815,17 @@ class TrainingEngine:
 
     # ---------------------------------------------------------- checkpointing
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
-                        client_state: Optional[dict] = None):
+                        client_state: Optional[dict] = None,
+                        async_save: bool = False):
         from deepspeed_tpu.checkpoint import save_checkpoint as _save
 
-        return _save(self, save_dir, tag=tag, client_state=client_state)
+        return _save(self, save_dir, tag=tag, client_state=client_state,
+                     async_save=async_save)
+
+    def wait_for_checkpoint(self):
+        from deepspeed_tpu.checkpoint import wait_for_checkpoint as _wait
+
+        return _wait(self)
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
         from deepspeed_tpu.checkpoint import load_checkpoint as _load

@@ -143,3 +143,87 @@ def test_zero_to_fp32_rejects_qwz(tmp_path, devices):
     ckpt.save_checkpoint(engine, str(tmp_path), tag="q")
     with pytest.raises(ValueError, match="qwZ"):
         ckpt.zero_to_fp32(str(tmp_path), str(tmp_path / "o.npz"), tag="q")
+
+
+def test_async_save_overlaps_training(tmp_path, devices):
+    """ref: decoupled/async checkpoint engine — training continues during
+    the save; 'latest' appears only after the join; resume matches."""
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu import checkpoint as ckpt
+
+    def loss(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    def build():
+        e, _, _, _ = dstpu.initialize(
+            loss_fn=loss, params={"w": jnp.ones((8, 4))},
+            config={"train_batch_size": 8,
+                    "zero_optimization": {"stage": 2},
+                    "optimizer": {"type": "adam", "params": {"lr": 1e-2}}})
+        return e
+
+    rng = np.random.default_rng(0)
+    batch = {"x": jnp.asarray(rng.normal(size=(8, 8)), jnp.float32),
+             "y": jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)}
+    engine = build()
+    engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path), tag="a1", async_save=True)
+    # training continues while the save is in flight; the saved state
+    # must be the PRE-continuation snapshot
+    snap = np.asarray(engine.state.params["w"])
+    for _ in range(3):
+        engine.train_batch(batch)
+    assert not np.allclose(np.asarray(engine.state.params["w"]), snap)
+    engine.wait_for_checkpoint()
+    assert (tmp_path / "latest").read_text() == "a1"
+    fresh = build()
+    fresh.load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(np.asarray(fresh.state.params["w"]), snap,
+                               rtol=1e-6)
+    assert fresh.global_steps == 1
+
+
+def test_successive_async_saves_all_finalize(tmp_path, devices):
+    """A new async save must run (not drop) the previous save's
+    meta/latest finalizer."""
+    import deepspeed_tpu as dstpu
+
+    def loss(p, b):
+        return jnp.mean((b["x"] @ p["w"]) ** 2)
+
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=loss, params={"w": jnp.ones((4, 4))},
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-2}}})
+    batch = {"x": jnp.ones((8, 4), jnp.float32)}
+    for i in range(3):
+        engine.train_batch(batch)
+        engine.save_checkpoint(str(tmp_path), tag=f"t{i}", async_save=True)
+    engine.wait_for_checkpoint()
+    for i in range(3):
+        assert (tmp_path / f"t{i}" / "meta.json").exists(), i
+    assert (tmp_path / "latest").read_text() == "t2"
+
+
+def test_async_save_joined_by_other_engine(tmp_path, devices):
+    """The pending finalizer is global: a DIFFERENT engine's load joins
+    and finalizes it (elastic-restart shape)."""
+    import deepspeed_tpu as dstpu
+
+    def loss(p, b):
+        return jnp.mean((b["x"] @ p["w"]) ** 2)
+
+    def build():
+        e, _, _, _ = dstpu.initialize(
+            loss_fn=loss, params={"w": jnp.ones((4, 4))},
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "adam", "params": {"lr": 1e-2}}})
+        return e
+
+    a = build()
+    a.train_batch({"x": jnp.ones((8, 4), jnp.float32)})
+    a.save_checkpoint(str(tmp_path), tag="x", async_save=True)
+    b = build()
+    path, _ = b.load_checkpoint(str(tmp_path))   # different engine joins
+    assert path is not None and path.endswith("x")
+    assert b.global_steps == 1
