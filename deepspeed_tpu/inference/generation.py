@@ -197,6 +197,29 @@ def llama_generator(params, cfg, eos_token_id: Optional[int] = None,
     return Generator(params, step, step, alloc, eos_token_id=eos_token_id)
 
 
+def gpt2_generator(params, cfg, eos_token_id: Optional[int] = None,
+                   cache_dtype=jnp.bfloat16) -> Generator:
+    """Cached-attention generation for models/gpt2.py weights."""
+    from deepspeed_tpu.models import gpt2
+
+    step, alloc = cached_step_alloc(gpt2.forward_with_cache, cfg,
+                                    cache_dtype)
+
+    def checked_alloc(batch, max_seq):
+        # learned positions: a traced wpe gather CLAMPS out-of-range
+        # indices, so generating past the table would silently reuse the
+        # last position's embedding — fail here instead (RoPE models have
+        # no such table and need no check)
+        if max_seq > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens ({max_seq}) exceeds gpt2's "
+                f"learned position table ({cfg.max_seq_len})")
+        return alloc(batch, max_seq)
+
+    return Generator(params, step, step, checked_alloc,
+                     eos_token_id=eos_token_id)
+
+
 def mixtral_generator(params, cfg, eos_token_id: Optional[int] = None,
                       cache_dtype=jnp.bfloat16) -> Generator:
     """MoE text generation (ref: DeepSpeed-MoE inference): cached

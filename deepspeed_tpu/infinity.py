@@ -692,11 +692,19 @@ class InfinityEngine:
 
     # ---------------------------------------------------------- checkpoint
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
-                        client_state: Optional[dict] = None):
+                        client_state: Optional[dict] = None,
+                        async_save: bool = False):
         """Persist the tier + counters (ref: the reference swaps state to
         NVMe but still checkpoints through the engine).  Leaves are saved
         CONSOLIDATED and unpadded so checkpoints restore across different
-        dp widths."""
+        dp widths.
+
+        ``async_save`` is accepted for TrainingEngine drop-in parity and
+        degrades to a synchronous save: the state already streams through
+        host/NVMe tiers, so there is no device snapshot to overlap."""
+        if async_save:
+            logger.info("InfinityEngine.save_checkpoint: async_save "
+                        "degrades to synchronous (state is host-resident)")
         import json
 
         tag = tag or f"global_step{self.global_steps}"
@@ -720,6 +728,10 @@ class InfinityEngine:
         with open(os.path.join(d, "meta.json"), "w") as f:
             json.dump(meta, f)
         return d
+
+    def wait_for_checkpoint(self) -> None:
+        """Drop-in parity with TrainingEngine: saves here are synchronous,
+        so there is never a pending write to join."""
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
         import json

@@ -33,6 +33,10 @@ class GPT2Config:
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
+    @property
+    def n_kv_heads(self) -> int:
+        return self.n_heads  # MHA — lets the shared cache builders apply
+
     @classmethod
     def gpt2_1_3b(cls, **kw):
         # "GPT-2 1.3B" config used by the reference's ZeRO-2 benchmark
@@ -118,6 +122,42 @@ def forward(params, tokens, cfg: GPT2Config):
     x = layer_norm(x, params["lnf_w"], params["lnf_b"], cfg.norm_eps)
     return jnp.einsum("btd,vd->btv", x, params["wte"],
                       preferred_element_type=jnp.float32)
+
+
+def forward_with_cache(params, tokens, cfg: GPT2Config, cache):
+    """Incremental forward for generation (same KV-cache contract as
+    models/llama.py forward_with_cache; MHA so KV == H).
+
+    tokens: [B, T] → (logits [B, T, V] f32, updated cache).
+    """
+    from deepspeed_tpu.inference.generation import cached_attention
+
+    B, T = tokens.shape
+    nh, hd = cfg.n_heads, cfg.head_dim
+    start = cache.length
+    pos = start + jnp.arange(T, dtype=jnp.int32)
+    x = params["wte"][tokens] + params["wpe"][pos][None]
+
+    def block(x, layer):
+        lp, kc, vc = layer
+        h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.norm_eps)
+        qkv = h @ lp["qkv_w"] + lp["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, nh, hd)
+        k = k.reshape(B, T, nh, hd)
+        v = v.reshape(B, T, nh, hd)
+        attn, kc, vc = cached_attention(q, kc, vc, k, v, start)
+        x = x + attn.reshape(B, T, nh * hd) @ lp["proj_w"] + lp["proj_b"]
+        h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.norm_eps)
+        h = jax.nn.gelu(h @ lp["fc_w"] + lp["fc_b"], approximate=True)
+        return x + h @ lp["out_w"] + lp["out_b"], (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(block, x,
+                                     (params["blocks"], cache.k, cache.v))
+    x = layer_norm(x, params["lnf_w"], params["lnf_b"], cfg.norm_eps)
+    logits = jnp.einsum("btd,vd->btv", x, params["wte"],
+                        preferred_element_type=jnp.float32)
+    return logits, cache._replace(k=new_k, v=new_v, length=start + T)
 
 
 def loss_fn(cfg: GPT2Config):

@@ -9,6 +9,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from deepspeed_tpu.inference.generation import (KVCache, llama_generator,
                                                 sample_logits)
@@ -191,3 +192,65 @@ def test_injection_unknown_arch():
 
     with pytest.raises(ValueError):
         get_policy("not-a-real-arch")
+
+
+class TestGPT2Generation:
+    def test_cached_prefill_matches_forward(self, devices):
+        from deepspeed_tpu.models import gpt2
+        from deepspeed_tpu.inference.generation import KVCache
+
+        cfg = gpt2.GPT2Config.tiny()
+        params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 10)), jnp.int32)
+        ref = gpt2.forward(params, toks, cfg)
+        cache = KVCache.alloc(cfg.n_layers, 2, 16, cfg.n_kv_heads,
+                              cfg.head_dim, dtype=jnp.float32)
+        got, cache = gpt2.forward_with_cache(params, toks, cfg, cache)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+        assert int(cache.length) == 10
+
+    def test_generator_greedy_deterministic(self, devices):
+        from deepspeed_tpu.models import gpt2
+        from deepspeed_tpu.inference.generation import gpt2_generator
+
+        cfg = gpt2.GPT2Config.tiny()
+        params = gpt2.init_params(jax.random.PRNGKey(1), cfg)
+        gen = gpt2_generator(params, cfg)
+        out1 = gen.generate(jnp.asarray([[3, 7, 11]], jnp.int32),
+                            max_new_tokens=6)
+        out2 = gen.generate(jnp.asarray([[3, 7, 11]], jnp.int32),
+                            max_new_tokens=6)
+        assert out1.shape == (1, 9)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+    def test_position_table_overflow_raises(self, devices):
+        from deepspeed_tpu.models import gpt2
+        from deepspeed_tpu.inference.generation import gpt2_generator
+
+        cfg = gpt2.GPT2Config.tiny(max_seq_len=16)
+        params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+        gen = gpt2_generator(params, cfg)
+        with pytest.raises(ValueError, match="position table"):
+            gen.generate(jnp.ones((1, 12), jnp.int32), max_new_tokens=8)
+
+    def test_infinity_engine_ckpt_api_parity(self, devices, tmp_path):
+        """async_save / wait_for_checkpoint must not crash on the
+        config-selected InfinityEngine (drop-in engine swap)."""
+        import deepspeed_tpu as dstpu
+
+        def loss(p, b):
+            return jnp.mean((b["x"] @ p["w"]) ** 2)
+
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=loss, params={"w": jnp.ones((8, 4))},
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "zero_optimization": {
+                        "stage": 2,
+                        "offload_optimizer": {"device": "cpu",
+                                              "scheduled": True}}})
+        engine.train_batch({"x": jnp.ones((8, 8), jnp.float32)})
+        engine.save_checkpoint(str(tmp_path), tag="t", async_save=True)
+        engine.wait_for_checkpoint()
