@@ -31,6 +31,12 @@ def main():
     ap.add_argument("--new-tokens", type=int, default=128)
     ap.add_argument("--decode-chunk", type=int, default=8,
                     help="decode steps per host sync (1 = sync per token)")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="split-fuse: absorb prompts N tokens/iteration "
+                         "between decodes (0 = whole-prompt prefill)")
+    ap.add_argument("--weight-dtype", default="bfloat16",
+                    choices=["bfloat16", "int8"],
+                    help="int8 = weight-only quantized serving")
     ap.add_argument("--json-out", default=os.path.join(REPO, "SERVING_BENCH.json"))
     args = ap.parse_args()
 
@@ -58,7 +64,8 @@ def main():
         params, cfg, max_batch=args.slots, page_size=16,
         num_pages=args.slots * (-(-max_seq // 16)) + 32,
         max_seq=max_seq, prefill_bucket=args.prompt_len,
-        decode_chunk=args.decode_chunk)
+        decode_chunk=args.decode_chunk, prefill_chunk=args.prefill_chunk,
+        weight_dtype=args.weight_dtype)
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(1, cfg.vocab_size, args.prompt_len).tolist()
@@ -91,6 +98,9 @@ def main():
             "generated_total": generated,
             "wall_s": round(dt, 2),
             "decode_steps": engine.stats["decode_steps"],
+            "prefill_chunks": engine.stats["prefill_chunks"],
+            "prefill_chunk": args.prefill_chunk,
+            "weight_dtype": args.weight_dtype,
             "preempted": engine.stats["preempted"],
             "ms_per_decode_step": round(
                 1000 * dt / max(engine.stats["decode_steps"], 1), 2),

@@ -208,11 +208,7 @@ def _match(path: str, patterns: List[str]) -> bool:
     return any(fnmatch.fnmatch(path, pat) or pat in path for pat in patterns)
 
 
-def _leaf_path(kp) -> str:
-    parts = []
-    for k in kp:
-        parts.append(str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k)))))
-    return ".".join(parts)
+from deepspeed_tpu.utils.trees import leaf_path as _leaf_path
 
 
 class Compressor:

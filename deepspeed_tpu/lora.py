@@ -1,0 +1,137 @@
+"""LoRA adapter training (ref: deepspeed/linear/optimized_linear.py
+LoRAOptimizedLinear + deepspeed/linear/config.py LoRAConfig — the
+reference wraps Linear modules so only the low-rank A/B factors train,
+with the frozen base weight optionally sharded).
+
+TPU design: models here are pure pytrees, so LoRA is a TREE transform,
+not a module wrapper.  The ENGINE's params are just the adapter tree —
+optimizer state, ZeRO sharding, and checkpoints are all adapter-sized
+(the entire point of LoRA: a 0.1% state footprint) — while the frozen
+base weights are closed over by the loss and baked into the jitted step
+as device constants.  Each step traces ``W_eff = W + (alpha/r)·A@B`` per
+target leaf; XLA fuses the rank-r matmul + add into the consumer region,
+so no persistent merged copy exists and gradients flow only to A/B by
+construction (the base is not an argument).
+
+Example::
+
+    lcfg = LoRAConfig(lora_r=8, lora_alpha=16,
+                      target_modules=("wq", "wv"))
+    adapters = init_lora(jax.random.PRNGKey(0), base_params, lcfg)
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=lora_loss_fn(llama.loss_fn(cfg), base_params, lcfg),
+        params=adapters, config={...})
+    ...
+    merged = merge_lora(base_params, engine.module_params(), lcfg)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.trees import leaf_path as _leaf_path
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    """ref: deepspeed/linear/config.py LoRAConfig (lora_r, lora_alpha,
+    base_weight_sharding — the last is moot here: GSPMD shards the frozen
+    base like any other constant)."""
+
+    lora_r: int = 8
+    lora_alpha: int = 32
+    target_modules: Sequence[str] = ("wq", "wk", "wv", "wo")
+
+    @property
+    def scale(self) -> float:
+        return self.lora_alpha / self.lora_r
+
+    def matches(self, path: str) -> bool:
+        leaf = path.split(".")[-1]
+        return any(t == leaf or t == path for t in self.target_modules)
+
+
+def _target_leaves(params: Any, cfg: LoRAConfig):
+    out = []
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        path = _leaf_path(kp)
+        if cfg.matches(path) and getattr(leaf, "ndim", 0) >= 2:
+            out.append((path, leaf))
+    if not out:
+        raise ValueError(
+            f"no parameter matched target_modules={cfg.target_modules!r} "
+            "— check the leaf names against your params tree")
+    return out
+
+
+def init_lora(rng: jax.Array, base_params: Any, cfg: LoRAConfig,
+              dtype=jnp.float32) -> Any:
+    """Adapter tree {path: {"A": [..., in, r], "B": [..., r, out]}}.
+
+    A is gaussian (1/r std), B zeros — so training starts exactly at the
+    base model (reference init).  Stacked-layer leaves ([L, in, out])
+    get stacked adapters ([L, in, r] / [L, r, out]).
+    """
+    adapters = {}
+    for path, leaf in _target_leaves(base_params, cfg):
+        rng, k = jax.random.split(rng)
+        *lead, din, dout = leaf.shape
+        adapters[path] = {
+            "A": (jax.random.normal(k, (*lead, din, cfg.lora_r))
+                  / cfg.lora_r).astype(dtype),
+            "B": jnp.zeros((*lead, cfg.lora_r, dout), dtype),
+        }
+    return adapters
+
+
+def _delta(ad, scale, dtype):
+    return (scale * ad["A"].astype(jnp.float32)
+            @ ad["B"].astype(jnp.float32)).astype(dtype)
+
+
+def apply_lora(base_params: Any, adapters: Any, cfg: LoRAConfig) -> Any:
+    """Effective params: base + scale·A@B on target leaves (traced —
+    call inside the loss/forward)."""
+    flat = dict(adapters)
+
+    def leaf(kp, w):
+        ad = flat.get(_leaf_path(kp))
+        if ad is None:
+            return w
+        return w + _delta(ad, cfg.scale, w.dtype)
+
+    return jax.tree_util.tree_map_with_path(leaf, base_params)
+
+
+def lora_loss_fn(base_loss_fn: Callable, base_params: Any,
+                 cfg: LoRAConfig, compute_dtype=jnp.bfloat16) -> Callable:
+    """``(adapters, batch) -> loss`` for ``initialize(params=adapters)``.
+
+    The frozen base is captured in compute precision (no f32 master is
+    ever built for it — it does not train)."""
+    frozen = jax.tree.map(
+        lambda x: jax.lax.stop_gradient(x.astype(compute_dtype))
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+        base_params)
+
+    def f(adapters, batch):
+        return base_loss_fn(apply_lora(frozen, adapters, cfg), batch)
+
+    return f
+
+
+def merge_lora(base_params: Any, adapters: Any, cfg: LoRAConfig) -> Any:
+    """Fold trained adapters into a standalone checkpoint-ready tree
+    (ref: peft merge_and_unload / the reference's full-weight export)."""
+    return apply_lora(base_params, jax.tree.map(jnp.asarray, adapters), cfg)
+
+
+def count_trainable(adapters: Any) -> Tuple[int, int]:
+    """(n_adapter_params, bytes) — the LoRA footprint."""
+    n = sum(l.size for l in jax.tree.leaves(adapters))
+    b = sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(adapters))
+    return n, b

@@ -1,0 +1,18 @@
+"""Small shared pytree helpers."""
+
+from __future__ import annotations
+
+
+def leaf_path(kp) -> str:
+    """KeyPath → dotted module-style path ('blocks.wq').
+
+    Handles DictKey (.key), SequenceKey (.idx), GetAttrKey (.name) and
+    falls back to str() — one implementation so path-matching semantics
+    (compression module groups, LoRA target_modules) cannot drift.
+    """
+    parts = []
+    for k in kp:
+        parts.append(str(getattr(k, "key",
+                                 getattr(k, "idx",
+                                         getattr(k, "name", k)))))
+    return ".".join(parts)
