@@ -284,6 +284,18 @@ class TrainingEngine:
         self._eval_fn = jax.jit(self._eval_step,
                                 in_shardings=(self.state_shardings, None))
 
+        # curriculum (ref: engine.curriculum_scheduler +
+        # megatron curriculum_seqlen truncation in the train path): the
+        # parsed block must DRIVE the step, not sit inert — seqlen-type
+        # curricula truncate the batch's sequence axis before the jit.
+        # difficulty_step quantization bounds the distinct compiled
+        # shapes, exactly the reference's recompile-limiting knob.
+        self.curriculum_scheduler = None
+        if config.curriculum is not None and config.curriculum.enabled:
+            from deepspeed_tpu.data.curriculum import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(config.curriculum)
+
         # host bookkeeping (ref: engine.global_steps / skipped_steps)
         self.global_steps = 0
         self._pending: Optional[dict] = None
@@ -715,11 +727,30 @@ class TrainingEngine:
 
         return jax.tree.map(fix, batch)
 
+    def curriculum_difficulty(self) -> Optional[int]:
+        """Current curriculum difficulty (ref: engine.curriculum_scheduler
+        .get_difficulty), or None when no curriculum is configured."""
+        if self.curriculum_scheduler is None:
+            return None
+        return self.curriculum_scheduler.get_difficulty(self.global_steps)
+
+    def _apply_curriculum(self, batch):
+        if self.curriculum_scheduler is None or \
+                self.curriculum_scheduler.cfg.curriculum_type != "seqlen":
+            return batch
+        from deepspeed_tpu.data.curriculum import truncate_to_difficulty
+
+        return truncate_to_difficulty(
+            batch, self.curriculum_difficulty(),
+            seq_keys=("tokens", "input_ids", "labels", "attention_mask",
+                      "position_ids", "loss_mask", "segment_ids"))
+
     def train_batch(self, batch) -> jnp.ndarray:
         """Run one full optimizer step on a global batch; returns the loss.
 
         (ref: PipelineEngine.train_batch — one call per global step.)
         """
+        batch = self._apply_curriculum(batch)
         timed = self.monitor.enabled
         if timed:
             self.tput_timer.start()
@@ -737,7 +768,9 @@ class TrainingEngine:
         train_batch actually runs.  HLO/memory inspection must go through
         here: the step jit leaves batch shardings unspecified (placement
         happens in _align_batch), so lowering a raw host batch would
-        inspect a differently-sharded program."""
+        inspect a differently-sharded program.  Curriculum truncation
+        applies for the same reason — same shapes as the real step."""
+        batch = self._apply_curriculum(batch)
         return self._step_fn.lower(self.state, self._align_batch(batch))
 
     # torch-idiom compatibility shims (ref: engine.__call__/backward/step)
@@ -745,6 +778,7 @@ class TrainingEngine:
         # State is committed immediately — the step donates the old buffers,
         # so holding them in a "pending" slot would leave self.state pointing
         # at deleted arrays.  backward()/step() validate call order only.
+        batch = self._apply_curriculum(batch)
         new_state, metrics = self._step_fn(self.state, self._align_batch(batch))
         self.state = new_state
         self._pending = metrics
@@ -868,6 +902,12 @@ def initialize(args=None, *, loss_fn: Callable, params: Any,
                 "the ZeRO-Infinity scheduled-offload engine drives its own "
                 "Adam update and parameter layout; pass the optimizer via "
                 "the config block and drop param_specs/has_aux")
+        if config.curriculum is not None and config.curriculum.enabled:
+            raise ValueError(
+                "curriculum_learning does not compose with the scheduled "
+                "ZeRO-Infinity engine yet — drop one of the two (the "
+                "TrainingEngine honors curriculum; Infinity ignores it, "
+                "which would be a silent no-op)")
         if _is_init_thunk(params):
             # zero.Init thunk: the Infinity engine keeps bf16 compute params
             # resident in HBM regardless, so materialize the thunk eagerly

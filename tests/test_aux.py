@@ -223,3 +223,81 @@ class TestDataAnalyzer:
         assert unseen > seen  # out-of-corpus tokens rank hardest
         with pytest.raises(ValueError, match="vocab_size"):
             VocabRarity(vocab_size=8).fit([{"tokens": np.asarray([9])}])
+
+
+class TestEngineCurriculum:
+    """The parsed curriculum block drives train_batch (ref:
+    engine.curriculum_scheduler + megatron curriculum_seqlen)."""
+
+    def test_seqlen_curriculum_truncates_and_learns(self, devices):
+        import deepspeed_tpu as dstpu
+        from deepspeed_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny()
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=llama.loss_fn(cfg),
+            params=llama.init_params(jax.random.PRNGKey(0), cfg),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "curriculum_learning": {
+                        "enabled": True, "curriculum_type": "seqlen",
+                        "min_difficulty": 9, "max_difficulty": 33,
+                        "schedule_config": {"total_curriculum_step": 4,
+                                            "difficulty_step": 8}}})
+        assert engine.curriculum_difficulty() == 9
+        toks = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (8, 33)), jnp.int32)
+        losses = [float(engine.train_batch({"tokens": toks}))
+                  for _ in range(6)]
+        assert np.isfinite(losses).all()
+        # ramped to max, floored to the difficulty_step grid (the
+        # reference scheduler does the same: 33 -> 32 at step 8)
+        assert engine.curriculum_difficulty() == 32
+
+    def test_no_curriculum_block_is_inert(self, devices):
+        import deepspeed_tpu as dstpu
+
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=lambda p, b: jnp.sum(p["w"] * b["x"].mean()),
+            params={"w": jnp.ones(4)},
+            config={"train_batch_size": 8})
+        assert engine.curriculum_scheduler is None
+        assert engine.curriculum_difficulty() is None
+
+    def test_torch_idiom_applies_curriculum(self, devices):
+        import deepspeed_tpu as dstpu
+        from deepspeed_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny()
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=llama.loss_fn(cfg),
+            params=llama.init_params(jax.random.PRNGKey(0), cfg),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "curriculum_learning": {
+                        "enabled": True, "curriculum_type": "seqlen",
+                        "min_difficulty": 9, "max_difficulty": 33,
+                        "schedule_config": {"total_curriculum_step": 400,
+                                            "difficulty_step": 8}}})
+        toks = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (8, 33)), jnp.int32)
+        loss = engine({"tokens": toks})          # torch idiom
+        engine.backward(loss)
+        engine.step()
+        # same truncated-program shapes as train_batch: difficulty 9
+        # means the compiled step saw [8, 9] tokens — compare losses
+        l2 = float(engine.train_batch({"tokens": toks}))
+        assert np.isfinite(float(loss)) and np.isfinite(l2)
+
+    def test_infinity_rejects_curriculum(self, devices):
+        import deepspeed_tpu as dstpu
+
+        with pytest.raises(ValueError, match="curriculum"):
+            dstpu.initialize(
+                loss_fn=lambda p, b: jnp.sum(p["w"]), params={"w": jnp.ones(4)},
+                config={"train_batch_size": 8,
+                        "optimizer": {"type": "adamw",
+                                      "params": {"lr": 1e-3}},
+                        "curriculum_learning": {"enabled": True},
+                        "zero_optimization": {"offload_optimizer": {
+                            "device": "cpu", "scheduled": True}}})
