@@ -131,6 +131,11 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None):
         meta = json.load(f)
     engine.global_steps = int(meta.get("global_steps", 0))
     engine.skipped_steps = int(meta.get("skipped_steps", 0))
+    pld = getattr(engine, "progressive_layer_drop", None)
+    if pld is not None:
+        # re-derive theta(t) — otherwise the first post-resume forward
+        # reads the fresh-init theta of 1.0 and keeps every layer
+        pld.update_state(engine.global_steps)
     logger.info("loaded checkpoint %s", path)
     return path, meta.get("client_state", {})
 

@@ -295,6 +295,26 @@ class TrainingEngine:
             from deepspeed_tpu.data.curriculum import CurriculumScheduler
 
             self.curriculum_scheduler = CurriculumScheduler(config.curriculum)
+        # PLD / eigenvalue engine attributes (ref: the reference engine
+        # owns progressive_layer_drop and eigenvalue objects; models read
+        # theta / keep-probs from here, _post_step advances the schedule)
+        self.progressive_layer_drop = None
+        if config.progressive_layer_drop:
+            from deepspeed_tpu.runtime_extras import ProgressiveLayerDrop
+
+            pld = config.progressive_layer_drop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=float(pld.get("theta", 0.5)),
+                gamma=float(pld.get("gamma", 0.001)))
+        self.eigenvalue = None
+        if config.eigenvalue:
+            from deepspeed_tpu.runtime_extras import Eigenvalue
+
+            ev = config.eigenvalue
+            self.eigenvalue = Eigenvalue(
+                max_iter=int(ev.get("max_iter", 100)),
+                tol=float(ev.get("tol", 1e-2)),
+                stability=float(ev.get("stability", 1e-6)))
 
         # host bookkeeping (ref: engine.global_steps / skipped_steps)
         self.global_steps = 0
@@ -695,6 +715,8 @@ class TrainingEngine:
         self.global_steps += 1
         self._last_metrics = metrics
         self._skipped_acc = self._skipped_acc + metrics["overflow"]
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         if self.monitor.enabled and (
                 self.global_steps % max(self.config.steps_per_print, 1) == 0):
             self.monitor.write_scalars(
@@ -726,6 +748,19 @@ class TrainingEngine:
                 a, self._batch_sharding if a.ndim >= 1 else repl)
 
         return jax.tree.map(fix, batch)
+
+    def random_ltd_scheduler(self, seq_len: int):
+        """Build the configured random-LTD scheduler for a model's
+        sequence length (ref: the reference engine's random_ltd hooks —
+        the kept-token count needs the model seq_len, which only the
+        model knows, hence a factory rather than an attribute)."""
+        if self.config.random_ltd is None:
+            raise ValueError(
+                "no data_efficiency.data_routing.random_ltd block in the "
+                "config")
+        from deepspeed_tpu.random_ltd import RandomLTDScheduler
+
+        return RandomLTDScheduler(self.config.random_ltd, seq_len)
 
     def curriculum_difficulty(self) -> Optional[int]:
         """Current curriculum difficulty (ref: engine.curriculum_scheduler

@@ -73,12 +73,29 @@ class RandomLTDConfig:
     random_ltd_layer_num: int = 0          # how many middle layers wrapped
     random_ltd_layer_id: tuple = ()        # which layers; default: middle
     start_ratio: float = 0.5               # initial kept fraction
+    start_value: int = 0                   # absolute kept-token start (wins)
     schedule_type: str = "fixed_linear"
     total_schedule_steps: int = 1000
     step_quantum: int = 16                 # round kept count (recompile rate)
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "RandomLTDConfig":
+        d = dict(d)
+        # the reference nests the ramp under random_ltd_schedule
+        # (min_value/max_value + schedule_config.seq_per_step/
+        # require_steps, ref: data_pipeline/config.py) — map it rather
+        # than silently dropping a migrated config
+        sched = d.pop("random_ltd_schedule", None)
+        if sched:
+            if "min_value" in sched:
+                d.setdefault("start_value", int(sched["min_value"]))
+            sc = sched.get("schedule_config", {})
+            if "seq_per_step" in sc:
+                d.setdefault("step_quantum", int(sc["seq_per_step"]))
+            if "require_steps" in sc:
+                d.setdefault("total_schedule_steps", int(sc["require_steps"]))
+            if "schedule_type" in sched:
+                d.setdefault("schedule_type", sched["schedule_type"])
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in d.items() if k in known})
 
@@ -90,7 +107,8 @@ class RandomLTDScheduler:
     def __init__(self, cfg: RandomLTDConfig, seq_len: int):
         self.cfg = cfg
         self.seq_len = seq_len
-        self.start = max(1, int(round(seq_len * cfg.start_ratio)))
+        self.start = (min(cfg.start_value, seq_len) if cfg.start_value
+                      else max(1, int(round(seq_len * cfg.start_ratio))))
 
     def keep_at(self, step: int) -> int:
         c = self.cfg

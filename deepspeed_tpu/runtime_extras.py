@@ -50,8 +50,10 @@ class Eigenvalue:
     def compute(self, loss_fn: Callable[[Any], jnp.ndarray],
                 params: Any) -> float:
         """Dominant |eigenvalue| of ∇²loss at params."""
-        if self._jit_hvp is None:
-            self._jit_hvp = jax.jit(lambda p, v: hvp(loss_fn, p, v))
+        # jit per loss_fn — caching the first closure forever would
+        # silently return the FIRST loss's curvature on every later call
+        # (jax's own cache dedupes repeated calls with the same fn object)
+        self._jit_hvp = jax.jit(lambda p, v: hvp(loss_fn, p, v))
         key = jax.random.PRNGKey(self.seed)
         leaves, treedef = jax.tree.flatten(params)
         keys = jax.random.split(key, len(leaves))
