@@ -47,7 +47,11 @@ class LlamaConfig:
     tie_embeddings: bool = False
     remat: str = "none"                    # none | full | save_dots
     loss_chunk: int = 0                    # >0: fused chunked-vocab CE
-    attn_impl: str = "auto"                # auto | flash | reference | ring
+    # attn_impl="sparse": blocksparse attention from this dict (the
+    # engine config's `sparse_attention` block — {"mode": ..., "block":
+    # ..., ...}; see ops/sparse_attention.sparsity_config_from_dict)
+    sparse_config: Optional[Dict[str, Any]] = None
+    attn_impl: str = "auto"     # auto | flash | reference | ring | ulysses | sparse
 
     def __post_init__(self):
         if self.ffn_dim is None:
@@ -185,6 +189,28 @@ def apply_rope(x, cos, sin):
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
+_SPARSE_CACHE = {}
+
+
+def _sparse_self_attention(cfg: LlamaConfig):
+    """Per-config SparseSelfAttention (caches per-seqlen layouts so the
+    O(H·nb²) host-side layout build does not rerun on every retrace)."""
+    from deepspeed_tpu.ops.sparse_attention import (
+        SparseSelfAttention, sparsity_config_from_dict)
+
+    norm = tuple(sorted(
+        (k, tuple(v) if isinstance(v, (list, tuple)) else v)
+        for k, v in (cfg.sparse_config or {}).items()))
+    key = (cfg.n_heads, norm)
+    sa = _SPARSE_CACHE.get(key)
+    if sa is None:
+        sc = sparsity_config_from_dict(
+            cfg.sparse_config or {}, cfg.n_heads,
+            attention="unidirectional")               # causal LM default
+        sa = _SPARSE_CACHE[key] = SparseSelfAttention(sc, causal=True)
+    return sa
+
+
 def _attention(q, k, v, cfg: LlamaConfig, segment_ids=None):
     """q: [B,T,H,Dh], k/v: [B,T,KV,Dh] → [B,T,H,Dh]."""
     impl = cfg.attn_impl
@@ -207,6 +233,18 @@ def _attention(q, k, v, cfg: LlamaConfig, segment_ids=None):
 
             return ulysses_attention_sharded(q, k, v, ms, causal=True)
         impl = "auto"  # no seq axis in scope: plain attention
+    if impl == "sparse":
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "packed-sequence segment_ids are not supported on the "
+                "blocksparse path yet")
+        sa = _sparse_self_attention(cfg)   # cached per-config wrapper
+        rep = cfg.n_heads // cfg.n_kv_heads
+        kh = jnp.repeat(k, rep, axis=2) if rep > 1 else k
+        vh = jnp.repeat(v, rep, axis=2) if rep > 1 else v
+        out = sa(q.transpose(0, 2, 1, 3), kh.transpose(0, 2, 1, 3),
+                 vh.transpose(0, 2, 1, 3))
+        return out.transpose(0, 2, 1, 3)
     if impl in ("auto", "flash"):
         try:
             from deepspeed_tpu.ops.attention import flash_attention

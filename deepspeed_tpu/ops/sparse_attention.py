@@ -260,6 +260,46 @@ def _gather_plan(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return idx, mask
 
 
+_MODE_REGISTRY = {
+    "dense": DenseSparsityConfig,
+    "fixed": FixedSparsityConfig,
+    "variable": VariableSparsityConfig,
+    "bigbird": BigBirdSparsityConfig,
+    "bslongformer": BSLongformerSparsityConfig,
+    "local_sliding_window": LocalSlidingWindowSparsityConfig,
+}
+
+
+def sparsity_config_from_dict(d, num_heads: int, **defaults) -> SparsityConfig:
+    """``{"mode": "fixed"|"bigbird"|..., ...}`` → SparsityConfig (ref:
+    the ``sparse_attention`` JSON block of deepspeed/runtime/config.py,
+    whose ``mode`` picks the sparsity_config class).
+
+    ``defaults`` are soft: applied only when the chosen class has the
+    field and the dict didn't set it (e.g. a causal-LM caller defaults
+    ``attention="unidirectional"`` — meaningless for dense)."""
+    d = dict(d or {})
+    d.pop("num_heads", None)  # the caller's model owns the head count
+    mode = str(d.pop("mode", "fixed")).lower()
+    if mode not in _MODE_REGISTRY:
+        raise ValueError(f"unknown sparse_attention mode {mode!r}; "
+                         f"one of {sorted(_MODE_REGISTRY)}")
+    cls = _MODE_REGISTRY[mode]
+    known = {f.name for f in dataclasses.fields(cls)}
+    for key, val in defaults.items():
+        if key in known:
+            d.setdefault(key, val)
+    unknown = set(d) - known
+    if unknown:
+        raise ValueError(
+            f"sparse_attention mode {mode!r} does not accept {sorted(unknown)}")
+    for tup in ("local_window_blocks", "global_block_indices",
+                "global_block_end_indices"):
+        if tup in d and d[tup] is not None:
+            d[tup] = tuple(d[tup])
+    return cls(num_heads=num_heads, **d)
+
+
 def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      layout: np.ndarray, block: int,
                      causal: bool = False,
