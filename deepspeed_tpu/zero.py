@@ -140,6 +140,42 @@ def grad_constraint(grads: Any, ms: MeshSpec, stage: int,
             g, ms.sharding(_zero_spec(g, base, ms))), grads, specs)
 
 
+def estimate_memory(num_params: int, dp_world: int, stage: int,
+                    offload_optimizer: bool = False,
+                    compute_bytes: int = 2, master_bytes: int = 4,
+                    activation_bytes: int = 0) -> dict:
+    """Per-device memory plan for a ZeRO stage (ref:
+    deepspeed/runtime/zero/stage3.py estimate_zero3_model_states_mem_needs*
+    / stage_1_and_2.py estimate_zero2_model_states_mem_needs*).
+
+    Returns bytes per device for each state class plus the total.  The
+    model: bf16 compute copy (replicated below stage 3, sharded at 3),
+    f32 master + two Adam moments (sharded from stage 1; on host when
+    ``offload_optimizer``), grads in compute dtype (sharded from stage 2).
+    """
+    if not 0 <= stage <= 3:
+        raise ValueError(f"stage must be 0..3, got {stage}")
+    n, w = num_params, max(dp_world, 1)
+    shard = lambda b: b // w
+    opt = 3 * master_bytes * n                      # master + m + v
+    plan = {
+        "compute_params": shard(compute_bytes * n) if stage >= 3
+        else compute_bytes * n,
+        "gradients": shard(compute_bytes * n) if stage >= 2
+        else compute_bytes * n,
+        "optimizer_states": 0 if offload_optimizer
+        else (shard(opt) if stage >= 1 else opt),
+        # stage 0 keeps replicated state: every host holds the FULL copy
+        "host_optimizer_states": (shard(opt) if stage >= 1 else opt)
+        if offload_optimizer else 0,
+        "activations": activation_bytes,
+    }
+    plan["device_total"] = (plan["compute_params"] + plan["gradients"]
+                            + plan["optimizer_states"]
+                            + plan["activations"])
+    return plan
+
+
 def sharded_init(init_fn: Callable[[], Any], ms: MeshSpec, stage: int,
                  param_specs: SpecTree = None) -> Any:
     """Materialize a parameter pytree directly into its ZeRO shardings.

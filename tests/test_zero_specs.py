@@ -99,3 +99,27 @@ def test_lower_rank_state_leaf(devices):
                                  param_specs=specs)
     spec = sh["w"].spec
     assert len(spec) <= 1  # truncated to rank 1
+
+
+def test_estimate_memory_plans(devices):
+    """ref: estimate_zero{2,3}_model_states_mem_needs — sanity of the
+    per-device arithmetic across stages."""
+    n, w = 7_000_000_000, 8
+    s0 = zero.estimate_memory(n, w, 0)
+    s1 = zero.estimate_memory(n, w, 1)
+    s2 = zero.estimate_memory(n, w, 2)
+    s3 = zero.estimate_memory(n, w, 3)
+    # monotone: each stage strictly shrinks the device total
+    assert s0["device_total"] > s1["device_total"] > s2["device_total"] \
+        > s3["device_total"]
+    # stage-3 totals = (2 + 2 + 12)/8 bytes/param
+    assert s3["device_total"] == (2 * n) // w * 2 + (12 * n) // w
+    off = zero.estimate_memory(n, w, 3, offload_optimizer=True)
+    assert off["optimizer_states"] == 0
+    assert off["host_optimizer_states"] == 12 * n // w
+    assert off["device_total"] < s3["device_total"]
+    # stage-0 offload: replicated state — every host holds the full copy
+    off0 = zero.estimate_memory(n, w, 0, offload_optimizer=True)
+    assert off0["host_optimizer_states"] == 12 * n
+    with pytest.raises(ValueError):
+        zero.estimate_memory(n, w, 5)
