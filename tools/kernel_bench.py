@@ -173,6 +173,41 @@ def paged_vs_gather(configs, iters):
     return rows
 
 
+def chunk_vs_gather(configs, iters):
+    """Chunked-prefill (split-fuse) attention: pallas kernel vs the
+    masked-gather reference — decides where the 1<<28 gather-bytes
+    threshold in models/llama.py forward_paged should actually sit for
+    chunk shapes (round-3: committed untested, tunnel was down)."""
+    from deepspeed_tpu.inference.kernels import (
+        paged_chunk_attention, paged_chunk_attention_reference)
+
+    rows = []
+    for (B, C, H, KV, Dh, ps, pages, seq) in configs:
+        mp = -(-seq // ps)
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(ks[0], (B, C, H, Dh), jnp.bfloat16)
+        kp = jax.random.normal(ks[1], (KV, pages, ps, Dh), jnp.bfloat16)
+        vp = jax.random.normal(ks[2], (KV, pages, ps, Dh), jnp.bfloat16)
+        rng = np.random.default_rng(1)
+        table = jnp.asarray(
+            rng.permutation(pages)[:B * mp].reshape(B, mp), jnp.int32)
+        start = jnp.asarray(rng.integers(0, seq - C, B), jnp.int32)
+
+        pal = jax.jit(lambda q, kp, vp, t, s: paged_chunk_attention(
+            q, kp, vp, t, s))
+        ref = jax.jit(lambda q, kp, vp, t, s:
+                      paged_chunk_attention_reference(q, kp, vp, t, s))
+        tp = bench(pal, q, kp, vp, table, start, iters=iters)
+        tr = bench(ref, q, kp, vp, table, start, iters=iters)
+        rows.append({
+            "shape": {"B": B, "C": C, "H": H, "KV": KV, "Dh": Dh,
+                      "page": ps, "pages": pages, "seq": seq},
+            "pallas_ms": round(1e3 * tp, 3), "gather_ms": round(1e3 * tr, 3),
+            "speedup": round(tr / tp, 2)})
+        print("chunk", rows[-1])
+    return rows
+
+
 def block_sweep(iters):
     """Sweep flash tile sizes at the bench shape; _pick_blocks should
     match the argmin."""
@@ -223,15 +258,21 @@ def main():
     adam_sizes = [1 << 22, 1 << 26]
     paged_cfgs = [(8, 16, 4, 128, 16, 512, 1024),
                   (16, 16, 8, 128, 16, 1024, 512)]
+    # (B, C, H, KV, Dh, page, pages, seq): short interactive chunk,
+    # serving-default chunk, long-context chunk over a big table
+    chunk_cfgs = [(8, 16, 16, 4, 128, 16, 512, 1024),
+                  (8, 64, 16, 4, 128, 16, 512, 1024),
+                  (4, 64, 16, 4, 128, 16, 2048, 8192)]
     if args.quick:
         attn_shapes, adam_sizes = attn_shapes[:1], adam_sizes[:1]
-        paged_cfgs = paged_cfgs[:1]
+        paged_cfgs, chunk_cfgs = paged_cfgs[:1], chunk_cfgs[:1]
 
     result = {
         "backend": jax.default_backend(),
         "flash_vs_xla": flash_vs_ref(attn_shapes, iters),
         "adam_pallas_vs_xla": adam_vs_xla(adam_sizes, iters),
         "paged_decode_vs_gather": paged_vs_gather(paged_cfgs, iters),
+        "chunk_prefill_vs_gather": chunk_vs_gather(chunk_cfgs, iters),
         "flash_block_sweep": block_sweep(iters),
     }
     with open(args.json_out, "w") as f:
