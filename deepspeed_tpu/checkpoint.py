@@ -44,6 +44,7 @@ _async_ckptr = None     # one StandardCheckpointer owns the background save
 _pending_finalize = None  # its in-flight save's meta/latest writer — module
 #                           scope, PAIRED with _async_ckptr: any engine's
 #                           next save/load/wait must finalize it
+_atexit_registered = False
 
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
@@ -90,6 +91,15 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 
     if async_save:
         _pending_finalize = finalize
+        # normal interpreter exit must still commit this save: without the
+        # atexit join, a process that exits after its final async save
+        # leaves the state on disk but never writes meta/latest, so
+        # load_checkpoint cannot find the tag
+        global _atexit_registered
+        if not _atexit_registered:
+            import atexit
+            atexit.register(wait_for_checkpoint)
+            _atexit_registered = True
         return path
     ckptr.wait_until_finished()
     finalize()

@@ -33,7 +33,7 @@ def dense_lm_loss(x, head, targets, mask=None):
     x: [N, D] (flattened positions), head: [D, V], targets: [N] int32,
     mask: optional [N] (1 = count).  Returns scalar f32.
     """
-    logits = (x @ head).astype(jnp.float32)
+    logits = jnp.dot(x, head, preferred_element_type=jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[:, None], axis=-1)[:, 0]
     if mask is None:
@@ -74,7 +74,9 @@ def _chunked_fwd_pieces(x, head, targets, num_chunks, v_real):
     def step(carry, inp):
         m, s, tgt = carry                            # running max / sum / logit
         hc, base = inp
-        logits = (x @ hc).astype(jnp.float32)        # [N, Vc]
+        # f32 MXU accumulation: a bf16 product rounded then upcast would
+        # quantize logits to 8 mantissa bits before the logsumexp
+        logits = jnp.dot(x, hc, preferred_element_type=jnp.float32)
         logits = jnp.where((base + col < v_real)[None, :], logits, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.sum(
@@ -114,7 +116,7 @@ def _chunked_nll_bwd(num_chunks, v_real, res, g):
     def step(carry, inp):
         dx, dheads_c = carry
         hc, base, c = inp
-        logits = (x @ hc).astype(jnp.float32)        # [N, Vc] recompute
+        logits = jnp.dot(x, hc, preferred_element_type=jnp.float32)  # recompute
         logits = jnp.where((base + col < v_real)[None, :], logits, -jnp.inf)
         p = jnp.exp(logits - lse[:, None])           # softmax block (pad→0)
         local = targets - base

@@ -17,6 +17,7 @@ keep decisions are a Bernoulli vector consumed by the model's scan.
 
 from __future__ import annotations
 
+import weakref
 from typing import Any, Callable, Tuple
 
 import numpy as np
@@ -36,11 +37,18 @@ class Eigenvalue:
 
     def __init__(self, max_iter: int = 100, tol: float = 1e-2,
                  stability: float = 1e-6, seed: int = 0):
+        if max_iter < 1:
+            raise ValueError(f"max_iter must be >= 1, got {max_iter}")
         self.max_iter = max_iter
         self.tol = tol
         self.stability = stability
         self.seed = seed
-        self._jit_hvp = None
+        # jitted HVP cache keyed on the loss_fn object: jax's jit cache is
+        # per-wrapper, so a fresh jax.jit(lambda...) per call would retrace
+        # every invocation, while caching only the first closure would
+        # return the FIRST loss's curvature for every later loss_fn
+        self._hvp_cache: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary())
 
     def _normalize(self, v):
         sq = sum(jnp.vdot(x, x) for x in jax.tree.leaves(v))
@@ -50,10 +58,18 @@ class Eigenvalue:
     def compute(self, loss_fn: Callable[[Any], jnp.ndarray],
                 params: Any) -> float:
         """Dominant |eigenvalue| of ∇²loss at params."""
-        # jit per loss_fn — caching the first closure forever would
-        # silently return the FIRST loss's curvature on every later call
-        # (jax's own cache dedupes repeated calls with the same fn object)
-        self._jit_hvp = jax.jit(lambda p, v: hvp(loss_fn, p, v))
+        try:
+            jit_hvp = self._hvp_cache[loss_fn]
+        except (KeyError, TypeError):   # TypeError: non-weakrefable fn
+            try:
+                # close over a weakref, not loss_fn itself: a strong
+                # capture would pin the WeakKeyDictionary key via its own
+                # value and the cache would never evict dead closures
+                fn_ref = weakref.ref(loss_fn)
+                jit_hvp = jax.jit(lambda p, v: hvp(fn_ref(), p, v))
+                self._hvp_cache[loss_fn] = jit_hvp
+            except TypeError:       # uncacheable: jit per call, no entry
+                jit_hvp = jax.jit(lambda p, v: hvp(loss_fn, p, v))
         key = jax.random.PRNGKey(self.seed)
         leaves, treedef = jax.tree.flatten(params)
         keys = jax.random.split(key, len(leaves))
@@ -63,7 +79,7 @@ class Eigenvalue:
         v, _ = self._normalize(v)
         prev = 0.0
         for _ in range(self.max_iter):
-            hv = self._jit_hvp(params, v)
+            hv = jit_hvp(params, v)
             v, lam = self._normalize(hv)
             lam = float(lam)
             if abs(lam - prev) / (abs(lam) + self.stability) < self.tol:

@@ -155,6 +155,11 @@ def estimate_memory(num_params: int, dp_world: int, stage: int,
     """
     if not 0 <= stage <= 3:
         raise ValueError(f"stage must be 0..3, got {stage}")
+    if offload_optimizer and stage == 0:
+        # no stage-0 offload path exists in the engine (the reference
+        # estimators likewise only model offload for ZeRO 1-3) — refuse to
+        # describe an unreachable plan
+        raise ValueError("offload_optimizer requires ZeRO stage >= 1")
     n, w = num_params, max(dp_world, 1)
     shard = lambda b: b // w
     opt = 3 * master_bytes * n                      # master + m + v
@@ -165,9 +170,7 @@ def estimate_memory(num_params: int, dp_world: int, stage: int,
         else compute_bytes * n,
         "optimizer_states": 0 if offload_optimizer
         else (shard(opt) if stage >= 1 else opt),
-        # stage 0 keeps replicated state: every host holds the FULL copy
-        "host_optimizer_states": (shard(opt) if stage >= 1 else opt)
-        if offload_optimizer else 0,
+        "host_optimizer_states": shard(opt) if offload_optimizer else 0,
         "activations": activation_bytes,
     }
     plan["device_total"] = (plan["compute_params"] + plan["gradients"]
