@@ -231,14 +231,14 @@ def mixtral_generator(params, cfg, eos_token_id: Optional[int] = None,
     return Generator(params, step, step, alloc, eos_token_id=eos_token_id)
 
 
-def llama_paged_generator(params, cfg, eos_token_id: Optional[int] = None,
-                          page_size: int = 16, num_pages: Optional[int] = None,
-                          cache_dtype=jnp.bfloat16) -> Generator:
-    """Paged-KV variant: decode streams only live pages via the pallas
-    paged-attention kernel (ref contract: deepspeed/ops/transformer/
-    inference decode kernels + their preallocated KV workspace)."""
+def _paged_generator(forward_paged, params, cfg,
+                     eos_token_id: Optional[int] = None,
+                     page_size: int = 16, num_pages: Optional[int] = None,
+                     cache_dtype=jnp.bfloat16) -> Generator:
+    """Shared paged-KV generator over any ``forward_paged(params, tokens,
+    cfg, cache)`` — cache sizing and wiring live once, model families
+    supply only their forward."""
     from deepspeed_tpu.inference.kernels import PagedKVCache
-    from deepspeed_tpu.models import llama
 
     def alloc(batch, max_seq):
         mp = -(-max_seq // page_size)
@@ -248,6 +248,23 @@ def llama_paged_generator(params, cfg, eos_token_id: Optional[int] = None,
                                   dtype=cache_dtype)
 
     def step(params, tokens, cache):
-        return llama.forward_paged(params, tokens, cfg, cache)
+        return forward_paged(params, tokens, cfg, cache)
 
     return Generator(params, step, step, alloc, eos_token_id=eos_token_id)
+
+
+def llama_paged_generator(params, cfg, **kw) -> Generator:
+    """Paged-KV variant: decode streams only live pages via the pallas
+    paged-attention kernel (ref contract: deepspeed/ops/transformer/
+    inference decode kernels + their preallocated KV workspace)."""
+    from deepspeed_tpu.models import llama
+
+    return _paged_generator(llama.forward_paged, params, cfg, **kw)
+
+
+def mixtral_paged_generator(params, cfg, **kw) -> Generator:
+    """Paged-KV MoE generation — the offline oracle for Mixtral serving
+    (ref: DeepSpeed-MoE inference engine's generate path)."""
+    from deepspeed_tpu.models import mixtral
+
+    return _paged_generator(mixtral.forward_paged, params, cfg, **kw)

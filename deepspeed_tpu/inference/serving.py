@@ -494,3 +494,51 @@ def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
     return ServingEngine(
         params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
         head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, **kw)
+
+
+def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
+                           quant_group_size: int = 128,
+                           **kw) -> ServingEngine:
+    """ServingEngine over models/mixtral.py's paged MoE forward (ref:
+    DeepSpeed-MoE inference serving, deepspeed/inference/engine.py) —
+    iteration-level scheduling, paged KV, split-fuse and decode chunking
+    all apply to the MoE model unchanged."""
+    from deepspeed_tpu.models import mixtral
+
+    def step(params, tokens, cache):
+        return mixtral.forward_paged(params, tokens, cfg, cache)
+
+    def chunk_step(params, tokens, cache):
+        return mixtral.forward_paged(params, tokens, cfg, cache,
+                                     continuation=True)
+
+    if weight_dtype != "bfloat16":
+        from deepspeed_tpu.inference.quantized import quantize_for_inference
+
+        full = params
+        params, step, chunk_step = quantize_for_inference(
+            params, step, chunk_step, weight_dtype=weight_dtype,
+            group_size=quant_group_size)
+        # the router stays exact: int8 gate logits could flip a near-tied
+        # top-k choice and diverge generation from the trained routing
+        params["blocks"]["gate"] = full["blocks"]["gate"]
+
+    return ServingEngine(
+        params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, **kw)
+
+
+def serving_engine(params, cfg, **kw) -> ServingEngine:
+    """Model registry for serving: dispatch on the config type (ref:
+    init_inference accepting any supported model).  Covers every family
+    with a paged forward; others raise with the supported list."""
+    from deepspeed_tpu.models.llama import LlamaConfig
+    from deepspeed_tpu.models.mixtral import MixtralConfig
+
+    if isinstance(cfg, MixtralConfig):
+        return mixtral_serving_engine(params, cfg, **kw)
+    if isinstance(cfg, LlamaConfig):
+        return llama_serving_engine(params, cfg, **kw)
+    raise TypeError(
+        f"no serving path for config type {type(cfg).__name__}; "
+        "supported: LlamaConfig, MixtralConfig")

@@ -376,9 +376,13 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache):
 
 def forward_paged(params, tokens, cfg: LlamaConfig, cache,
                   interpret: Optional[bool] = None,
-                  continuation: bool = False):
+                  continuation: bool = False, ffn=None):
     """Forward over a paged KV cache (ref: the reference's inference
     kernels' workspace contract, modernised to vLLM-style page tables).
+
+    ``ffn``: optional ``(lp, h) -> y`` override of the per-block FFN —
+    the paged-attention backbone is model-agnostic, and MoE families
+    (models/mixtral.py) reuse it by swapping in their expert combine.
 
     Prefill (T > 1, empty cache): dense causal attention over the prompt,
     K/V bulk-written into pages.  Decode (T == 1): pallas paged attention
@@ -455,7 +459,8 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
             attn = pa(q[:, 0], kp, vp, cache.table, start + 1)[:, None]
         x = x + attn.reshape(B, T, nh * hd) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        x = x + swiglu(h, lp["w1"], lp["w3"]) @ lp["w2"]
+        x = x + (swiglu(h, lp["w1"], lp["w3"]) @ lp["w2"]
+                 if ffn is None else ffn(lp, h))
         return x, (kp, vp)
 
     x, (new_k, new_v) = jax.lax.scan(block, x,

@@ -316,6 +316,25 @@ def forward_with_cache(params, tokens, cfg: MixtralConfig, cache):
     return logits, cache
 
 
+def forward_paged(params, tokens, cfg: MixtralConfig, cache,
+                  interpret=None, continuation: bool = False):
+    """Paged-KV MoE forward for continuous-batching serving (ref:
+    DeepSpeed-MoE inference — the reference SERVES MoE models through its
+    inference engine, it does not just eval them; deepspeed/inference/
+    engine.py + deepspeed/moe/sharded_moe.py inference path).
+
+    Reuses models/llama.py's paged-attention backbone (page writes,
+    decode/chunk kernels, ragged frontiers) with the capacity-free dense
+    top-k expert combine swapped in as the FFN — so every ServingEngine
+    feature (split-fuse chunked prefill, K-token decode chunks, paged
+    preemption) works for MoE unchanged.  tokens: [B, T] →
+    (logits [B, T, V] f32, cache)."""
+    return _llama.forward_paged(
+        params, tokens, cfg.llama_view(), cache, interpret=interpret,
+        continuation=continuation,
+        ffn=lambda lp, h: _moe_ffn_dense(cfg, h, lp))
+
+
 def loss_fn(cfg: MixtralConfig):
     """Next-token CE + MoE aux losses; returns (loss, aux)."""
 

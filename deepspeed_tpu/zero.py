@@ -156,10 +156,14 @@ def estimate_memory(num_params: int, dp_world: int, stage: int,
     if not 0 <= stage <= 3:
         raise ValueError(f"stage must be 0..3, got {stage}")
     if offload_optimizer and stage == 0:
-        # no stage-0 offload path exists in the engine (the reference
-        # estimators likewise only model offload for ZeRO 1-3) — refuse to
-        # describe an unreachable plan
-        raise ValueError("offload_optimizer requires ZeRO stage >= 1")
+        # reachable but degenerate: engine_offload_shardings applies the
+        # host tier at any stage, so stage 0 pins the FULL replicated
+        # optimizer copy to every host (the reference estimators only
+        # model offload for ZeRO 1-3) — model it, but say so
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            "offload_optimizer at ZeRO stage 0 keeps the full replicated "
+            "optimizer state on every host; use stage >= 1 to shard it")
     n, w = num_params, max(dp_world, 1)
     shard = lambda b: b // w
     opt = 3 * master_bytes * n                      # master + m + v
@@ -170,7 +174,8 @@ def estimate_memory(num_params: int, dp_world: int, stage: int,
         else compute_bytes * n,
         "optimizer_states": 0 if offload_optimizer
         else (shard(opt) if stage >= 1 else opt),
-        "host_optimizer_states": shard(opt) if offload_optimizer else 0,
+        "host_optimizer_states": (shard(opt) if stage >= 1 else opt)
+        if offload_optimizer else 0,
         "activations": activation_bytes,
     }
     plan["device_total"] = (plan["compute_params"] + plan["gradients"]

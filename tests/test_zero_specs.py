@@ -118,8 +118,9 @@ def test_estimate_memory_plans(devices):
     assert off["optimizer_states"] == 0
     assert off["host_optimizer_states"] == 12 * n // w
     assert off["device_total"] < s3["device_total"]
-    # stage-0 offload is not an engine-supported combination: refused
-    with pytest.raises(ValueError):
-        zero.estimate_memory(n, w, 0, offload_optimizer=True)
+    # stage-0 offload: degenerate but reachable (engine_offload_shardings
+    # has no stage gate) — modeled as the full replicated copy per host
+    off0 = zero.estimate_memory(n, w, 0, offload_optimizer=True)
+    assert off0["host_optimizer_states"] == 12 * n
     with pytest.raises(ValueError):
         zero.estimate_memory(n, w, 5)
