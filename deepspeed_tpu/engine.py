@@ -902,7 +902,8 @@ class TrainingEngine:
         return _load(self, load_dir, tag=tag)
 
 
-def initialize(args=None, *, loss_fn: Callable, params: Any,
+def initialize(args=None, *, loss_fn: Optional[Callable] = None,
+               params: Any = None,
                config: Any = None, mesh: Optional[MeshSpec] = None,
                optimizer: Optional[Optimizer] = None,
                lr_scheduler=None, param_specs: "zero.SpecTree" = None,
@@ -927,6 +928,36 @@ def initialize(args=None, *, loss_fn: Callable, params: Any,
     # backend without pinned_host memory) needs host-scheduled state
     # streaming — IO cannot live inside the jitted step (ref:
     # deepspeed/runtime/swap_tensor/partitioned_optimizer_swapper.py).
+    # ZeRO-Infinity PARAMETER offload: a scheduled offload_param tier
+    # streams bf16 params layer-by-layer around fwd+bwd, so the compute
+    # copy never fully resides in HBM (ref: partitioned_param_swapper.py).
+    # Requires the layered-model factoring (params = LayeredModel).
+    from deepspeed_tpu.param_stream import LayeredModel, ParamStreamEngine
+
+    poff = config.zero.offload_param or {}
+    poff_dev = poff.get("device", "none")
+    if isinstance(params, LayeredModel) or (
+            poff_dev == "nvme" or (poff_dev == "cpu"
+                                   and poff.get("scheduled"))):
+        if not isinstance(params, LayeredModel):
+            raise ValueError(
+                "scheduled parameter offload streams per-layer programs "
+                "and needs the model factored for it: pass params="
+                "<model>.layered_model(cfg, params) (llama provides one); "
+                "plain pytrees only support the memory-kind offload path")
+        if optimizer is not None or param_specs is not None or has_aux:
+            raise ValueError(
+                "the param-stream engine drives its own CPU-Adam and "
+                "parameter layout; configure the optimizer via the config "
+                "block and drop param_specs/has_aux")
+        engine = ParamStreamEngine(params, config, mesh=mesh,
+                                   lr_scheduler=lr_scheduler)
+        return _finish_initialize(engine, config, training_data)
+
+    if loss_fn is None or params is None:
+        raise ValueError("initialize() needs loss_fn and params (a "
+                         "LayeredModel params carries its own loss)")
+
     off = config.zero.offload_optimizer or {}
     off_dev = off.get("device", "none")
     if off_dev == "nvme" or (off_dev == "cpu" and off.get("scheduled")):
@@ -953,6 +984,12 @@ def initialize(args=None, *, loss_fn: Callable, params: Any,
         engine = TrainingEngine(loss_fn, params, config, mesh=mesh,
                                 optimizer=optimizer, lr_scheduler=lr_scheduler,
                                 param_specs=param_specs, has_aux=has_aux)
+    return _finish_initialize(engine, config, training_data)
+
+
+def _finish_initialize(engine, config, training_data):
+    """Shared initialize() tail: build the dataloader (every engine path
+    must honor ``training_data``) and return the 4-tuple."""
     dataloader = None
     if training_data is not None:
         from deepspeed_tpu.data.loader import DataLoader

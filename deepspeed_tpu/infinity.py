@@ -401,6 +401,13 @@ class InfinityEngine:
         self.skipped_steps = 0
         self._last_metrics: Dict[str, Any] = {}
         self.step_times: List[float] = []
+        # per-phase wall-clock of the LAST step (see phase_report):
+        # the viability breakdown the 406 s/step question needs
+        self.phase_times: Dict[str, float] = {}
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._d2h_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="dstpu-infinity-d2h")
         logger.info(
             "InfinityEngine: tier=%s dp=%d local_rows=%d groups=%d "
             "(%s elems) params=%d",
@@ -442,6 +449,27 @@ class InfinityEngine:
         return rows.reshape(-1)[:self._sizes[i]].reshape(self._shapes[i])
 
     # ------------------------------------------------------------------ step
+    def _phase_reset(self) -> Dict[str, float]:
+        """Zeroed per-phase timing dict for the step about to run."""
+        self.phase_times = {
+            "grad_program": 0.0, "tier_read_wait": 0.0,
+            "grad_d2h_wait": 0.0, "state_h2d": 0.0, "update_submit": 0.0,
+            "host_adam": 0.0, "state_d2h": 0.0, "tier_write": 0.0,
+            "param_h2d_submit": 0.0, "total": 0.0}
+        return self.phase_times
+
+    def phase_report(self) -> Dict[str, float]:
+        """Per-phase seconds of the last step.  Host mode: grad_program
+        (jit fwd+bwd to the finite-check sync), tier_read_wait (aio read
+        fence), grad_d2h_wait (stall on the prefetch thread's
+        device→host grad copy), host_adam (fused CPU kernel),
+        tier_write (aio submit + fences), param_h2d_submit (async upload
+        dispatch).  Device mode: state_h2d (tier rows → device),
+        update_submit (async jit dispatch), state_d2h (new state →
+        host, absorbs the update's execution), tier_write.  Phases
+        overlap by design, so the parts can sum past 'total'."""
+        return dict(self.phase_times)
+
     def _submit_group_read(self, k: int):
         """Begin fetching group k's (master, mu, nu) rows from the tier."""
         bufs = []
@@ -473,45 +501,48 @@ class InfinityEngine:
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
 
-    def _host_adam_group(self, g, m, v, p, lr, t):
-        """In-place numpy Adam on one leaf's local rows (f32), mirroring
-        ops/optim.adam exactly (ref: DeepSpeedCPUAdam — the reference's
-        offload optimizer updates on the HOST so only bf16 params/grads
-        ever cross the host↔device link, which is the whole viability
-        argument for offload on a thin link)."""
+    def _host_adam_group(self, g, m, v, p, lr, t, emit_bf16=False):
+        """Fused C++ CPU-Adam on one leaf's local rows, in place (ref:
+        DeepSpeedCPUAdam, deepspeed/ops/adam/cpu_adam.cpp — the
+        reference's offload optimizer updates on the HOST with a native
+        threaded kernel so only bf16 params/grads ever cross the
+        host↔device link).  One memory pass over the 16 B/param of state
+        instead of numpy's ~10; optionally emits the bf16 compute image
+        in the same pass.  Returns (p, m, v, bf16_or_None)."""
+        from deepspeed_tpu.ops.cpu_adam import cpu_adam_step
+
         b1, b2 = self._hyp["betas"]
-        eps, wd = self._hyp["eps"], self._hyp["wd"]
-        if wd and not self._hyp["adamw"]:
-            g = g + wd * p
-        m *= b1
-        m += (1.0 - b1) * g
-        v *= b2
-        v += (1.0 - b2) * (g * g)
-        if self._hyp["bias_correction"]:
-            c1 = 1.0 - b1 ** t
-            c2 = 1.0 - b2 ** t
-        else:
-            c1 = c2 = 1.0
-        u = (m / c1) / (np.sqrt(v / c2) + eps)
-        if wd and self._hyp["adamw"]:
-            u = u + wd * p
-        p -= lr * u
-        return p, m, v
+        out = cpu_adam_step(
+            p, m, v, g, lr=lr, b1=b1, b2=b2, eps=self._hyp["eps"],
+            wd=self._hyp["wd"], adamw=self._hyp["adamw"], t=t,
+            bias_correction=self._hyp["bias_correction"],
+            emit_bf16=emit_bf16)
+        return p, m, v, out
 
     def _train_batch_host(self, batch, t0: float) -> jnp.ndarray:
         """CPU-Adam step: grads come DOWN in the grad dtype, fresh
         compute params go UP in the compute dtype; the f32 state never
         transits the device (2+2 bytes/param on the link vs 12+12 for
-        the device-update path)."""
+        the device-update path).
+
+        Pipeline per leaf: while leaf i runs its fused host update, leaf
+        i+1's gradient is already crossing device→host on the prefetch
+        thread, the NEXT group's tier reads are in flight in the aio
+        pool, and leaf i-1's state writes are draining — so the step
+        time tends to max(link, NVMe, adam) instead of their sum."""
         nvme = isinstance(self.tier, _NvmeTier)
         # ml_dtypes registers bf16/f8 with numpy, so this maps ANY
         # configured compute dtype (bf16/f16/f32) to its host twin —
         # the uploaded rows must already be in compute dtype so only
         # 2 bytes/param cross the link
         cdt_np = np.dtype(self._compute_dtype)
+        emit_bf16 = cdt_np == np.dtype(jnp.bfloat16)
+        ph = self._phase_reset()
         try:
+            t1 = time.perf_counter()
             loss, ok, grads = self._grad_fn(self.params_c, batch)
-            ok_host = bool(ok)
+            ok_host = bool(ok)       # sync: the whole grad program ran
+            ph["grad_program"] += time.perf_counter() - t1
             if not ok_host:
                 # skipped step: params_c were donated — rebuild unchanged.
                 # Drop the grad slab first: restore's replicated allocs
@@ -528,23 +559,52 @@ class InfinityEngine:
             t = self._opt_steps + 1
             lr = float(self.lr_schedule(jnp.int32(t)))
 
+            # start every shard's D2H immediately: the copies stream
+            # while tier reads and earlier leaves' updates proceed
+            for a in grads:
+                a.copy_to_host_async()
+
+            def fetch_grad(i):
+                g = np.asarray(self._rows_to_host(grads[i]), np.float32)
+                grads[i] = None
+                return g
+
+            order = [i for grp in self.groups for i in grp]
+            nxt_pos = 0
+            futures: Dict[int, Any] = {}
+
+            def prefetch_next():
+                nonlocal nxt_pos
+                if nxt_pos < len(order):
+                    i = order[nxt_pos]
+                    futures[i] = self._d2h_pool.submit(fetch_grad, i)
+                    nxt_pos += 1
+
+            prefetch_next()
             pending = self._submit_group_read(0)
             for k, group in enumerate(self.groups):
                 if nvme:
+                    t1 = time.perf_counter()
                     self.tier.fence_reads()
+                    ph["tier_read_wait"] += time.perf_counter() - t1
                     self.tier.next_read_slot()
                 bufs = pending
                 if k + 1 < len(self.groups):
                     pending = self._submit_group_read(k + 1)
                 for j, i in enumerate(group):
-                    g = np.asarray(self._rows_to_host(grads[i]),
-                                   np.float32)            # D2H (grad dtype)
-                    grads[i] = None
+                    t1 = time.perf_counter()
+                    g = futures.pop(i).result()       # D2H (grad dtype)
+                    ph["grad_d2h_wait"] += time.perf_counter() - t1
+                    prefetch_next()   # overlap i+1's D2H with i's update
                     m = np.asarray(bufs[j][1], np.float32)
                     v = np.asarray(bufs[j][2], np.float32)
                     p = np.asarray(bufs[j][0], np.float32)
-                    p, m, v = self._host_adam_group(g, m, v, p, lr, t)
+                    t1 = time.perf_counter()
+                    p, m, v, bf16 = self._host_adam_group(
+                        g, m, v, p, lr, t, emit_bf16=emit_bf16)
+                    ph["host_adam"] += time.perf_counter() - t1
                     n = self._names[i]
+                    t1 = time.perf_counter()
                     if nvme:
                         self.tier.fence_writes()
                     self.tier.put(n, p)
@@ -552,21 +612,29 @@ class InfinityEngine:
                     self.tier.put("v" + n, v)
                     if nvme:
                         self.tier.next_write_slot()
-                    # H2D: compute-dtype rows only; _restore_fns unpads,
-                    # reshapes and (no-op) casts, gathering on-device
-                    rows_c = np.ascontiguousarray(p.astype(cdt_np))
+                    ph["tier_write"] += time.perf_counter() - t1
+                    # H2D: compute-dtype rows only (async dispatch; the
+                    # fused kernel already emitted bf16, other dtypes
+                    # cast here); _restore_fns unpads/reshapes on-device
+                    t1 = time.perf_counter()
+                    rows_c = (bf16.view(cdt_np) if bf16 is not None
+                              else np.ascontiguousarray(p.astype(cdt_np)))
                     self.params_c[i] = self._restore_fns[i](
                         jax.make_array_from_process_local_data(
                             self.state_sharding, rows_c,
                             (self._dp, self._chunks[i])))
+                    ph["param_h2d_submit"] += time.perf_counter() - t1
                 del bufs
             if nvme:
+                t1 = time.perf_counter()
                 self.tier.fence_all()
+                ph["tier_write"] += time.perf_counter() - t1
             self.global_steps += 1
             self._opt_steps += 1
             loss = jnp.asarray(loss)
             self._last_metrics = {"loss": loss, "overflow": jnp.int32(0)}
             self.step_times.append(time.perf_counter() - t0)
+            ph["total"] = self.step_times[-1]
             return loss
         except BaseException:
             loss = ok = grads = None
@@ -578,35 +646,44 @@ class InfinityEngine:
         if self.update_mode == "host":
             return self._train_batch_host(batch, t0)
         nvme = isinstance(self.tier, _NvmeTier)
+        ph = self._phase_reset()
         try:
+            t1 = time.perf_counter()
             loss, ok, grads = self._grad_fn(self.params_c, batch)
             # fence the grad program before streaming state through HBM:
             # its transient peak (activations + grad tree) must not
             # coexist with the first groups' device_puts, or a model
             # sized to the streaming budget OOMs on the overlap
             ok_host = bool(ok)
+            ph["grad_program"] += time.perf_counter() - t1
             step = jnp.int32(self._opt_steps)
             pending = self._submit_group_read(0)
             for k, group in enumerate(self.groups):
                 if nvme:
+                    t1 = time.perf_counter()
                     self.tier.fence_reads()  # group k's buffers are ready
+                    ph["tier_read_wait"] += time.perf_counter() - t1
                     self.tier.next_read_slot()
                 bufs = pending
                 if k + 1 < len(self.groups):
                     pending = self._submit_group_read(k + 1)  # overlap read
+                t1 = time.perf_counter()
                 master = [self._rows_to_device(b[0], i)
                           for b, i in zip(bufs, group)]
                 mu = [self._rows_to_device(b[1], i)
                       for b, i in zip(bufs, group)]
                 nu = [self._rows_to_device(b[2], i)
                       for b, i in zip(bufs, group)]
+                ph["state_h2d"] += time.perf_counter() - t1
                 g_k = [grads[i] for i in group]
                 for i in group:
                     grads[i] = None   # free each shard as it's consumed:
                     # holding all groups' grads through the loop adds a
                     # full grad-size slab to peak HBM (1.4B demo OOM)
+                t1 = time.perf_counter()
                 new_master, new_mu, new_nu, compute = self._update_fns[k](
                     master, mu, nu, g_k, step, ok)
+                ph["update_submit"] += time.perf_counter() - t1
                 del g_k, bufs
                 for j, i in enumerate(group):
                     self.params_c[i] = compute[j]
@@ -615,18 +692,31 @@ class InfinityEngine:
                     for x in t:
                         x.copy_to_host_async()
                 if nvme:
+                    t1 = time.perf_counter()
                     # reuse of this write slot two groups on: fence it
                     self.tier.fence_writes()
+                    ph["tier_write"] += time.perf_counter() - t1
+                t1 = time.perf_counter()
+                hosted = [(self._rows_to_host(new_master[j]),
+                           self._rows_to_host(new_mu[j]),
+                           self._rows_to_host(new_nu[j]))
+                          for j in range(len(group))]
+                ph["state_d2h"] += time.perf_counter() - t1
+                t1 = time.perf_counter()
                 for j, i in enumerate(group):
                     n = self._names[i]
-                    self.tier.put(n, self._rows_to_host(new_master[j]))
-                    self.tier.put("m" + n, self._rows_to_host(new_mu[j]))
-                    self.tier.put("v" + n, self._rows_to_host(new_nu[j]))
+                    self.tier.put(n, hosted[j][0])
+                    self.tier.put("m" + n, hosted[j][1])
+                    self.tier.put("v" + n, hosted[j][2])
+                del hosted
                 if nvme:
                     self.tier.next_write_slot()
+                ph["tier_write"] += time.perf_counter() - t1
 
             if nvme:
+                t1 = time.perf_counter()
                 self.tier.fence_all()  # read-after-write for next step
+                ph["tier_write"] += time.perf_counter() - t1
         except BaseException:
             # params_c were donated to _grad_fn; rebuild them so the
             # engine stays usable after a caught IO error or an
@@ -651,6 +741,7 @@ class InfinityEngine:
         self._last_metrics = {"loss": loss,
                               "overflow": jnp.int32(not ok_host)}
         self.step_times.append(time.perf_counter() - t0)
+        ph["total"] = self.step_times[-1]
         return loss
 
     # ----------------------------------------------------------- inspection

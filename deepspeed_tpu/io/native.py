@@ -39,10 +39,14 @@ def _ensure_lib() -> Optional[ctypes.CDLL]:
                 os.path.exists(_SRC)
                 and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
             try:
+                # temp + atomic rename: concurrent builders racing the
+                # same -o target can CDLL a half-written .so
+                tmp = f"{_LIB}.{os.getpid()}.tmp"
                 subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC,
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC,
                      "-lpthread"],
                     check=True, capture_output=True)
+                os.replace(tmp, _LIB)
             except (subprocess.CalledProcessError, FileNotFoundError):
                 return None
         try:

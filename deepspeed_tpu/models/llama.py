@@ -473,6 +473,45 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
     return logits, cache
 
 
+def layered_model(cfg: LlamaConfig, params):
+    """Factor a llama param tree for the layer-streaming engine (ref:
+    ZeRO-Infinity parameter offload, partitioned_param_swapper.py): stem
+    = embedding, block = one transformer layer, head = final norm + LM
+    head with the chunked fused loss.  See param_stream.LayeredModel."""
+    from deepspeed_tpu.param_stream import LayeredModel
+
+    if cfg.tie_embeddings:
+        raise NotImplementedError(
+            "layered streaming with tied embeddings would need the embed "
+            "grad summed across stem and head — untie for now")
+
+    def stem_fn(sp, batch):
+        return sp["embed"][batch["tokens"][:, :-1]]
+
+    def block_fn(lp, x):
+        T = x.shape[1]
+        cos, sin = rope_tables(cfg, jnp.arange(T, dtype=jnp.int32))
+        return _block(cfg, x, lp, cos, sin, None)
+
+    def head_fn(hp, x, batch):
+        from deepspeed_tpu.ops.losses import chunked_lm_loss
+
+        tokens = batch["tokens"]
+        mask = batch.get("loss_mask")
+        if mask is not None:
+            mask = mask[:, 1:].astype(jnp.float32)
+        x = rms_norm(x, hp["final_norm"], cfg.norm_eps)
+        return chunked_lm_loss(x, hp["lm_head"], tokens[:, 1:], mask=mask,
+                               chunk=cfg.loss_chunk or cfg.vocab_size)
+
+    return LayeredModel(
+        stem_fn=stem_fn, block_fn=block_fn, head_fn=head_fn,
+        stem={"embed": params["embed"]}, blocks=params["blocks"],
+        head={"final_norm": params["final_norm"],
+              "lm_head": params["lm_head"]},
+        n_layers=cfg.n_layers)
+
+
 def loss_fn(cfg: LlamaConfig, n_micro: Optional[int] = None):
     """Causal-LM next-token cross entropy; batch = {tokens, (loss_mask)}.
 

@@ -1,0 +1,592 @@
+"""ZeRO-Infinity parameter offload: layer-streamed training where the
+bf16 compute params NEVER fully reside in HBM.
+
+Reference: deepspeed/runtime/swap_tensor/partitioned_param_swapper.py
+(AsyncPartitionedParameterSwapper) + zero/stage3.py's parameter
+prefetch/release around each submodule — the other half of
+ZeRO-Infinity, which is what makes 100B+ models fit: params as well as
+optimizer state swap between NVMe/host and the accelerator, with a
+working set of O(layers-in-flight), not O(model).
+
+TPU design.  The reference hooks torch submodule pre/post-forward to
+fault params in and release them.  Under XLA there are no hooks inside a
+compiled program, so the schedule is HOST-side and the programs are
+per-LAYER jits (compiled once each, reused for every layer — all layers
+share shapes):
+
+    stem:      (stem_params, batch) -> x0            [embed; resident]
+    block:     (layer_params, x) -> x                [one transformer layer]
+    head_grad: (head_params, xL, batch) -> loss, dhead, dxL
+    block_vjp: (layer_params, x_in, dy) -> (dlayer_grads, dx)
+    stem_vjp:  (stem_params, batch, dx0) -> dstem
+
+Forward streams layer k+1's bf16 params host→device (aio read + async
+device_put) while layer k computes; the backward streams them again in
+reverse order (params transit the link twice per step — same as the
+reference's swap-in for backward).  Layer-boundary activations are kept
+in HBM (one [B, T, d] per layer — the layer-granular remat the reference
+gets from activation checkpointing).  Peak param HBM = 2 layers (the
+double buffer), so the trainable size is bounded by host/NVMe capacity
+and step time by link bandwidth — not by the 2N bf16 residency that caps
+:class:`~deepspeed_tpu.infinity.InfinityEngine` at ~HBM/2.
+
+Gradients land in pinned host f32 buffers as the backward drains them
+(device→host overlaps the next layer's vjp), the whole-step finite check
+runs on the host, and only then does the fused C++ CPU-Adam
+(ops/cpu_adam.py) update each layer's f32 master+moments on the tier and
+emit the fresh bf16 image — so a nonfinite anywhere skips the WHOLE step
+(reference overflow semantics), at the cost of holding one f32 grad copy
+in host RAM.
+
+Single-controller only for now (every device addressable from this
+process); the [dp, chunk] cross-host row partition of the optimizer-only
+engine does not apply because host updates here are whole-leaf.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu import lr_schedules, precision
+from deepspeed_tpu.config import Config
+from deepspeed_tpu.infinity import _NvmeTier, _RamTier, _Tier
+from deepspeed_tpu.ops.optim import default_lr
+from deepspeed_tpu.topology import MeshSpec
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclasses.dataclass
+class LayeredModel:
+    """A model factored for layer streaming.
+
+    ``stem_fn(stem_params, batch) -> x`` (embedding / input projection),
+    ``block_fn(layer_params, x) -> x`` (ONE layer; all layers share
+    shapes), ``head_fn(head_params, x, batch) -> scalar f32 loss``.
+    ``blocks`` is the stacked [L, ...] pytree; stem/head stay resident.
+    Models provide builders (e.g. ``models.llama.layered_model``).
+    """
+    stem_fn: Callable
+    block_fn: Callable
+    head_fn: Callable
+    stem: Any
+    blocks: Any
+    head: Any
+    n_layers: int
+
+
+class ParamStreamEngine:
+    """Host-scheduled layer-streaming engine (params + optimizer state
+    offloaded; HBM holds a 2-layer param working set + activations)."""
+
+    def __init__(self, layered: LayeredModel, config: Config,
+                 mesh: Optional[MeshSpec] = None, lr_scheduler=None):
+        self.config = config
+        self.mesh = mesh or MeshSpec.build(
+            config.mesh.axis_sizes(jax.device_count()))
+        config.resolve_batch_sizes(self.mesh.size("data"))
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "param-stream engine: multi-host layer streaming needs "
+                "per-process row IO, not implemented yet")
+        self.layered = layered
+        self.L = layered.n_layers
+        self._last_grad_norm: Optional[float] = None
+        if config.curriculum is not None and config.curriculum.enabled:
+            raise ValueError(
+                "curriculum_learning does not compose with the "
+                "param-stream engine yet — it would be a silent no-op")
+
+        off = dict(config.zero.offload_param or {})
+        opt_off = config.zero.offload_optimizer or {}
+        self.device_tier = off.get("device", "cpu")
+        if self.device_tier == "nvme":
+            self.tier: _Tier = _NvmeTier(os.path.join(
+                off.get("nvme_path", "/tmp/dstpu_nvme_swap"), "pstream"))
+        else:
+            self.tier = _RamTier()
+
+        opt_type = config.optimizer.type.lower()
+        if opt_type not in ("adam", "adamw", "fusedadam"):
+            raise ValueError(
+                f"param-stream engine supports the Adam family (the "
+                f"reference's swappable optimizer is CPU-Adam), got "
+                f"{opt_type!r}")
+        oparams = dict(config.optimizer.params)
+        opt_lr = float(oparams.pop("lr", default_lr(opt_type)))
+        self.lr_schedule = (
+            lr_scheduler if callable(lr_scheduler)
+            else lr_schedules.from_config(config.scheduler.type,
+                                          config.scheduler.params,
+                                          fallback_lr=opt_lr))
+        oparams.pop("torch_adam", None)
+        self._hyp = {
+            "betas": tuple(oparams.get("betas", (0.9, 0.999))),
+            "eps": float(oparams.get("eps", 1e-8)),
+            "wd": float(oparams.get("weight_decay", 0.0)),
+            "adamw": bool(oparams.pop("adam_w_mode", True)),
+            "bias_correction": bool(oparams.get("bias_correction", True)),
+        }
+        self.optimizer = None          # the engine IS the optimizer here
+
+        self._compute_dtype = precision.compute_dtype(config.precision)
+        if self._compute_dtype != jnp.bfloat16:
+            raise NotImplementedError(
+                "param-stream engine streams bf16 compute images (the "
+                "fused CPU-Adam emits bf16); set bf16.enabled")
+        self._cdt_np = np.dtype(jnp.bfloat16)
+
+        # ---- block leaves: per-layer files on the tier
+        leaves, self._btree = jax.tree_util.tree_flatten(layered.blocks)
+        self._bshapes = [tuple(a.shape[1:]) for a in leaves]   # per-layer
+        self._bsizes = [int(np.prod(s)) for s in self._bshapes]
+        self._bnames = [f"b{i}" for i in range(len(leaves))]
+        for l in range(self.L):
+            for nm, leaf in zip(self._bnames, leaves):
+                # np.array: force copies — asarray views of jax CPU
+                # buffers must never land on the (mutating) tier
+                a = np.array(leaf[l])
+                self.tier.put(f"p_{l}_{nm}", a.astype(self._cdt_np)
+                              if a.dtype != self._cdt_np else a)
+                f32 = np.ascontiguousarray(
+                    a.astype(np.float32, copy=True)).reshape(-1)
+                self.tier.put(f"w_{l}_{nm}", f32)               # f32 master
+                self.tier.put(f"m_{l}_{nm}", np.zeros_like(f32))
+                self.tier.put(f"v_{l}_{nm}", np.zeros_like(f32))
+        if isinstance(self.tier, _NvmeTier):
+            self.tier.fence_all()
+        del leaves
+
+        # ---- stem/head: resident compute copies + host f32 state
+        repl = self.mesh.replicated()
+        self._repl = repl
+
+        def host_state(tree):
+            flat, td = jax.tree_util.tree_flatten(tree)
+            # np.array, not np.asarray: on the CPU backend asarray gives a
+            # ZERO-COPY view of the jax buffer, and the in-place CPU-Adam
+            # would then silently mutate the caller's param tree
+            st = [{"w": np.array(a, np.float32).reshape(-1),
+                   "m": np.zeros(a.size, np.float32),
+                   "v": np.zeros(a.size, np.float32),
+                   "shape": tuple(a.shape)} for a in flat]
+            return st, td
+
+        self._stem_state, self._stem_td = host_state(layered.stem)
+        self._head_state, self._head_td = host_state(layered.head)
+        self.stem_c = jax.device_put(jax.tree.map(
+            lambda a: jnp.asarray(a, self._cdt_np), layered.stem), repl)
+        self.head_c = jax.device_put(jax.tree.map(
+            lambda a: jnp.asarray(a, self._cdt_np), layered.head), repl)
+
+        self.batch_sharding = self.mesh.sharding(self.mesh.batch_spec())
+        self._jits_built = False
+
+        self.global_steps = 0
+        self._opt_steps = 0
+        self.skipped_steps = 0
+        self.step_times: List[float] = []
+        self.phase_times: Dict[str, float] = {}
+        self._last_metrics: Dict[str, Any] = {}
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._d2h_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="dstpu-pstream-d2h")
+        logger.info(
+            "ParamStreamEngine: tier=%s layers=%d block-leaves=%d "
+            "per-layer=%d params (%.1f MB bf16), stem+head resident",
+            self.device_tier, self.L, len(self._bnames),
+            sum(self._bsizes), 2 * sum(self._bsizes) / 1e6)
+
+    # ------------------------------------------------------------- programs
+    def _build_jits(self):
+        lm = self.layered
+        bs = self.batch_sharding
+
+        self._stem_jit = jax.jit(lm.stem_fn,
+                                 in_shardings=(self._repl, bs))
+
+        # donate lp: the uploaded double-buffer entry is dead after its
+        # single use (re-uploaded for the backward pass)
+        self._block_jit = jax.jit(lm.block_fn, donate_argnums=(0,))
+
+        def head_grad(hp, x, batch):
+            (loss, _), (dh, dx) = jax.value_and_grad(
+                lambda h, xx: (lm.head_fn(h, xx, batch)
+                               .astype(jnp.float32),) * 2,
+                argnums=(0, 1), has_aux=True)(hp, x)
+            return loss, dh, dx
+
+        self._head_grad_jit = jax.jit(
+            head_grad, out_shardings=(None, self._repl, None))
+
+        def block_vjp(lp, x_in, dy):
+            _, pull = jax.vjp(lm.block_fn, lp, x_in)
+            dlp, dx = pull(dy)
+            return dlp, dx
+
+        # donate dy → dx reuses its buffer; lp dead after the pull
+        self._block_vjp_jit = jax.jit(
+            block_vjp, donate_argnums=(0, 2),
+            out_shardings=(self._repl, None))
+
+        def stem_vjp(sp, batch, dx):
+            _, pull = jax.vjp(lambda s: lm.stem_fn(s, batch), sp)
+            return pull(dx)[0]
+
+        # no donation: dstem ([V, d]) shares no shape with dx ([B, T, d])
+        self._stem_vjp_jit = jax.jit(stem_vjp, out_shardings=self._repl)
+        self._jits_built = True
+
+    # ------------------------------------------------------------ streaming
+    def _submit_layer_read(self, l: int):
+        bufs = [self.tier.get_submit(f"p_{l}_{nm}",
+                                     (sz,), self._cdt_np)
+                for nm, sz in zip(self._bnames, self._bsizes)]
+        return bufs
+
+    def _bufs_to_device(self, bufs):
+        flat = [jax.device_put(
+            jnp.asarray(b).reshape(s), self._repl)
+            for b, s in zip(bufs, self._bshapes)]
+        return jax.tree_util.tree_unflatten(self._btree, flat)
+
+    def _phase_reset(self):
+        self.phase_times = {
+            "fwd_compute": 0.0, "bwd_compute": 0.0, "param_read_wait": 0.0,
+            "grad_d2h_wait": 0.0, "host_adam": 0.0, "tier_write": 0.0,
+            "total": 0.0}
+        return self.phase_times
+
+    def phase_report(self) -> Dict[str, float]:
+        """Per-phase seconds of the last step (phases overlap by design:
+        param reads and grad D2H run behind the layer computes)."""
+        return dict(self.phase_times)
+
+    # ------------------------------------------------------------------ step
+    def train_batch(self, batch) -> jnp.ndarray:
+        t0 = time.perf_counter()
+        if not self._jits_built:
+            self._build_jits()
+        ph = self._phase_reset()
+        nvme = isinstance(self.tier, _NvmeTier)
+        accum = self.config.gradient_accumulation_steps
+        if accum > 1:
+            from deepspeed_tpu.engine import accum_split
+
+            micros = accum_split(batch, accum, self.mesh.size("data"))
+            micros = [jax.tree.map(lambda x, _i=i: x[_i], micros)
+                      for i in range(accum)]
+        else:
+            micros = [batch]
+
+        # host f32 grad accumulators, one per block leaf per layer
+        gbuf: List[Optional[List[np.ndarray]]] = [None] * self.L
+        gstem = ghead = None
+        loss_sum = 0.0
+
+        for mb in micros:
+            mb = jax.device_put(mb, self.batch_sharding)
+            # ---------------- forward: stream layers up
+            t1 = time.perf_counter()
+            x = self._stem_jit(self.stem_c, mb)
+            xs: List[Any] = []
+            pending = self._submit_layer_read(0)
+            for l in range(self.L):
+                if nvme:
+                    tr = time.perf_counter()
+                    self.tier.fence_reads()
+                    ph["param_read_wait"] += time.perf_counter() - tr
+                    self.tier.next_read_slot()
+                lp = self._bufs_to_device(pending)
+                if l + 1 < self.L:
+                    pending = self._submit_layer_read(l + 1)
+                xs.append(x)
+                x = self._block_jit(lp, x)
+            ph["fwd_compute"] += time.perf_counter() - t1
+
+            # ---------------- head
+            t1 = time.perf_counter()
+            loss, dhead, dx = self._head_grad_jit(self.head_c, x, mb)
+            loss_sum += float(loss)              # sync: fwd+head done
+            ph["bwd_compute"] += time.perf_counter() - t1
+
+            def fetch(tree_or_list):
+                return [np.asarray(a, np.float32).reshape(-1)
+                        for a in jax.tree.leaves(tree_or_list)]
+
+            hfut = self._d2h_pool.submit(fetch, dhead)
+
+            # ---------------- backward: stream layers down
+            t1 = time.perf_counter()
+            pending = self._submit_layer_read(self.L - 1)
+            dfut = None
+            for l in range(self.L - 1, -1, -1):
+                if nvme:
+                    tr = time.perf_counter()
+                    self.tier.fence_reads()
+                    ph["param_read_wait"] += time.perf_counter() - tr
+                    self.tier.next_read_slot()
+                lp = self._bufs_to_device(pending)
+                if l - 1 >= 0:
+                    pending = self._submit_layer_read(l - 1)
+                dlp, dx = self._block_vjp_jit(lp, xs[l], dx)
+                xs[l] = None
+                # drain the PREVIOUS layer's grads while this one computes
+                if dfut is not None:
+                    lprev, fut = dfut
+                    tw = time.perf_counter()
+                    self._accum_layer(gbuf, lprev, fut.result())
+                    ph["grad_d2h_wait"] += time.perf_counter() - tw
+                dfut = (l, self._d2h_pool.submit(fetch, dlp))
+            lprev, fut = dfut
+            self._accum_layer(gbuf, lprev, fut.result())
+            ds = self._stem_vjp_jit(self.stem_c, mb, dx)
+            sflat = fetch(ds)
+            gstem = sflat if gstem is None else [
+                a + b for a, b in zip(gstem, sflat)]
+            hflat = hfut.result()
+            ghead = hflat if ghead is None else [
+                a + b for a, b in zip(ghead, hflat)]
+            ph["bwd_compute"] += time.perf_counter() - t1
+
+        inv = 1.0 / accum
+        loss = loss_sum * inv
+
+        # ---------------- whole-step finite consensus, then update
+        finite = math.isfinite(loss) and all(
+            np.isfinite(g).all()
+            for gs in ([gstem, ghead] + [g for g in gbuf if g])
+            for g in gs)
+        if not finite:
+            self.global_steps += 1
+            self.skipped_steps += 1
+            self._last_metrics = {"loss": jnp.float32(loss),
+                                  "overflow": jnp.int32(1)}
+            self.step_times.append(time.perf_counter() - t0)
+            ph["total"] = self.step_times[-1]
+            return jnp.float32(loss)
+
+        t = self._opt_steps + 1
+        lr = float(self.lr_schedule(jnp.int32(t)))
+        clip = self.config.gradient_clipping
+        if clip and clip > 0:
+            # same semantics as engine.clip_by_global_norm, on the host
+            # copies: the clipped quantity is the MEAN grad (hence inv²)
+            ssq = sum(float(np.vdot(g, g))
+                      for gs in ([gstem, ghead] + [g for g in gbuf if g])
+                      for g in gs)
+            norm = math.sqrt(ssq) * inv
+            inv = inv * min(1.0, clip / (norm + 1e-6))
+            self._last_grad_norm = norm
+        self._update_blocks(gbuf, lr, t, inv, ph, nvme)
+        self._update_resident(self._stem_state, gstem, "stem", lr, t, inv,
+                              ph)
+        self._update_resident(self._head_state, ghead, "head", lr, t, inv,
+                              ph)
+        if nvme:
+            t1 = time.perf_counter()
+            self.tier.fence_all()
+            ph["tier_write"] += time.perf_counter() - t1
+
+        self.global_steps += 1
+        self._opt_steps += 1
+        self._last_metrics = {"loss": jnp.float32(loss),
+                              "overflow": jnp.int32(0)}
+        self.step_times.append(time.perf_counter() - t0)
+        ph["total"] = self.step_times[-1]
+        return jnp.float32(loss)
+
+    # ------------------------------------------------------------- updates
+    def _accum_layer(self, gbuf, l: int, flat: List[np.ndarray]) -> None:
+        if gbuf[l] is None:
+            gbuf[l] = flat
+        else:
+            for a, b in zip(gbuf[l], flat):
+                a += b
+
+    def _adam_inplace(self, w, m, v, g, lr, t, emit_bf16):
+        from deepspeed_tpu.ops.cpu_adam import cpu_adam_step
+
+        b1, b2 = self._hyp["betas"]
+        return cpu_adam_step(
+            w, m, v, g, lr=lr, b1=b1, b2=b2, eps=self._hyp["eps"],
+            wd=self._hyp["wd"], adamw=self._hyp["adamw"], t=t,
+            bias_correction=self._hyp["bias_correction"],
+            emit_bf16=emit_bf16)
+
+    def _update_blocks(self, gbuf, lr, t, inv, ph, nvme) -> None:
+        """Fused CPU-Adam per layer leaf; fresh bf16 image to the tier.
+        Tier state reads are double-buffered ahead of the update."""
+        def read_layer(l):
+            return [(self.tier.get_submit(f"w_{l}_{nm}", (sz,), np.float32),
+                     self.tier.get_submit(f"m_{l}_{nm}", (sz,), np.float32),
+                     self.tier.get_submit(f"v_{l}_{nm}", (sz,), np.float32))
+                    for nm, sz in zip(self._bnames, self._bsizes)]
+
+        pending = read_layer(0)
+        for l in range(self.L):
+            if nvme:
+                t1 = time.perf_counter()
+                self.tier.fence_reads()
+                ph["param_read_wait"] += time.perf_counter() - t1
+                self.tier.next_read_slot()
+            bufs = pending
+            if l + 1 < self.L:
+                pending = read_layer(l + 1)
+            for (w, m, v), g, nm in zip(bufs, gbuf[l], self._bnames):
+                if inv != 1.0:
+                    g *= inv
+                t1 = time.perf_counter()
+                w = np.asarray(w, np.float32)
+                m = np.asarray(m, np.float32)
+                v = np.asarray(v, np.float32)
+                bf16 = self._adam_inplace(w, m, v, g, lr, t, True)
+                ph["host_adam"] += time.perf_counter() - t1
+                t1 = time.perf_counter()
+                if nvme:
+                    self.tier.fence_writes()
+                self.tier.put(f"w_{l}_{nm}", w)
+                self.tier.put(f"m_{l}_{nm}", m)
+                self.tier.put(f"v_{l}_{nm}", v)
+                self.tier.put(f"p_{l}_{nm}", bf16.view(self._cdt_np))
+                if nvme:
+                    self.tier.next_write_slot()
+                ph["tier_write"] += time.perf_counter() - t1
+            gbuf[l] = None
+
+    def _update_resident(self, state, grads, which, lr, t, inv, ph) -> None:
+        """Stem/head update: host adam + fresh resident compute copy."""
+        t1 = time.perf_counter()
+        fresh = []
+        for st, g in zip(state, grads):
+            if inv != 1.0:
+                g *= inv
+            bf16 = self._adam_inplace(st["w"], st["m"], st["v"], g, lr, t,
+                                      True)
+            fresh.append(jnp.asarray(bf16.view(self._cdt_np)
+                                     .reshape(st["shape"])))
+        ph["host_adam"] += time.perf_counter() - t1
+        td = self._stem_td if which == "stem" else self._head_td
+        tree = jax.device_put(
+            jax.tree_util.tree_unflatten(td, fresh), self._repl)
+        if which == "stem":
+            self.stem_c = tree
+        else:
+            self.head_c = tree
+
+    # ----------------------------------------------------------- inspection
+    @property
+    def metrics(self):
+        return self._last_metrics
+
+    def get_lr(self):
+        return [float(self.lr_schedule(jnp.int32(self._opt_steps)))]
+
+    def get_global_grad_norm(self):
+        """Pre-clip global norm of the last applied mean grad (None until
+        a clipped step has run — norm is only computed when clipping)."""
+        return self._last_grad_norm
+
+    @property
+    def train_batch_size(self):
+        return self.config.train_batch_size
+
+    def hbm_param_working_set_bytes(self) -> int:
+        """Peak bf16 PARAM bytes resident during a step: the 2-layer
+        double buffer + stem/head — the streaming contract (compare:
+        2N for any engine that keeps the full compute copy)."""
+        per_layer = 2 * sum(self._bsizes)
+        stem_head = sum(x.nbytes for x in jax.tree.leaves(self.stem_c)) + \
+            sum(x.nbytes for x in jax.tree.leaves(self.head_c))
+        return 2 * per_layer + stem_head
+
+    def total_param_count(self) -> int:
+        n = self.L * sum(self._bsizes)
+        n += sum(int(np.prod(s["shape"])) for s in self._stem_state)
+        n += sum(int(np.prod(s["shape"])) for s in self._head_state)
+        return n
+
+    # ---------------------------------------------------------- checkpoint
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[dict] = None,
+                        async_save: bool = False):
+        import json
+
+        tag = tag or f"global_step{self.global_steps}"
+        d = os.path.join(save_dir, tag)
+        os.makedirs(d, exist_ok=True)
+        arrays = {}
+        for l in range(self.L):
+            for nm, sz in zip(self._bnames, self._bsizes):
+                for kind in ("w", "m", "v"):
+                    buf = self.tier.get_submit(
+                        f"{kind}_{l}_{nm}", (sz,), np.float32)
+                    self.tier.fence_reads()
+                    arrays[f"{kind}_{l}_{nm}"] = np.array(buf)
+        for pre, st in (("stem", self._stem_state),
+                        ("head", self._head_state)):
+            for i, s in enumerate(st):
+                for kind in ("w", "m", "v"):
+                    arrays[f"{pre}{kind}_{i}"] = s[kind]
+        if isinstance(self.tier, _NvmeTier):
+            self.tier.fence_all()
+        np.savez(os.path.join(d, "pstream_state.npz"), **arrays)
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump({"global_steps": self.global_steps,
+                       "opt_steps": self._opt_steps,
+                       "skipped_steps": self.skipped_steps,
+                       "client_state": client_state or {}}, f)
+        return d
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
+        import json
+
+        from deepspeed_tpu.ops.cpu_adam import f32_to_bf16
+
+        if tag is None:
+            tags = sorted(t for t in os.listdir(load_dir)
+                          if os.path.isdir(os.path.join(load_dir, t)))
+            if not tags:
+                raise FileNotFoundError(f"no checkpoints under {load_dir}")
+            tag = tags[-1]
+        d = os.path.join(load_dir, tag)
+        arrays = np.load(os.path.join(d, "pstream_state.npz"))
+        for l in range(self.L):
+            for nm in self._bnames:
+                w = np.ascontiguousarray(arrays[f"w_{l}_{nm}"])
+                self.tier.put(f"w_{l}_{nm}", w)
+                self.tier.put(f"m_{l}_{nm}",
+                              np.ascontiguousarray(arrays[f"m_{l}_{nm}"]))
+                self.tier.put(f"v_{l}_{nm}",
+                              np.ascontiguousarray(arrays[f"v_{l}_{nm}"]))
+                self.tier.put(f"p_{l}_{nm}",
+                              f32_to_bf16(w).view(self._cdt_np))
+        fresh = {"stem": [], "head": []}
+        for pre, st in (("stem", self._stem_state),
+                        ("head", self._head_state)):
+            for i, s in enumerate(st):
+                for kind in ("w", "m", "v"):
+                    s[kind][...] = arrays[f"{pre}{kind}_{i}"]
+                fresh[pre].append(jnp.asarray(
+                    f32_to_bf16(s["w"]).view(self._cdt_np)
+                    .reshape(s["shape"])))
+        self.stem_c = jax.device_put(jax.tree_util.tree_unflatten(
+            self._stem_td, fresh["stem"]), self._repl)
+        self.head_c = jax.device_put(jax.tree_util.tree_unflatten(
+            self._head_td, fresh["head"]), self._repl)
+        if isinstance(self.tier, _NvmeTier):
+            self.tier.fence_all()
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+        self.global_steps = meta["global_steps"]
+        self._opt_steps = meta["opt_steps"]
+        self.skipped_steps = meta["skipped_steps"]
+        return d, meta.get("client_state", {})

@@ -141,7 +141,9 @@ def main():
                    for f in os.listdir(swap_dir))
 
     from deepspeed_tpu.io.aio import AioHandle
+    from deepspeed_tpu.ops.cpu_adam import native_available
     native = AioHandle(1).native
+    _native_adam = native_available()
 
     def write_evidence(losses, times):
         if not args.json_out:
@@ -160,6 +162,13 @@ def main():
             "losses": losses,
             "step_time_s": times,
             "native_aio": bool(native),
+            "update_mode": engine.update_mode,
+            "native_cpu_adam": _native_adam,
+            # per-phase seconds of the LAST step — the viability
+            # breakdown (phases overlap; parts can sum past total)
+            "phase_breakdown_s": {
+                k: round(v, 3)
+                for k, v in engine.phase_report().items()},
         }
         with open(args.json_out, "w") as f:
             json.dump(evidence, f, indent=1)
