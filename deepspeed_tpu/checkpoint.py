@@ -47,6 +47,22 @@ _pending_finalize = None  # its in-flight save's meta/latest writer — module
 _atexit_registered = False
 
 
+def finalize_checkpoint_dir(save_dir: str, tag: str, meta: dict) -> None:
+    """Shared durable-commit tail for every engine's save path: write
+    meta.json in the tagged dir, then point ``latest`` at it (process 0
+    only).  Ordering matters — ``latest`` must never name a dir whose
+    state is not fully on disk, so call this only after the state write
+    has been joined."""
+    path = _ckpt_dir(save_dir, tag)
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        with open(os.path.join(os.path.abspath(save_dir), "latest"),
+                  "w") as f:
+            f.write(tag)
+    logger.info("saved checkpoint %s", path)
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None,
                     async_save: bool = False) -> str:
@@ -81,13 +97,7 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     }
 
     def finalize():
-        if jax.process_index() == 0:
-            with open(os.path.join(path, "meta.json"), "w") as f:
-                json.dump(meta, f)
-            with open(os.path.join(os.path.abspath(save_dir),
-                                   "latest"), "w") as f:
-                f.write(tag)
-        logger.info("saved checkpoint %s", path)
+        finalize_checkpoint_dir(save_dir, tag, meta)
 
     if async_save:
         _pending_finalize = finalize

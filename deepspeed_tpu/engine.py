@@ -963,11 +963,12 @@ def initialize(args=None, *, loss_fn: Optional[Callable] = None,
     if off_dev == "nvme" or (off_dev == "cpu" and off.get("scheduled")):
         from deepspeed_tpu.infinity import InfinityEngine
 
-        if optimizer is not None or param_specs is not None or has_aux:
+        if optimizer is not None or has_aux:
             raise ValueError(
                 "the ZeRO-Infinity scheduled-offload engine drives its own "
-                "Adam update and parameter layout; pass the optimizer via "
-                "the config block and drop param_specs/has_aux")
+                "Adam update; pass the optimizer via the config block and "
+                "drop has_aux (param_specs ARE supported: TP shardings on "
+                "the compute params compose with the [dp, chunk] state)")
         if config.curriculum is not None and config.curriculum.enabled:
             raise ValueError(
                 "curriculum_learning does not compose with the scheduled "
@@ -979,7 +980,8 @@ def initialize(args=None, *, loss_fn: Optional[Callable] = None,
             # resident in HBM regardless, so materialize the thunk eagerly
             params = params()
         engine = InfinityEngine(loss_fn, params, config, mesh=mesh,
-                                lr_scheduler=lr_scheduler)
+                                lr_scheduler=lr_scheduler,
+                                param_specs=param_specs)
     else:
         engine = TrainingEngine(loss_fn, params, config, mesh=mesh,
                                 optimizer=optimizer, lr_scheduler=lr_scheduler,

@@ -169,7 +169,8 @@ class InfinityEngine:
     """
 
     def __init__(self, loss_fn, params: Any, config: Config,
-                 mesh: Optional[MeshSpec] = None, lr_scheduler=None):
+                 mesh: Optional[MeshSpec] = None, lr_scheduler=None,
+                 param_specs=None):
         self.config = config
         self.mesh = mesh or MeshSpec.build(
             config.mesh.axis_sizes(jax.device_count()))
@@ -277,13 +278,24 @@ class InfinityEngine:
             self.tier.fence_all()
 
         # ---- compute-dtype copy, resident in HBM (bf16 by default; an
-        # explicit fp32/f16 precision config is honored)
+        # explicit fp32/f16 precision config is honored).  With
+        # param_specs the compute leaves are TP-sharded over the model
+        # axis (ref: the reference's swapper composes with Megatron TP
+        # via mpu) while the f32 STATE stays [dp, chunk] P("data") —
+        # GSPMD reshards at the grad ravel and the fresh-param unravel.
         self._compute_dtype = precision.compute_dtype(config.precision)
         self.batch_sharding = self.mesh.sharding(self.mesh.batch_spec())
         repl = self.mesh.replicated()
+        from deepspeed_tpu import zero as _zero
+
+        spec_tree = _zero.resolve_specs(params, param_specs)
+        self._pshards = [self.mesh.sharding(s)
+                         for s in jax.tree.leaves(spec_tree)]
+        if len(self._pshards) != len(leaves):
+            raise ValueError("param_specs tree does not match params")
         self.params_c = [
-            jax.device_put(jnp.asarray(a, self._compute_dtype), repl)
-            for a in leaves]
+            jax.device_put(jnp.asarray(a, self._compute_dtype), sh)
+            for a, sh in zip(leaves, self._pshards)]
 
         grad_dtype = jnp.bfloat16 if off.get("bf16_grads") else jnp.float32
         accum = config.gradient_accumulation_steps
@@ -377,7 +389,8 @@ class InfinityEngine:
 
         def _upd_out_shardings(k):
             g = [self.state_sharding] * len(self.groups[k])
-            return (g, g, g, [self.mesh.replicated()] * len(self.groups[k]))
+            return (g, g, g,
+                    [self._pshards[i] for i in self.groups[k]])
 
         self._update_fns = [
             jax.jit(lambda m, mu, nu, gr, s, ok, _k=k: group_update(
@@ -393,7 +406,12 @@ class InfinityEngine:
         self._restore_fns = [
             jax.jit(lambda a, _i=i: a.reshape(-1)[:sizes[_i]]
                     .reshape(self._shapes[_i]).astype(cdt),
-                    out_shardings=repl)
+                    out_shardings=self._pshards[i])
+            for i in range(len(leaves))]
+        # [dp, chunk] sharded rows → flat unpadded f32 (checkpoint's
+        # topology-free universal form); jitted per leaf, sharded output
+        self._flatten_fns = [
+            jax.jit(lambda a, _i=i: a.reshape(-1)[:sizes[_i]])
             for i in range(len(leaves))]
 
         self.global_steps = 0
@@ -782,6 +800,14 @@ class InfinityEngine:
         return sum(12 * n_local * c for c in self._chunks)
 
     # ---------------------------------------------------------- checkpoint
+    def _ckpt_key(self, kind: str, i: int) -> str:
+        """Stable orbax key: index + sanitized leaf path (tree-path
+        strings carry quotes/brackets that should not name directories)."""
+        import re as _re
+
+        return f"{kind}{i:04d}_" + _re.sub(r"[^0-9A-Za-z_]", "",
+                                           self._names[i])
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None,
                         async_save: bool = False):
@@ -796,28 +822,47 @@ class InfinityEngine:
         if async_save:
             logger.info("InfinityEngine.save_checkpoint: async_save "
                         "degrades to synchronous (state is host-resident)")
-        import json
+        import orbax.checkpoint as ocp
+
+        from deepspeed_tpu.checkpoint import finalize_checkpoint_dir
 
         tag = tag or f"global_step{self.global_steps}"
         d = os.path.join(save_dir, tag)
         os.makedirs(d, exist_ok=True)
         n_local = len(self._local_rows)
-        arrays = {}
+        # UNIVERSAL layout (ref: deepspeed/checkpoint/ ds_to_universal):
+        # each leaf saved as its FLAT UNPADDED f32 global array via orbax
+        # — restorable under any dp width or process count (the
+        # [dp, chunk] padding is a save-time topology detail that must
+        # not leak into the format).  One orbax item PER LEAF-STATE so
+        # the transient footprint is a single sub-group leaf, never the
+        # whole 12N state (which by this engine's premise does not fit):
+        # single-controller assembles on host (no device roundtrip);
+        # multi-host lifts the leaf through the device sharded, and each
+        # process writes only the shards it owns.
+        ckptr = ocp.StandardCheckpointer()
+        single = jax.process_count() == 1
         for i, n in enumerate(self._names):
             for kind in ("", "m", "v"):
                 buf = self.tier.get_submit(
                     kind + n, (n_local, self._chunks[i]), np.float32)
                 self.tier.fence_reads()
-                arrays[kind + n] = self._assemble(np.array(buf), i)
+                if single:
+                    item = np.array(buf).reshape(-1)[:self._sizes[i]]
+                else:
+                    item = self._flatten_fns[i](
+                        self._rows_to_device(np.array(buf), i))
+                key = self._ckpt_key(kind or "w", i)
+                ckptr.save(os.path.join(d, "state", key), {"a": item},
+                           force=True)
+                ckptr.wait_until_finished()
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
-        np.savez(os.path.join(d, "infinity_state.npz"), **arrays)
-        meta = {"global_steps": self.global_steps,
-                "opt_steps": self._opt_steps,
-                "skipped_steps": self.skipped_steps,
-                "client_state": client_state or {}}
-        with open(os.path.join(d, "meta.json"), "w") as f:
-            json.dump(meta, f)
+        finalize_checkpoint_dir(save_dir, tag, {
+            "global_steps": self.global_steps,
+            "opt_steps": self._opt_steps,
+            "skipped_steps": self.skipped_steps,
+            "client_state": client_state or {}})
         return d
 
     def wait_for_checkpoint(self) -> None:
@@ -827,21 +872,45 @@ class InfinityEngine:
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
         import json
 
+        import orbax.checkpoint as ocp
+
+        from deepspeed_tpu.checkpoint import _resolve_tag
+
+        tag = _resolve_tag(load_dir, tag, required=False)
         if tag is None:
-            tags = sorted(t for t in os.listdir(load_dir)
-                          if os.path.isdir(os.path.join(load_dir, t)))
+            # no 'latest' pointer (e.g. pre-pointer checkpoints): fall
+            # back to the numerically newest global_step directory
+            tags = [t for t in os.listdir(load_dir)
+                    if os.path.isdir(os.path.join(load_dir, t))]
             if not tags:
                 raise FileNotFoundError(f"no checkpoints under {load_dir}")
-            tag = tags[-1]
+            tag = max(tags, key=lambda t: (
+                int(t.rsplit("global_step", 1)[-1])
+                if t.rsplit("global_step", 1)[-1].isdigit() else -1, t))
         d = os.path.join(load_dir, tag)
-        arrays = np.load(os.path.join(d, "infinity_state.npz"))
-        repl = self.mesh.replicated()
+        legacy = os.path.join(d, "infinity_state.npz")
+        arrays = np.load(legacy) if os.path.exists(legacy) else None
+        ckptr = None if arrays is not None else ocp.StandardCheckpointer()
         for i, n in enumerate(self._names):
-            for kind in ("", "m", "v"):
-                self.tier.put(kind + n, self._partition_host(
-                    np.ascontiguousarray(arrays[kind + n]), i))
+            leaf = {}
+            for kind in ("w", "m", "v"):
+                if arrays is not None:        # pre-orbax npz layout
+                    leaf[kind] = np.ascontiguousarray(
+                        arrays[("" if kind == "w" else kind) + n])
+                else:
+                    # host-side restore (no target shardings → numpy):
+                    # one sub-group leaf at a time, no HBM transient —
+                    # this is also what makes the load topology-free
+                    # (any dp width / process count re-partitions below)
+                    leaf[kind] = np.ascontiguousarray(ckptr.restore(
+                        os.path.join(d, "state",
+                                     self._ckpt_key(kind, i)))["a"])
+            for kind, key in (("", "w"), ("m", "m"), ("v", "v")):
+                self.tier.put(kind + n,
+                              self._partition_host(leaf[key], i))
             self.params_c[i] = jax.device_put(
-                jnp.asarray(arrays[n], self._compute_dtype), repl)
+                jnp.asarray(leaf["w"].reshape(self._shapes[i]),
+                            self._compute_dtype), self._pshards[i])
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
         with open(os.path.join(d, "meta.json")) as f:

@@ -539,11 +539,13 @@ class ParamStreamEngine:
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
         np.savez(os.path.join(d, "pstream_state.npz"), **arrays)
-        with open(os.path.join(d, "meta.json"), "w") as f:
-            json.dump({"global_steps": self.global_steps,
-                       "opt_steps": self._opt_steps,
-                       "skipped_steps": self.skipped_steps,
-                       "client_state": client_state or {}}, f)
+        from deepspeed_tpu.checkpoint import finalize_checkpoint_dir
+
+        finalize_checkpoint_dir(save_dir, tag, {
+            "global_steps": self.global_steps,
+            "opt_steps": self._opt_steps,
+            "skipped_steps": self.skipped_steps,
+            "client_state": client_state or {}})
         return d
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
@@ -551,12 +553,9 @@ class ParamStreamEngine:
 
         from deepspeed_tpu.ops.cpu_adam import f32_to_bf16
 
-        if tag is None:
-            tags = sorted(t for t in os.listdir(load_dir)
-                          if os.path.isdir(os.path.join(load_dir, t)))
-            if not tags:
-                raise FileNotFoundError(f"no checkpoints under {load_dir}")
-            tag = tags[-1]
+        from deepspeed_tpu.checkpoint import _resolve_tag
+
+        tag = _resolve_tag(load_dir, tag, required=True)
         d = os.path.join(load_dir, tag)
         arrays = np.load(os.path.join(d, "pstream_state.npz"))
         for l in range(self.L):
