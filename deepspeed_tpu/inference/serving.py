@@ -101,7 +101,27 @@ class ServingEngine:
                  prefill_bucket: int = 32, eos_token_id: Optional[int] = None,
                  cache_dtype=jnp.bfloat16, seed: int = 0,
                  decode_chunk: int = 1, prefill_chunk: int = 0,
-                 chunk_prefill_fn=None):
+                 chunk_prefill_fn=None, mesh=None):
+        # TP-sharded serving (ref: deepspeed/module_inject/
+        # replace_module.py TP injection): with a mesh, the KV cache's
+        # head axis shards over ``model``, params arrive pre-sharded from
+        # the builder, and every host-built jit input is placed
+        # replicated on the mesh (a device-0-committed array mixed with
+        # sharded arrays is an error, not a resharding).
+        self._mesh = mesh
+        if mesh is not None and mesh.size("model") > 1:
+            if n_kv % mesh.size("model"):
+                raise ValueError(
+                    f"n_kv_heads {n_kv} not divisible by model-axis size "
+                    f"{mesh.size('model')}")
+            from jax.sharding import PartitionSpec as P
+
+            self._repl = mesh.replicated()
+            self._kv_sharding = mesh.sharding(
+                P(None, "model", None, None, None))
+        else:
+            self._mesh = None
+            self._repl = self._kv_sharding = None
         self.params = params
         self.decode_chunk = int(decode_chunk)
         if self.decode_chunk < 1:
@@ -127,14 +147,27 @@ class ServingEngine:
         # last page is the sacrificial target for inactive-slot writes
         self.trash_page = num_pages - 1
         self.allocator = PageAllocator(num_pages - 1)
+
+        def put_repl(x):
+            x = jnp.asarray(x)
+            return (jax.device_put(x, self._repl)
+                    if self._repl is not None else x)
+
+        def put_kv(x):
+            return (jax.device_put(x, self._kv_sharding)
+                    if self._kv_sharding is not None else x)
+
+        self._put = put_repl
         self.cache = PagedKVCache(
-            k=jnp.zeros((n_layers, n_kv, num_pages, page_size, head_dim),
-                        cache_dtype),
-            v=jnp.zeros((n_layers, n_kv, num_pages, page_size, head_dim),
-                        cache_dtype),
-            table=jnp.full((max_batch, self.max_pages_per_seq),
-                           self.trash_page, jnp.int32),
-            seq_lens=jnp.zeros((max_batch,), jnp.int32),
+            k=put_kv(jnp.zeros(
+                (n_layers, n_kv, num_pages, page_size, head_dim),
+                cache_dtype)),
+            v=put_kv(jnp.zeros(
+                (n_layers, n_kv, num_pages, page_size, head_dim),
+                cache_dtype)),
+            table=put_repl(jnp.full((max_batch, self.max_pages_per_seq),
+                                    self.trash_page, jnp.int32)),
+            seq_lens=put_repl(jnp.zeros((max_batch,), jnp.int32)),
             page_size=page_size)
 
         self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
@@ -215,14 +248,14 @@ class ServingEngine:
             up = self._table_host.copy()
             for b in pending:
                 up[b, :] = self.trash_page
-            self.cache = self.cache._replace(table=jnp.asarray(up))
+            self.cache = self.cache._replace(table=self._put(up))
             self._table_dirty = False
         if self._lens_dirty:
             lens = np.zeros((self.max_batch,), np.int32)
             for b, s in enumerate(self.slots):
                 if s is not None and not s.prefilling:
                     lens[b] = s.seq_len
-            self.cache = self.cache._replace(seq_lens=jnp.asarray(lens))
+            self.cache = self.cache._replace(seq_lens=self._put(lens))
             self._lens_dirty = False
 
     def _free_slot(self) -> Optional[int]:
@@ -275,9 +308,10 @@ class ServingEngine:
         # donation would then delete out from under the decode path
         view = PagedKVCache(
             k=self.cache.k, v=self.cache.v,
-            table=jnp.asarray(self._table_host[b:b + 1]),
-            seq_lens=jnp.zeros((1,), jnp.int32), page_size=self.page_size)
-        logits, view = self._prefill(self.params, jnp.asarray(toks), view)
+            table=self._put(self._table_host[b:b + 1]),
+            seq_lens=self._put(jnp.zeros((1,), jnp.int32)),
+            page_size=self.page_size)
+        logits, view = self._prefill(self.params, self._put(toks), view)
         self.cache = self.cache._replace(k=view.k, v=view.v)
 
         slot = _Slot(req=req, seq_len=T, generated=[], rng=rng,
@@ -310,10 +344,10 @@ class ServingEngine:
         np_bkt = min(np_bkt, self.max_pages_per_seq)
         view = PagedKVCache(
             k=self.cache.k, v=self.cache.v,
-            table=jnp.asarray(self._table_host[b:b + 1, :np_bkt]),
-            seq_lens=jnp.full((1,), done, jnp.int32),
+            table=self._put(self._table_host[b:b + 1, :np_bkt]),
+            seq_lens=self._put(jnp.full((1,), done, jnp.int32)),
             page_size=self.page_size)
-        logits, view = self._chunk_prefill(self.params, jnp.asarray(toks),
+        logits, view = self._chunk_prefill(self.params, self._put(toks),
                                            view)
         self.cache = self.cache._replace(k=view.k, v=view.v)
         s.prefill_done = done + take
@@ -432,8 +466,8 @@ class ServingEngine:
             keys = jax.random.split(r, K * self.max_batch).reshape(
                 K, self.max_batch, -1)
             out, self.cache = self._decode_chunk_fn(
-                self.params, jnp.asarray(toks), self.cache, keys,
-                jnp.asarray(temps))
+                self.params, self._put(toks), self.cache,
+                self._put(keys), self._put(temps))
             # trust the decode's structural seq_lens+K between
             # composition changes (inactive rows drift, rebuilt on the
             # next dirty upload)
@@ -467,33 +501,60 @@ class ServingEngine:
 
 
 def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
-                         quant_group_size: int = 128, **kw) -> ServingEngine:
+                         quant_group_size: int = 128, mesh=None,
+                         **kw) -> ServingEngine:
     """ServingEngine over models/llama.py's paged forward.
 
     ``weight_dtype="int8"``: weight-only quantized serving (ref:
     init_inference(dtype=int8)) — int8 codes + group scales in HBM
     (half the bf16 weight residency), dequant traced into the forward.
+
+    ``mesh``: TP-sharded serving (ref: replace_module.py TP injection) —
+    params shard Megatron-style over the ``model`` axis, the KV cache
+    shards its head axis, and both jits run under GSPMD with the psum
+    after wo/w2 inserted by XLA.  The mesh is published ambient so the
+    forward picks its TP-compatible attention paths.
     """
     from deepspeed_tpu.models import llama
+    from deepspeed_tpu.topology import set_current_mesh
+
+    # tp baked in at BUILD time: the compiled paths must not re-read the
+    # mutable ambient mesh on a later retrace (a cleared/replaced global
+    # would silently re-enable pallas kernels over the sharded cache)
+    tp = mesh is not None and mesh.size("model") > 1
 
     def step(params, tokens, cache):
-        return llama.forward_paged(params, tokens, cfg, cache)
+        return llama.forward_paged(params, tokens, cfg, cache, tp=tp)
 
     def chunk_step(params, tokens, cache):
         return llama.forward_paged(params, tokens, cfg, cache,
-                                   continuation=True)
+                                   continuation=True, tp=tp)
 
     if weight_dtype != "bfloat16":
         from deepspeed_tpu.inference.quantized import quantize_for_inference
 
+        if mesh is not None and mesh.size("model") > 1:
+            raise NotImplementedError(
+                "int8 weight-only quant + TP serving: the group-scale "
+                "layout is not model-axis sharded yet — pick one")
         # raises on anything but "int8" — never silently serve unquantized
         params, step, chunk_step = quantize_for_inference(
             params, step, chunk_step, weight_dtype=weight_dtype,
             group_size=quant_group_size)
 
+    if mesh is not None and mesh.size("model") > 1:
+        from deepspeed_tpu import zero as _zero
+
+        set_current_mesh(mesh)
+        specs = _zero.resolve_specs(params, llama.param_specs(cfg))
+        params = jax.tree.map(
+            lambda a, s: jax.device_put(jnp.asarray(a), mesh.sharding(s)),
+            params, specs)
+
     return ServingEngine(
         params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
-        head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, **kw)
+        head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, mesh=mesh,
+        **kw)
 
 
 def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",

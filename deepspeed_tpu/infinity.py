@@ -853,9 +853,12 @@ class InfinityEngine:
                     item = self._flatten_fns[i](
                         self._rows_to_device(np.array(buf), i))
                 key = self._ckpt_key(kind or "w", i)
+                # no per-leaf wait: orbax serializes/commits in the
+                # background and self-orders successive saves, so the
+                # next leaf's tier read overlaps this leaf's disk commit
                 ckptr.save(os.path.join(d, "state", key), {"a": item},
                            force=True)
-                ckptr.wait_until_finished()
+        ckptr.wait_until_finished()
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
         finalize_checkpoint_dir(save_dir, tag, {

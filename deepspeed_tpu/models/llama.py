@@ -376,13 +376,21 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache):
 
 def forward_paged(params, tokens, cfg: LlamaConfig, cache,
                   interpret: Optional[bool] = None,
-                  continuation: bool = False, ffn=None):
+                  continuation: bool = False, ffn=None,
+                  tp: Optional[bool] = None):
     """Forward over a paged KV cache (ref: the reference's inference
     kernels' workspace contract, modernised to vLLM-style page tables).
 
     ``ffn``: optional ``(lp, h) -> y`` override of the per-block FFN —
     the paged-attention backbone is model-agnostic, and MoE families
     (models/mixtral.py) reuse it by swapping in their expert combine.
+
+    ``tp``: True = params/cache are model-axis sharded, so every pallas
+    path (paged kernels AND the prefill flash kernel) must yield to the
+    GSPMD-partitionable XLA formulations.  Serving closures pass this
+    EXPLICITLY at build time — correctness must not hang off the mutable
+    ambient mesh, which is only consulted when ``tp`` is None (direct
+    callers).
 
     Prefill (T > 1, empty cache): dense causal attention over the prompt,
     K/V bulk-written into pages.  Decode (T == 1): pallas paged attention
@@ -403,6 +411,12 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
     ps = cache.k.shape[3]   # [L, KV, P, page_size, Dh] — static from shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if tp is None:
+        from deepspeed_tpu.topology import current_mesh as _cm
+
+        _ms = _cm()
+        tp = _ms is not None and _ms.size("model") > 1
+    tp_active = tp
     start = cache.seq_lens
     x = params["embed"][tokens]
     # per-sequence position offsets: ragged frontiers under continuous
@@ -442,14 +456,20 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
         mp = cache.table.shape[1]
         gather_bytes = (2 * B * nkv * mp * ps * hd
                         * (kp.dtype.itemsize + 4))
-        use_pallas = not interpret and gather_bytes >= (1 << 28)
+        # TP serving runs the XLA reference paths: GSPMD partitions jnp
+        # gathers over the model-sharded head axis for free, but cannot
+        # partition a pallas custom call (that would need shard_map
+        # plumbing through the cache donation)
+        use_pallas = (not interpret and not tp_active
+                      and gather_bytes >= (1 << 28))
         if T > 1 and continuation:
             kp, vp = write_chunk_pages(kp, vp, k, v, cache.table, start, ps)
             pa = (paged_chunk_attention if use_pallas
                   else paged_chunk_attention_reference)
             attn = pa(q, kp, vp, cache.table, start)
         elif prefill:
-            attn = flash_attention(q, k, v, causal=True)
+            attn = flash_attention(q, k, v, causal=True,
+                                   force_reference=tp_active)
             kp, vp = write_prompt_pages(kp, vp, k, v, cache.table, ps)
         else:
             kp, vp = write_token_pages(kp, vp, k[:, 0], v[:, 0],

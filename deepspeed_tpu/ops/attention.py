@@ -33,16 +33,20 @@ def _reference(q, k, v, causal=True, segment_ids=None):
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
-def flash_attention(q, k, v, causal: bool = True, segment_ids=None):
+def flash_attention(q, k, v, causal: bool = True, segment_ids=None,
+                    force_reference: bool = False):
     """[B,T,H,Dh] x [B,T,KV,Dh]^2 → [B,T,H,Dh].
 
     Dispatches to the Pallas TPU kernel when running on TPU with
     kernel-friendly shapes; otherwise the fused-softmax jnp reference
-    (which XLA still fuses well).
+    (which XLA still fuses well).  ``force_reference``: callers whose
+    operands are model-axis sharded (TP serving) must skip the pallas
+    custom call — GSPMD cannot partition it.
     """
     on_tpu = jax.default_backend() == "tpu"
     T, S = q.shape[1], k.shape[1]
-    if on_tpu and segment_ids is None and T >= 256 and T % 128 == 0 \
+    if on_tpu and not force_reference and segment_ids is None \
+            and T >= 256 and T % 128 == 0 \
             and S >= 256 and S % 128 == 0 and q.shape[-1] in (64, 128):
         try:
             from deepspeed_tpu.ops.attention_pallas import flash_attention_tpu

@@ -555,7 +555,16 @@ class ParamStreamEngine:
 
         from deepspeed_tpu.checkpoint import _resolve_tag
 
-        tag = _resolve_tag(load_dir, tag, required=True)
+        tag = _resolve_tag(load_dir, tag, required=False)
+        if tag is None:
+            # pre-pointer checkpoints: numerically newest global_step dir
+            tags = [t for t in os.listdir(load_dir)
+                    if os.path.isdir(os.path.join(load_dir, t))]
+            if not tags:
+                raise FileNotFoundError(f"no checkpoints under {load_dir}")
+            tag = max(tags, key=lambda t: (
+                int(t.rsplit("global_step", 1)[-1])
+                if t.rsplit("global_step", 1)[-1].isdigit() else -1, t))
         d = os.path.join(load_dir, tag)
         arrays = np.load(os.path.join(d, "pstream_state.npz"))
         for l in range(self.L):
