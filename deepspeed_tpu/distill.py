@@ -1,0 +1,126 @@
+"""Knowledge distillation (ref: the DeepSpeed compression suite's KD
+flow — deepspeed/compression/ is used with a teacher-student soft-label
+loss in the reference's Model-Compression recipes; layer_reduction's
+``teacher_layer`` exists precisely to initialize a student from teacher
+layers before distilling).
+
+TPU design: the teacher is a PURE function + param pytree traced into
+the SAME jitted loss as the student under ``stop_gradient`` — no second
+engine, no host round-trip for teacher logits; XLA overlaps the teacher
+forward with the student forward inside one program, and the teacher
+params ride along as ordinary (frozen) jit constants exactly like
+LoRA's frozen base (lora.py).
+
+Loss (Hinton et al., the reference recipes' formulation):
+
+    L = (1 - alpha) * CE(student, targets)
+      + alpha * T^2 * KL(softmax(teacher/T) || softmax(student/T))
+
+The T^2 factor keeps soft-gradient magnitudes comparable across
+temperatures.  All soft-label math runs in f32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def kd_kl_loss(student_logits, teacher_logits, temperature: float = 1.0,
+               mask=None) -> jnp.ndarray:
+    """Masked mean KL(teacher || student) at ``temperature``, scaled by
+    T^2.  logits: [..., V]; mask broadcasts over the leading dims."""
+    t = jnp.float32(temperature)
+    slog = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t,
+                              axis=-1)
+    tlog = jax.nn.log_softmax(teacher_logits.astype(jnp.float32) / t,
+                              axis=-1)
+    tp = jnp.exp(tlog)
+    kl = jnp.sum(tp * (tlog - slog), axis=-1)      # [...positions]
+    if mask is None:
+        return jnp.mean(kl) * t * t
+    from deepspeed_tpu.ops.losses import _masked_mean
+
+    return _masked_mean(kl.reshape(-1), mask.reshape(-1)) * t * t
+
+
+def distillation_loss(student_logits, teacher_logits, targets, *,
+                      alpha: float = 0.5, temperature: float = 1.0,
+                      mask=None):
+    """Combined hard-CE + soft-KL loss.  Returns (loss, aux dict with
+    ``hard_loss`` and ``kd_loss``).  The teacher term carries no
+    gradient (stop_gradient on the teacher logits)."""
+    logp = jax.nn.log_softmax(student_logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        hard = jnp.mean(nll)
+    else:
+        from deepspeed_tpu.ops.losses import _masked_mean
+
+        hard = _masked_mean(nll.reshape(-1), mask.reshape(-1))
+    soft = kd_kl_loss(student_logits,
+                      jax.lax.stop_gradient(teacher_logits),
+                      temperature=temperature, mask=mask)
+    loss = (1.0 - alpha) * hard + alpha * soft
+    return loss, {"hard_loss": hard, "kd_loss": soft}
+
+
+class Distiller:
+    """Wraps a student forward into an engine-ready distillation loss.
+
+    ``teacher_fn(teacher_params, tokens) -> logits`` is traced into the
+    student's jitted step under stop_gradient; ``teacher_params`` are
+    captured as frozen constants.
+    """
+
+    def __init__(self, teacher_fn: Callable, teacher_params: Any,
+                 alpha: float = 0.5, temperature: float = 2.0):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if temperature <= 0:
+            raise ValueError(f"temperature must be > 0, got {temperature}")
+        self.teacher_fn = teacher_fn
+        # jnp leaves: numpy teacher params would fail on tracer indexing
+        # (np_embed[traced_tokens]) when traced into the student's jit
+        self.teacher_params = jax.tree.map(jnp.asarray, teacher_params)
+        self.alpha = float(alpha)
+        self.temperature = float(temperature)
+
+    def loss_fn(self, student_fn: Callable,
+                has_aux: bool = False) -> Callable:
+        """``student_fn(params, tokens) -> logits`` → engine loss_fn over
+        ``batch = {tokens, (loss_mask)}`` (next-token LM convention:
+        inputs tokens[:, :-1], targets tokens[:, 1:])."""
+
+        def f(params, batch):
+            tokens = batch["tokens"]
+            inputs, targets = tokens[:, :-1], tokens[:, 1:]
+            mask = batch.get("loss_mask")
+            if mask is not None:
+                mask = mask[:, 1:]
+            s_logits = student_fn(params, inputs)
+            t_logits = self.teacher_fn(self.teacher_params, inputs)
+            loss, aux = distillation_loss(
+                s_logits, t_logits, targets, alpha=self.alpha,
+                temperature=self.temperature, mask=mask)
+            return (loss, aux) if has_aux else loss
+
+        return f
+
+
+def init_distillation(config: Any, teacher_fn: Callable,
+                      teacher_params: Any) -> Optional[Distiller]:
+    """Build a Distiller from the ``compression_training.
+    knowledge_distillation`` block ({enabled, alpha, temperature});
+    None when absent/disabled."""
+    if hasattr(config, "raw"):
+        config = config.raw
+    kd = (config.get("compression_training", {})
+          .get("knowledge_distillation", {}))
+    if not kd.get("enabled"):
+        return None
+    return Distiller(teacher_fn, teacher_params,
+                     alpha=float(kd.get("alpha", 0.5)),
+                     temperature=float(kd.get("temperature", 2.0)))

@@ -108,7 +108,6 @@ class ServingEngine:
         # the builder, and every host-built jit input is placed
         # replicated on the mesh (a device-0-committed array mixed with
         # sharded arrays is an error, not a resharding).
-        self._mesh = mesh
         if mesh is not None and mesh.size("model") > 1:
             if n_kv % mesh.size("model"):
                 raise ValueError(
@@ -120,7 +119,6 @@ class ServingEngine:
             self._kv_sharding = mesh.sharding(
                 P(None, "model", None, None, None))
         else:
-            self._mesh = None
             self._repl = self._kv_sharding = None
         self.params = params
         self.decode_chunk = int(decode_chunk)
@@ -516,7 +514,6 @@ def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
     forward picks its TP-compatible attention paths.
     """
     from deepspeed_tpu.models import llama
-    from deepspeed_tpu.topology import set_current_mesh
 
     # tp baked in at BUILD time: the compiled paths must not re-read the
     # mutable ambient mesh on a later retrace (a cleared/replaced global
@@ -545,7 +542,6 @@ def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
     if mesh is not None and mesh.size("model") > 1:
         from deepspeed_tpu import zero as _zero
 
-        set_current_mesh(mesh)
         specs = _zero.resolve_specs(params, llama.param_specs(cfg))
         params = jax.tree.map(
             lambda a, s: jax.device_put(jnp.asarray(a), mesh.sharding(s)),
@@ -558,13 +554,19 @@ def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
 
 
 def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
-                           quant_group_size: int = 128,
+                           quant_group_size: int = 128, mesh=None,
                            **kw) -> ServingEngine:
     """ServingEngine over models/mixtral.py's paged MoE forward (ref:
     DeepSpeed-MoE inference serving, deepspeed/inference/engine.py) —
     iteration-level scheduling, paged KV, split-fuse and decode chunking
     all apply to the MoE model unchanged."""
     from deepspeed_tpu.models import mixtral
+
+    if mesh is not None and mesh.size("model") > 1:
+        raise NotImplementedError(
+            "TP-sharded MoE serving needs expert+model param shardings "
+            "threaded through the dense combine — llama TP serving works "
+            "today; serve mixtral unsharded or train-side for now")
 
     def step(params, tokens, cache):
         return mixtral.forward_paged(params, tokens, cfg, cache)

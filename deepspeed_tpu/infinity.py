@@ -884,7 +884,9 @@ class InfinityEngine:
             # no 'latest' pointer (e.g. pre-pointer checkpoints): fall
             # back to the numerically newest global_step directory
             tags = [t for t in os.listdir(load_dir)
-                    if os.path.isdir(os.path.join(load_dir, t))]
+                    if os.path.isdir(os.path.join(load_dir, t))
+                    and os.path.exists(os.path.join(load_dir, t,
+                                                    "meta.json"))]
             if not tags:
                 raise FileNotFoundError(f"no checkpoints under {load_dir}")
             tag = max(tags, key=lambda t: (

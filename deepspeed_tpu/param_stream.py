@@ -559,7 +559,9 @@ class ParamStreamEngine:
         if tag is None:
             # pre-pointer checkpoints: numerically newest global_step dir
             tags = [t for t in os.listdir(load_dir)
-                    if os.path.isdir(os.path.join(load_dir, t))]
+                    if os.path.isdir(os.path.join(load_dir, t))
+                    and os.path.exists(os.path.join(load_dir, t,
+                                                    "meta.json"))]
             if not tags:
                 raise FileNotFoundError(f"no checkpoints under {load_dir}")
             tag = max(tags, key=lambda t: (
