@@ -229,6 +229,7 @@ class TestEngineCurriculum:
     """The parsed curriculum block drives train_batch (ref:
     engine.curriculum_scheduler + megatron curriculum_seqlen)."""
 
+    @pytest.mark.slow
     def test_seqlen_curriculum_truncates_and_learns(self, devices):
         import deepspeed_tpu as dstpu
         from deepspeed_tpu.models import llama

@@ -76,6 +76,7 @@ class TestQuantizedAllReduce:
         for d in range(8):
             np.testing.assert_allclose(got[d], want, atol=2e-2, rtol=2e-2)
 
+    @pytest.mark.slow
     def test_padding_path(self, devices):
         ms = MeshSpec.build({"data": 8})
         # size 13: needs padding to 8*512

@@ -47,6 +47,7 @@ def make_dataset(cfg, n=64, T=33):
 
 
 class TestHFTrainerBridge:
+    @pytest.mark.slow
     def test_e2e_from_pretrained_train_save(self, devices, tmp_path):
         base, cfg = make_base_checkpoint(tmp_path)
         args = TrainingArguments(

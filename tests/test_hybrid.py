@@ -51,6 +51,7 @@ class TestHybridEngine:
             prompts, max_new_tokens=8, temperature=0.0)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
+    @pytest.mark.slow
     def test_rlhf_iteration(self, devices, setup):
         cfg, engine, hybrid = setup
         prompts = _prompts(cfg)

@@ -54,6 +54,7 @@ class TestInfinityEngine:
         np.testing.assert_allclose(li, lp, rtol=2e-3, atol=2e-3)
         assert li[-1] < li[0]
 
+    @pytest.mark.slow
     def test_nvme_tier_matches_ram_tier(self, devices):
         cfg, params, batch = tiny_setup()
         ram = build(cfg, params, {"device": "cpu", "scheduled": True})
@@ -120,6 +121,7 @@ class TestInfinityEngine:
         assert not isinstance(eng, InfinityEngine)
         assert float(eng.train_batch(batch)) > 0
 
+    @pytest.mark.slow
     def test_nonfinite_grad_skips_and_counts(self, devices):
         cfg, params, batch = tiny_setup()
         inf = build(cfg, params, {"device": "cpu", "scheduled": True})

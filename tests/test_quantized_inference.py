@@ -61,6 +61,7 @@ class TestInt8Inference:
         agree = float(jnp.mean(jnp.argmax(got, -1) == jnp.argmax(ref, -1)))
         assert agree > 0.9, agree
 
+    @pytest.mark.slow
     def test_int8_serving_runs_and_matches_int8_offline(self, model, devices):
         from deepspeed_tpu.inference.serving import llama_serving_engine
 

@@ -193,6 +193,7 @@ class TestDecodeChunk:
         out = eng.run()
         return out, eng
 
+    @pytest.mark.slow
     def test_chunked_matches_unchunked_greedy(self, model, devices):
         reqs = {"a": ([5, 9, 2], 9), "b": ([17, 3, 3, 8, 1], 6),
                 "c": ([40, 2], 11)}
@@ -279,6 +280,7 @@ class TestChunkedPrefill:
         assert outs["long"] == offline_chunked_expected(
             cfg, params, prompt, 5, C=8)
 
+    @pytest.mark.slow
     def test_decode_interleaves_with_long_prefill(self, model, devices):
         """A short request admitted alongside a long prompt must finish
         decoding BEFORE the long prompt's prefill completes."""

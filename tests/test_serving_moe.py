@@ -52,6 +52,7 @@ class TestMixtralServing:
             jnp.asarray([prompt], jnp.int32), max_new_tokens=n_new)
         assert paged == [int(t) for t in np.asarray(dense[0])]
 
+    @pytest.mark.slow
     def test_staggered_arrivals_match_offline(self, model, devices):
         cfg, params = model
         eng = mixtral_serving_engine(
