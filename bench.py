@@ -76,11 +76,24 @@ def _child_run(force_cpu: bool):
     if on_tpu:
         # ~0.6B-param Llama slice sized for one v5e (16G HBM) with f32
         # master + Adam moments resident; same per-layer math as 8B.
+        # An on-chip autotune round (tools/autotune_onchip.py) may have
+        # committed a measured winner — consume it (round-3 task 7).
+        tuned = {}
+        table = os.path.join(REPO, "AUTOTUNE_TABLE.json")
+        if os.path.exists(table):
+            try:
+                with open(table) as f:
+                    t = json.load(f)
+                if t.get("workload") == "bench_llama_0p6b":
+                    tuned = t.get("winner", {})
+            except Exception:
+                tuned = {}
         cfg = llama.LlamaConfig(
             vocab_size=16384, dim=2048, n_layers=8, n_heads=16, n_kv_heads=8,
             ffn_dim=7168, max_seq_len=2048, rope_theta=500000.0,
-            remat="save_dots")
-        batch, seq, steps = 4, 2048, 20
+            remat=tuned.get("remat", "save_dots"),
+            loss_chunk=int(tuned.get("loss_chunk", 0)))
+        batch, seq, steps = int(tuned.get("batch", 4)), 2048, 20
     else:  # CPU smoke path
         cfg = llama.LlamaConfig.tiny()
         batch, seq, steps = 4, 128, 3
@@ -152,6 +165,7 @@ def _child_run(force_cpu: bool):
                    "compile_s": round(compile_s, 1),
                    "zero3_tokens_per_sec": round(tps3, 1),
                    "zero3_step_ms": round(1000 * dt3 / steps3, 2),
+                   "autotuned": (tuned or None) if on_tpu else None,
                    "backend": jax.default_backend()},
     }))
 

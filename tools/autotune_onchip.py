@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""On-chip autotune round over the bench workload (round-3 verdict task
+7; ref: deepspeed/autotuning/ — the reference searches micro-batch and
+ZeRO knobs by MEASURING steps, not by modeling them).
+
+Searches (micro-batch x remat x loss_chunk) at bench.py's 0.6B llama
+config on the real chip, one engine per candidate, timing through
+``float(loss)`` (block_until_ready returns early under the axon
+tunnel).  Writes AUTOTUNE_TABLE.json; bench.py consumes the winner on
+its next run (detail.autotuned records provenance).
+
+    python tools/autotune_onchip.py            # ~8 candidates x ~1 min
+    python tools/autotune_onchip.py --quick    # 2 candidates smoke
+"""
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--cpu", action="store_true",
+                    help="CPU smoke of the search loop (tiny model)")
+    ap.add_argument("--json-out",
+                    default=os.path.join(REPO, "AUTOTUNE_TABLE.json"))
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu as dstpu
+    from deepspeed_tpu.models import llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    if args.cpu or not on_tpu:
+        base = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, max_seq_len=128)
+        seq = 64
+        space = {"batch": [2, 4], "remat": ["none"], "loss_chunk": [0]}
+    else:
+        base = dict(vocab_size=16384, dim=2048, n_layers=8, n_heads=16,
+                    n_kv_heads=8, ffn_dim=7168, max_seq_len=2048,
+                    rope_theta=500000.0)
+        seq = 2048
+        space = {"batch": [4, 8], "remat": ["none", "save_dots"],
+                 "loss_chunk": [0, 8192]}
+    if args.quick:
+        batches = space["batch"][:2]   # keep TWO: the winner-comparison
+        space = {k: v[:1] for k, v in space.items()}
+        space["batch"] = batches       # path must run in the smoke too
+
+    rows = []
+    best = None
+    cands = [dict(zip(space, vals))
+             for vals in itertools.product(*space.values())]
+    for cand in cands:
+        cfg = llama.LlamaConfig(**base, remat=cand["remat"],
+                                loss_chunk=cand["loss_chunk"])
+        engine = params = None
+        try:
+            params = llama.init_params(jax.random.PRNGKey(0), cfg)
+            engine, _, _, _ = dstpu.initialize(
+                loss_fn=llama.loss_fn(cfg), params=params,
+                config={"train_micro_batch_size_per_gpu": cand["batch"],
+                        "zero_optimization": {"stage": 0},
+                        "optimizer": {"type": "adamw",
+                                      "params": {"lr": 1e-4}},
+                        "bf16": {"enabled": True}})
+            toks = jnp.asarray(np.random.default_rng(0).integers(
+                0, cfg.vocab_size, (cand["batch"], seq + 1)), jnp.int32)
+            data = {"tokens": toks}
+            float(engine.train_batch(data))          # compile
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                loss = engine.train_batch(data)
+            float(loss)
+            dt = (time.perf_counter() - t0) / args.steps
+            tps = cand["batch"] * seq / dt
+            rows.append({**cand, "step_ms": round(1e3 * dt, 1),
+                         "tokens_per_sec": round(tps, 1)})
+            print("cand", rows[-1], flush=True)
+            if best is None or tps > best[0]:
+                best = (tps, cand)
+        except Exception as e:                        # OOM and friends
+            rows.append({**cand, "error": str(e)[:200]})
+            print("cand FAILED", cand, str(e)[:120], flush=True)
+        finally:
+            # drop a failed candidate's HBM (params + state + compiled
+            # step) BEFORE the next init, or its residue makes later
+            # viable candidates spuriously OOM out of the search
+            engine = params = None
+
+    if best is None:
+        raise SystemExit("autotune: every candidate failed")
+    out = {"workload": "bench_llama_0p6b" if on_tpu else "cpu_smoke",
+           "backend": jax.default_backend(),
+           "winner": best[1], "rows": rows}
+    with open(args.json_out, "w") as f:
+        json.dump(out, f, indent=1)
+    print("winner:", best[1], "→", args.json_out)
+
+
+if __name__ == "__main__":
+    main()

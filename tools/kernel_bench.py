@@ -257,7 +257,11 @@ def main():
                    (8, 1024, 16, 128, 16)]
     adam_sizes = [1 << 22, 1 << 26]
     paged_cfgs = [(8, 16, 4, 128, 16, 512, 1024),
-                  (16, 16, 8, 128, 16, 1024, 512)]
+                  (16, 16, 8, 128, 16, 1024, 512),
+                  # ABOVE the 1<<28 gather-bytes gate in llama.forward_paged
+                  # (2*16*8*256*16*128*6 = 805 MB): the demoted kernel's
+                  # winning side, unmeasured until now (round-3 weak #5)
+                  (16, 32, 8, 128, 16, 4608, 4096)]
     # (B, C, H, KV, Dh, page, pages, seq): short interactive chunk,
     # serving-default chunk, long-context chunk over a big table
     chunk_cfgs = [(8, 16, 16, 4, 128, 16, 512, 1024),

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Turnkey runner for the queued on-chip evidence backlog.
+
+The axon TPU tunnel is down for multi-hour stretches; when it comes
+back, ONE command must capture every queued measurement before it drops
+again:
+
+    python tools/onchip_backlog.py            # everything, priority order
+    python tools/onchip_backlog.py --only bench,kernels
+
+Each item runs as a subprocess under a hard deadline (the tunnel's
+failure mode is an uninterruptible hang inside the first device touch,
+so in-process timeouts don't work — round-1 postmortem).  Items write
+their own evidence JSONs; this runner records per-item outcomes in
+ONCHIP_RUNLOG.json and keeps going on failure.
+
+Priority order (round-3 verdict task 1 + round-4 additions):
+  probe     — hard-deadline jax.devices(); abort everything if down
+  bench     — headline MFU with the measured 512/512 flash tiles +
+              ZeRO-3 config (BENCH fields), writes BENCH_PREVIEW.json
+  kernels   — flash/adam/paged/chunk sweeps incl. the above-gate
+              paged-decode row (KERNEL_BENCH.json)
+  serving   — baseline + split-fuse + int8 rows (SERVING_BENCH.json)
+  tuning    — remat x batch sweep (TRAIN_TUNING.json) — decides whether
+              bench.py's remat/batch leave MFU on the table
+  infinity  — 1.38B phase-breakdown run with the fused C++ CPU-Adam +
+              grad prefetch (INFINITY_BENCH.json; r3: 406 s/step)
+  pstream   — the >HBM parameter-offload proof at 10B-class scale
+              (PARAM_STREAM_BENCH.json)
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def run_item(name, argv, deadline_s):
+    print(f"=== {name} (deadline {deadline_s}s): {' '.join(argv)}",
+          flush=True)
+    t0 = time.perf_counter()
+    try:
+        p = subprocess.run(argv, cwd=REPO, timeout=deadline_s,
+                           capture_output=True, text=True)
+        # full stdout to a per-item file: the 800-char tail alone can
+        # push a JSON result line out behind stderr warnings, losing a
+        # measurement the tunnel window may not grant again
+        with open(os.path.join(REPO, f"ONCHIP_{name}.out"), "w") as f:
+            f.write(p.stdout + "\n--- stderr ---\n" + p.stderr)
+        out = {"rc": p.returncode, "s": round(time.perf_counter() - t0, 1),
+               "stdout_tail": p.stdout[-800:],
+               "stderr_tail": p.stderr[-400:]}
+        if name in ("bench", "bench_tuned") and p.returncode == 0:
+            for line in reversed(p.stdout.strip().splitlines()):
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                with open(os.path.join(
+                        REPO, f"BENCH_PREVIEW_{name}.json"), "w") as f:
+                    json.dump(row, f, indent=1)
+                break
+    except subprocess.TimeoutExpired:
+        out = {"rc": None, "s": deadline_s, "stdout_tail": "TIMEOUT"}
+    print(f"--- {name}: rc={out['rc']} in {out['s']}s", flush=True)
+    return out
+
+
+ITEMS = {
+    "probe": ([PY, "-c", "import jax; print(jax.devices())"], 120),
+    "bench": ([PY, "bench.py"], 900),
+    "kernels": ([PY, "tools/kernel_bench.py"], 1800),
+    "serving": None,   # expanded below: three rows
+    "tuning": ([PY, "tools/train_tuning_sweep.py"], 1800),
+    "autotune": ([PY, "tools/autotune_onchip.py"], 2400),
+    # re-run after autotune: bench.py consumes AUTOTUNE_TABLE.json's
+    # winner, so this is the tuned headline number
+    "bench_tuned": ([PY, "bench.py"], 900),
+    "infinity": ([PY, "tools/infinity_evidence.py", "--steps", "3"], 7200),
+    "pstream": ([PY, "examples/param_stream_offload.py", "--scale", "10b",
+                 "--steps", "2", "--json-out", "PARAM_STREAM_BENCH.json"],
+                7200),
+}
+ORDER = ["probe", "bench", "kernels", "serving", "tuning", "autotune",
+         "bench_tuned", "infinity", "pstream"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default="",
+                    help="comma-separated subset of: " + ",".join(ORDER))
+    ap.add_argument("--log", default=os.path.join(REPO,
+                                                  "ONCHIP_RUNLOG.json"))
+    args = ap.parse_args()
+    picked = [s for s in args.only.split(",") if s] or ORDER
+    unknown = [s for s in picked if s not in ORDER]
+    if unknown:
+        raise SystemExit(f"unknown --only items {unknown}; "
+                         f"valid: {','.join(ORDER)}")
+
+    log = {}
+    for name in ORDER:
+        if name not in picked:
+            continue
+        if name == "serving":
+            # distinct evidence files — the default --json-out would
+            # overwrite the baseline row with the variant rows
+            for sub, extra in (
+                    ("serving", ["--json-out", "SERVING_BENCH.json"]),
+                    ("serving_splitfuse",
+                     ["--prefill-chunk", "64",
+                      "--json-out", "SERVING_SPLITFUSE.json"]),
+                    ("serving_int8",
+                     ["--weight-dtype", "int8",
+                      "--json-out", "SERVING_INT8.json"])):
+                log[sub] = run_item(
+                    sub, [PY, "bench_serving.py"] + extra, 900)
+            continue
+        argv, deadline = ITEMS[name]
+        log[name] = run_item(name, argv, deadline)
+        if name == "probe" and log[name]["rc"] != 0:
+            print("TPU probe failed — aborting the backlog run",
+                  flush=True)
+            break
+    with open(args.log, "w") as f:
+        json.dump(log, f, indent=1)
+    print("→", args.log)
+
+
+if __name__ == "__main__":
+    main()
