@@ -102,22 +102,29 @@ class ServingEngine:
                  cache_dtype=jnp.bfloat16, seed: int = 0,
                  decode_chunk: int = 1, prefill_chunk: int = 0,
                  chunk_prefill_fn=None, mesh=None):
-        # TP-sharded serving (ref: deepspeed/module_inject/
-        # replace_module.py TP injection): with a mesh, the KV cache's
-        # head axis shards over ``model``, params arrive pre-sharded from
-        # the builder, and every host-built jit input is placed
-        # replicated on the mesh (a device-0-committed array mixed with
-        # sharded arrays is an error, not a resharding).
-        if mesh is not None and mesh.size("model") > 1:
-            if n_kv % mesh.size("model"):
-                raise ValueError(
-                    f"n_kv_heads {n_kv} not divisible by model-axis size "
-                    f"{mesh.size('model')}")
+        # Sharded serving (ref: deepspeed/module_inject/replace_module.py
+        # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
+        # inference): with a mesh, params arrive pre-sharded from the
+        # builder, the KV cache's head axis shards over ``model`` (TP;
+        # under expert-only parallelism it stays replicated), and every
+        # host-built jit input is placed replicated on the mesh (a
+        # device-0-committed array mixed with sharded arrays is an
+        # error, not a resharding).
+        active = mesh is not None and any(
+            mesh.size(ax) > 1 for ax in ("model", "expert"))
+        if active:
             from jax.sharding import PartitionSpec as P
 
             self._repl = mesh.replicated()
-            self._kv_sharding = mesh.sharding(
-                P(None, "model", None, None, None))
+            if mesh.size("model") > 1:
+                if n_kv % mesh.size("model"):
+                    raise ValueError(
+                        f"n_kv_heads {n_kv} not divisible by model-axis "
+                        f"size {mesh.size('model')}")
+                self._kv_sharding = mesh.sharding(
+                    P(None, "model", None, None, None))
+            else:
+                self._kv_sharding = self._repl
         else:
             self._repl = self._kv_sharding = None
         self.params = params
@@ -564,20 +571,48 @@ def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
 
     if mesh is not None and mesh.size("model") > 1:
         raise NotImplementedError(
-            "TP-sharded MoE serving needs expert+model param shardings "
-            "threaded through the dense combine — llama TP serving works "
-            "today; serve mixtral unsharded or train-side for now")
+            "model-axis TP MoE serving needs attention+expert shardings "
+            "threaded together — use an EXPERT-parallel mesh "
+            "({'expert': N}, ref deepspeed/moe/sharded_moe.py inference) "
+            "or serve unsharded")
+
+    # expert-parallel serving (ref: DeepSpeed-MoE inference — experts
+    # partitioned across ranks, attention replicated): the stacked
+    # [L, E, ...] expert FFNs shard over the expert axis, the dense
+    # top-k combine's vmap over E partitions with them, and XLA inserts
+    # the expert-axis psum at the weighted combine.  Attention params,
+    # router, and the KV cache stay replicated.
+    ep = mesh is not None and mesh.size("expert") > 1
+    if ep:
+        from deepspeed_tpu import zero as _zero
+
+        if cfg.num_experts % mesh.size("expert"):
+            raise ValueError(
+                f"num_experts {cfg.num_experts} not divisible by "
+                f"expert-axis size {mesh.size('expert')}")
+        # spec-driven placement, same as the llama TP path: the model's
+        # own param_specs is the single source of truth for which leaves
+        # shard (its model-axis entries are no-ops at model size 1)
+        specs = _zero.resolve_specs(params, mixtral.param_specs(cfg))
+        params = jax.tree.map(
+            lambda a, sp: jax.device_put(jnp.asarray(a),
+                                         mesh.sharding(sp)),
+            params, specs)
 
     def step(params, tokens, cache):
-        return mixtral.forward_paged(params, tokens, cfg, cache)
+        return mixtral.forward_paged(params, tokens, cfg, cache, tp=ep)
 
     def chunk_step(params, tokens, cache):
         return mixtral.forward_paged(params, tokens, cfg, cache,
-                                     continuation=True)
+                                     continuation=True, tp=ep)
 
     if weight_dtype != "bfloat16":
         from deepspeed_tpu.inference.quantized import quantize_for_inference
 
+        if ep:
+            raise NotImplementedError(
+                "int8 weight-only quant + expert-parallel serving: the "
+                "group-scale layout is not expert-sharded yet — pick one")
         full = params
         params, step, chunk_step = quantize_for_inference(
             params, step, chunk_step, weight_dtype=weight_dtype,
@@ -588,7 +623,8 @@ def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
 
     return ServingEngine(
         params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
-        head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, **kw)
+        head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, mesh=mesh,
+        **kw)
 
 
 def serving_engine(params, cfg, **kw) -> ServingEngine:

@@ -317,7 +317,8 @@ def forward_with_cache(params, tokens, cfg: MixtralConfig, cache):
 
 
 def forward_paged(params, tokens, cfg: MixtralConfig, cache,
-                  interpret=None, continuation: bool = False):
+                  interpret=None, continuation: bool = False,
+                  tp=None):
     """Paged-KV MoE forward for continuous-batching serving (ref:
     DeepSpeed-MoE inference — the reference SERVES MoE models through its
     inference engine, it does not just eval them; deepspeed/inference/
@@ -331,7 +332,7 @@ def forward_paged(params, tokens, cfg: MixtralConfig, cache,
     (logits [B, T, V] f32, cache)."""
     return _llama.forward_paged(
         params, tokens, cfg.llama_view(), cache, interpret=interpret,
-        continuation=continuation,
+        continuation=continuation, tp=tp,
         ffn=lambda lp, h: _moe_ffn_dense(cfg, h, lp))
 
 

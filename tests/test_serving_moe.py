@@ -100,6 +100,44 @@ class TestMixtralServing:
         outs = eng.run()
         assert len(outs["a"]) == len(PROMPTS["a"][0]) + 4
 
+    def test_expert_parallel_matches_unsharded(self, model, devices):
+        """EP serving (ref: deepspeed/moe/sharded_moe.py inference —
+        experts partitioned across ranks): exact token match vs the
+        unsharded engine."""
+        from deepspeed_tpu.topology import MeshSpec
+
+        cfg, params = model
+        base = mixtral_serving_engine(
+            params, cfg, max_batch=2, page_size=8, num_pages=32,
+            max_seq=64, prefill_bucket=8)
+        for rid, (p, n) in PROMPTS.items():
+            base.submit(rid, p, max_new_tokens=n)
+        want = base.run()
+
+        mesh = MeshSpec.build({"expert": 2}, devices=jax.devices()[:2])
+        eng = mixtral_serving_engine(
+            params, cfg, mesh=mesh, max_batch=2, page_size=8,
+            num_pages=32, max_seq=64, prefill_bucket=8)
+        spec = eng.params["blocks"]["w1"].sharding.spec
+        assert "expert" in [s for s in spec if s is not None]
+        for rid, (p, n) in PROMPTS.items():
+            eng.submit(rid, p, max_new_tokens=n)
+        assert eng.run() == want
+
+    def test_ep_refusals(self, model, devices):
+        from deepspeed_tpu.topology import MeshSpec
+
+        cfg, params = model
+        with pytest.raises(NotImplementedError, match="expert"):
+            mixtral_serving_engine(
+                params, cfg, mesh=MeshSpec.build(
+                    {"model": 2}, devices=jax.devices()[:2]))
+        with pytest.raises(NotImplementedError, match="int8"):
+            mixtral_serving_engine(
+                params, cfg, weight_dtype="int8",
+                mesh=MeshSpec.build({"expert": 2},
+                                    devices=jax.devices()[:2]))
+
     def test_registry_dispatch(self, model, devices):
         """Pin the dispatch itself: serving a Mixtral through the generic
         entrypoint must produce the MoE model's tokens (a mis-dispatch to
