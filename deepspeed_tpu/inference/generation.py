@@ -268,3 +268,11 @@ def mixtral_paged_generator(params, cfg, **kw) -> Generator:
     from deepspeed_tpu.models import mixtral
 
     return _paged_generator(mixtral.forward_paged, params, cfg, **kw)
+
+
+def gpt2_paged_generator(params, cfg, **kw) -> Generator:
+    """Paged-KV GPT-2 generation — the offline oracle for GPT-2 serving
+    (ref: gpt2 kernel-injection container)."""
+    from deepspeed_tpu.models import gpt2
+
+    return _paged_generator(gpt2.forward_paged, params, cfg, **kw)

@@ -374,3 +374,81 @@ def paged_chunk_attention(q, k_pages, v_pages, table, start,
       v_pages.reshape(KV * P, ps, Dh))
     out = out[:, :CG].reshape(B, KV, C, G, Dh).transpose(0, 2, 1, 3, 4)
     return out.reshape(B, C, H, Dh)
+
+
+# --------------------------------------------- shared per-layer dispatch
+def pallas_paged_gate(B: int, n_kv: int, head_dim: int, page_size: int,
+                      max_pages: int, kv_itemsize: int,
+                      interpret: bool, tp: bool) -> bool:
+    """One policy for when the pallas paged kernels beat the XLA gather
+    references, shared by every model's paged forward.  Measured on v5e
+    for decode (KERNEL_BENCH.json paged_decode_vs_gather): the gather
+    wins ~1.2x at small/mid shapes; the kernel pays off only when the
+    gathered K/V transient ([B, KV, mp*ps, Dh] x2, in cache dtype PLUS
+    the f32 upcast) is too big to materialize.  TP forces the XLA paths
+    (GSPMD cannot partition a pallas custom call)."""
+    gather_bytes = (2 * B * n_kv * max_pages * page_size * head_dim
+                    * (kv_itemsize + 4))
+    return not interpret and not tp and gather_bytes >= (1 << 28)
+
+
+def paged_attention_step(q, k, v, kp, vp, table, start, page_size: int, *,
+                         continuation: bool, prefill: bool,
+                         use_pallas: bool, flash_force_reference: bool):
+    """The per-layer paged-attention step every model family shares:
+    page writes + the right attention for the phase.
+
+    q: [B, T, H, Dh]; k/v: [B, T, KV, Dh]; kp/vp: one layer's pages.
+    Phases: chunked-prefill continuation (split-fuse), whole-prompt
+    prefill (empty cache), or single-token decode.  Returns
+    (attn [B, T, H, Dh], kp, vp)."""
+    from deepspeed_tpu.ops.attention import flash_attention
+
+    if continuation and q.shape[1] > 1:
+        kp, vp = write_chunk_pages(kp, vp, k, v, table, start, page_size)
+        pa = (paged_chunk_attention if use_pallas
+              else paged_chunk_attention_reference)
+        attn = pa(q, kp, vp, table, start)
+    elif prefill:
+        attn = flash_attention(q, k, v, causal=True,
+                               force_reference=flash_force_reference)
+        kp, vp = write_prompt_pages(kp, vp, k, v, table, page_size)
+    else:
+        kp, vp = write_token_pages(kp, vp, k[:, 0], v[:, 0], table, start,
+                                   page_size)
+        pa = (paged_decode_attention if use_pallas
+              else paged_attention_reference)
+        attn = pa(q[:, 0], kp, vp, table, start + 1)[:, None]
+    return attn, kp, vp
+
+
+def paged_forward_prelude(cache, tokens, interpret, tp,
+                          continuation: bool):
+    """Shared preamble for every model's ``forward_paged``: resolve the
+    interpret/tp defaults (ambient mesh consulted only when tp is None —
+    serving closures pass it explicitly), derive the page size and
+    ragged per-row start offsets, and guard the whole-prompt prefill
+    against a non-empty cache.  Returns (interpret, tp, ps, start,
+    prefill)."""
+    import jax as _jax
+
+    ps = cache.k.shape[3]
+    if interpret is None:
+        interpret = _jax.default_backend() != "tpu"
+    if tp is None:
+        from deepspeed_tpu.topology import current_mesh as _cm
+
+        _ms = _cm()
+        tp = _ms is not None and _ms.size("model") > 1
+    start = cache.seq_lens
+    prefill = tokens.shape[1] > 1 and not continuation
+    if prefill:
+        try:
+            if int(jnp.max(start)) != 0:
+                raise ValueError(
+                    "forward_paged prefill (T>1) requires an empty "
+                    "cache; pass continuation=True for chunked prefill")
+        except (_jax.errors.TracerArrayConversionError,
+                _jax.errors.ConcretizationTypeError):
+            pass  # traced: caller's responsibility
+    return interpret, tp, ps, start, prefill

@@ -68,22 +68,31 @@ def _pick_groups(leaf, group_size: int) -> int:
 
 
 def quantize_params(params: Any, *, bits: int = 8, group_size: int = 128,
-                    min_ndim: int = 2) -> Any:
+                    min_ndim: int = 2, skip_paths=()) -> Any:
     """Quantize every floating leaf with ``ndim >= min_ndim`` (weights —
-    norm gains and other vectors stay exact) to int8 groups."""
+    unstacked norm gains and other vectors stay exact) to int8 groups.
+
+    ``skip_paths``: leaf key names kept exact regardless of ndim — a
+    STACKED tree's per-layer vectors ([L, d] norm gains, biases) pass
+    the ndim gate looking like matrices, so model builders must name
+    them (the reference's weight-only quantization likewise touches only
+    the matmul weights)."""
     if bits != 8:
         raise NotImplementedError("weight-only inference quant: int8 only")
+    skip = set(skip_paths)
 
-    def one(leaf):
+    def one(path, leaf):
         leaf = jnp.asarray(leaf)
-        if leaf.ndim < min_ndim or not jnp.issubdtype(leaf.dtype,
-                                                      jnp.floating):
+        name = str(path[-1].key) if path and hasattr(path[-1], "key") \
+            else ""
+        if name in skip or leaf.ndim < min_ndim or \
+                not jnp.issubdtype(leaf.dtype, jnp.floating):
             return leaf
         q, scale, _ = quantize(leaf, bits=8,
                                num_groups=_pick_groups(leaf, group_size))
         return QuantizedTensor(q=q, scale=scale)
 
-    return jax.tree.map(one, params)
+    return jax.tree_util.tree_map_with_path(one, params)
 
 
 def dequantize_params(params: Any, dtype=jnp.bfloat16) -> Any:
@@ -107,7 +116,8 @@ def quantized_apply(apply_fn, dtype=jnp.bfloat16):
 
 def quantize_for_inference(params: Any, *apply_fns,
                            weight_dtype: str = "int8",
-                           group_size: int = 128, dtype=jnp.bfloat16):
+                           group_size: int = 128, dtype=jnp.bfloat16,
+                           skip_paths=()):
     """One-stop weight-only quantization for an inference path: validates
     ``weight_dtype``, quantizes the params, and wraps every forward fn.
     Returns ``(qparams, wrapped_fn, ...)``.  Shared by
@@ -117,7 +127,8 @@ def quantize_for_inference(params: Any, *apply_fns,
         raise NotImplementedError(
             f"weight-only quantized inference supports 'int8' only, got "
             f"{weight_dtype!r}")
-    qparams = quantize_params(params, group_size=group_size)
+    qparams = quantize_params(params, group_size=group_size,
+                              skip_paths=skip_paths)
     return (qparams, *[quantized_apply(f, dtype) for f in apply_fns])
 
 

@@ -541,10 +541,12 @@ def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
             raise NotImplementedError(
                 "int8 weight-only quant + TP serving: the group-scale "
                 "layout is not model-axis sharded yet — pick one")
-        # raises on anything but "int8" — never silently serve unquantized
+        # raises on anything but "int8" — never silently serve
+        # unquantized; stacked [L, d] norm gains stay exact
         params, step, chunk_step = quantize_for_inference(
             params, step, chunk_step, weight_dtype=weight_dtype,
-            group_size=quant_group_size)
+            group_size=quant_group_size,
+            skip_paths=("attn_norm", "mlp_norm", "final_norm"))
 
     if mesh is not None and mesh.size("model") > 1:
         from deepspeed_tpu import zero as _zero
@@ -613,13 +615,12 @@ def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
             raise NotImplementedError(
                 "int8 weight-only quant + expert-parallel serving: the "
                 "group-scale layout is not expert-sharded yet — pick one")
-        full = params
+        # the router stays exact (int8 gate logits could flip a
+        # near-tied top-k choice) and so do the stacked norm gains
         params, step, chunk_step = quantize_for_inference(
             params, step, chunk_step, weight_dtype=weight_dtype,
-            group_size=quant_group_size)
-        # the router stays exact: int8 gate logits could flip a near-tied
-        # top-k choice and diverge generation from the trained routing
-        params["blocks"]["gate"] = full["blocks"]["gate"]
+            group_size=quant_group_size,
+            skip_paths=("gate", "attn_norm", "mlp_norm", "final_norm"))
 
     return ServingEngine(
         params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
@@ -627,10 +628,58 @@ def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
         **kw)
 
 
+def gpt2_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
+                        quant_group_size: int = 128, mesh=None,
+                        **kw) -> ServingEngine:
+    """ServingEngine over models/gpt2.py's paged forward (ref: the
+    reference serves GPT-2 through kernel injection,
+    deepspeed/module_inject/containers/gpt2.py)."""
+    from deepspeed_tpu.models import gpt2
+
+    if mesh is not None and any(mesh.size(ax) > 1
+                                for ax in ("model", "expert")):
+        raise NotImplementedError(
+            "sharded GPT-2 serving: thread param_specs through like the "
+            "llama TP builder — unsharded serving works today")
+    max_seq = kw.get("max_seq", 256)
+    if max_seq > cfg.max_seq_len:
+        # learned positions are HARD-bounded by the wpe table (unlike
+        # RoPE); past it jax's clamping gather would silently reuse the
+        # last position embedding
+        raise ValueError(
+            f"max_seq {max_seq} exceeds the learned position table "
+            f"(cfg.max_seq_len={cfg.max_seq_len})")
+
+    def step(params, tokens, cache):
+        return gpt2.forward_paged(params, tokens, cfg, cache, tp=False)
+
+    def chunk_step(params, tokens, cache):
+        return gpt2.forward_paged(params, tokens, cfg, cache,
+                                  continuation=True, tp=False)
+
+    if weight_dtype != "bfloat16":
+        from deepspeed_tpu.inference.quantized import quantize_for_inference
+
+        # only the matmul weights quantize: stacked biases/norm
+        # vectors and the (tiny, accuracy-critical) position table stay
+        # exact
+        params, step, chunk_step = quantize_for_inference(
+            params, step, chunk_step, weight_dtype=weight_dtype,
+            group_size=quant_group_size,
+            skip_paths=("ln1_w", "ln1_b", "ln2_w", "ln2_b", "qkv_b",
+                        "proj_b", "fc_b", "out_b", "lnf_w", "lnf_b",
+                        "wpe"))
+
+    return ServingEngine(
+        params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, **kw)
+
+
 def serving_engine(params, cfg, **kw) -> ServingEngine:
     """Model registry for serving: dispatch on the config type (ref:
     init_inference accepting any supported model).  Covers every family
     with a paged forward; others raise with the supported list."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config
     from deepspeed_tpu.models.llama import LlamaConfig
     from deepspeed_tpu.models.mixtral import MixtralConfig
 
@@ -638,6 +687,8 @@ def serving_engine(params, cfg, **kw) -> ServingEngine:
         return mixtral_serving_engine(params, cfg, **kw)
     if isinstance(cfg, LlamaConfig):
         return llama_serving_engine(params, cfg, **kw)
+    if isinstance(cfg, GPT2Config):
+        return gpt2_serving_engine(params, cfg, **kw)
     raise TypeError(
         f"no serving path for config type {type(cfg).__name__}; "
-        "supported: LlamaConfig, MixtralConfig")
+        "supported: LlamaConfig, MixtralConfig, GPT2Config")

@@ -170,3 +170,59 @@ def loss_fn(cfg: GPT2Config):
         return jnp.mean(nll)
 
     return f
+
+
+def forward_paged(params, tokens, cfg: GPT2Config, cache,
+                  interpret=None, continuation: bool = False, tp=None):
+    """Paged-KV forward for continuous-batching serving (ref: the
+    reference's GPT-2 kernel-injection container,
+    deepspeed/module_inject/containers/gpt2.py — GPT-2 is served through
+    the same inference engine as llama-family models).
+
+    Shares the per-layer paged machinery (page writes, decode/chunk
+    dispatch) with models/llama.py via
+    :func:`~deepspeed_tpu.inference.kernels.paged_attention_step`; the
+    GPT-2 block itself differs (learned positions added at the ragged
+    per-row frontier, LayerNorm+bias, fused QKV, GELU MLP, tied head).
+    tokens: [B, T] → (logits [B, T, V] f32, cache)."""
+    from deepspeed_tpu.inference.kernels import (paged_attention_step,
+                                                 paged_forward_prelude,
+                                                 pallas_paged_gate)
+
+    B, T = tokens.shape
+    nh, hd, d = cfg.n_heads, cfg.head_dim, cfg.dim
+    interpret, tp, ps, start, prefill = paged_forward_prelude(
+        cache, tokens, interpret, tp, continuation)
+    # per-sequence position offsets: ragged frontiers under continuous
+    # batching index each row's learned positions by ITS seq_len.
+    # Learned positions are HARD-bounded by the table (unlike RoPE);
+    # serving/generator builders validate max_seq <= cfg.max_seq_len.
+    positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
+    x = params["wte"][tokens] + params["wpe"][positions]
+
+    def block(x, layer):
+        lp, kp, vp = layer
+        h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.norm_eps)
+        qkv = h @ lp["qkv_w"] + lp["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, nh, hd)
+        k = k.reshape(B, T, nh, hd)
+        v = v.reshape(B, T, nh, hd)
+        use_pallas = pallas_paged_gate(
+            B, nh, hd, ps, cache.table.shape[1], kp.dtype.itemsize,
+            interpret, tp)
+        attn, kp, vp = paged_attention_step(
+            q, k, v, kp, vp, cache.table, start, ps,
+            continuation=continuation, prefill=prefill,
+            use_pallas=use_pallas, flash_force_reference=tp)
+        x = x + attn.reshape(B, T, d) @ lp["proj_w"] + lp["proj_b"]
+        h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.norm_eps)
+        h = jax.nn.gelu(h @ lp["fc_w"] + lp["fc_b"], approximate=True)
+        return x + h @ lp["out_w"] + lp["out_b"], (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(block, x,
+                                     (params["blocks"], cache.k, cache.v))
+    x = layer_norm(x, params["lnf_w"], params["lnf_b"], cfg.norm_eps)
+    logits = jnp.einsum("btd,vd->btv", x, params["wte"],
+                        preferred_element_type=jnp.float32)
+    return logits, cache._replace(k=new_k, v=new_v, seq_lens=start + T)

@@ -399,43 +399,21 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
     each row's frontier and attention runs over history + chunk (the
     FastGen split-fuse read path).  tokens: [B, T] → (logits, cache).
     """
-    from deepspeed_tpu.inference.kernels import (
-        paged_attention_reference, paged_chunk_attention,
-        paged_chunk_attention_reference, paged_decode_attention,
-        write_chunk_pages, write_prompt_pages, write_token_pages)
-    from deepspeed_tpu.ops.attention import flash_attention
+    from deepspeed_tpu.inference.kernels import (paged_attention_step,
+                                                 pallas_paged_gate)
     from deepspeed_tpu.ops.fused_ops import swiglu
+
+    from deepspeed_tpu.inference.kernels import paged_forward_prelude
 
     B, T = tokens.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
-    ps = cache.k.shape[3]   # [L, KV, P, page_size, Dh] — static from shape
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    if tp is None:
-        from deepspeed_tpu.topology import current_mesh as _cm
-
-        _ms = _cm()
-        tp = _ms is not None and _ms.size("model") > 1
-    tp_active = tp
-    start = cache.seq_lens
+    interpret, tp_active, ps, start, prefill = paged_forward_prelude(
+        cache, tokens, interpret, tp, continuation)
     x = params["embed"][tokens]
     # per-sequence position offsets: ragged frontiers under continuous
     # batching rotate each row by ITS seq_len, not row 0's
     positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
     cos, sin = rope_tables(cfg, positions)
-    prefill = T > 1 and not continuation
-    if prefill:
-        # bulk page writes start at slot 0 and attention is prompt-local:
-        # only valid on an empty cache (chunked prefill passes
-        # continuation=True instead)
-        try:
-            if int(jnp.max(start)) != 0:
-                raise ValueError(
-                    "forward_paged prefill (T>1) requires an empty cache; "
-                    "pass continuation=True for chunked prefill")
-        except (jax.errors.TracerArrayConversionError,
-                jax.errors.ConcretizationTypeError):
-            pass  # traced: caller's responsibility
 
     def block(x, layer):
         lp, kp, vp = layer
@@ -445,38 +423,13 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
         v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # One policy for both paged read paths (decode and chunked
-        # prefill), measured on v5e for decode (KERNEL_BENCH.json
-        # paged_decode_vs_gather): the XLA gather reference wins ~1.2x at
-        # small/mid shapes; the pallas kernel pays off only when the
-        # gathered K/V transient ([B, KV, mp*ps, Dh] x2, in cache dtype
-        # PLUS the f32 upcast for the einsum) is too big to materialize.
-        # Chunk shapes reuse the decode threshold pending their own
-        # on-chip microbench.
-        mp = cache.table.shape[1]
-        gather_bytes = (2 * B * nkv * mp * ps * hd
-                        * (kp.dtype.itemsize + 4))
-        # TP serving runs the XLA reference paths: GSPMD partitions jnp
-        # gathers over the model-sharded head axis for free, but cannot
-        # partition a pallas custom call (that would need shard_map
-        # plumbing through the cache donation)
-        use_pallas = (not interpret and not tp_active
-                      and gather_bytes >= (1 << 28))
-        if T > 1 and continuation:
-            kp, vp = write_chunk_pages(kp, vp, k, v, cache.table, start, ps)
-            pa = (paged_chunk_attention if use_pallas
-                  else paged_chunk_attention_reference)
-            attn = pa(q, kp, vp, cache.table, start)
-        elif prefill:
-            attn = flash_attention(q, k, v, causal=True,
-                                   force_reference=tp_active)
-            kp, vp = write_prompt_pages(kp, vp, k, v, cache.table, ps)
-        else:
-            kp, vp = write_token_pages(kp, vp, k[:, 0], v[:, 0],
-                                       cache.table, start, ps)
-            pa = (paged_decode_attention if use_pallas
-                  else paged_attention_reference)
-            attn = pa(q[:, 0], kp, vp, cache.table, start + 1)[:, None]
+        use_pallas = pallas_paged_gate(
+            B, nkv, hd, ps, cache.table.shape[1], kp.dtype.itemsize,
+            interpret, tp_active)
+        attn, kp, vp = paged_attention_step(
+            q, k, v, kp, vp, cache.table, start, ps,
+            continuation=continuation, prefill=prefill,
+            use_pallas=use_pallas, flash_force_reference=tp_active)
         x = x + attn.reshape(B, T, nh * hd) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         x = x + (swiglu(h, lp["w1"], lp["w3"]) @ lp["w2"]

@@ -1,0 +1,83 @@
+"""GPT-2 continuous-batching serving (ref: the reference serves GPT-2
+through kernel injection, deepspeed/module_inject/containers/gpt2.py).
+
+Oracles: the dense-cache forward_with_cache generator (cross-oracle for
+the paged forward) and the offline paged generator (for the scheduler).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.generation import (gpt2_generator,
+                                                gpt2_paged_generator)
+from deepspeed_tpu.inference.serving import serving_engine
+from deepspeed_tpu.models import gpt2
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = gpt2.GPT2Config.tiny(dim=64, n_layers=2, n_heads=4,
+                               max_seq_len=64)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+PROMPTS = {
+    "a": ([5, 9, 2], 6),
+    "b": ([17, 3, 3, 8, 1], 5),
+    "c": ([40, 2], 7),
+}
+
+
+def offline_expected(cfg, params, prompt, n_new):
+    gen = gpt2_paged_generator(params, cfg, page_size=8)
+    out = gen.generate(jnp.asarray([prompt], jnp.int32),
+                       max_new_tokens=n_new)
+    return [int(t) for t in np.asarray(out[0])]
+
+
+class TestGPT2Serving:
+    def test_paged_matches_dense_cache_greedy(self, model, devices):
+        """The paged forward (ragged learned positions, page writes)
+        must generate exactly like forward_with_cache."""
+        cfg, params = model
+        prompt, n_new = PROMPTS["b"]
+        paged = offline_expected(cfg, params, prompt, n_new)
+        dense = gpt2_generator(params, cfg).generate(
+            jnp.asarray([prompt], jnp.int32), max_new_tokens=n_new)
+        assert paged == [int(t) for t in np.asarray(dense[0])]
+
+    def test_registry_serves_gpt2(self, model, devices):
+        cfg, params = model
+        eng = serving_engine(params, cfg, max_batch=2, page_size=8,
+                             num_pages=32, max_seq=64, prefill_bucket=8)
+        for rid, (p, n) in PROMPTS.items():
+            eng.submit(rid, p, max_new_tokens=n)
+        outs = eng.run()
+        for rid, (p, n) in PROMPTS.items():
+            assert outs[rid] == offline_expected(cfg, params, p, n), rid
+
+    @pytest.mark.slow
+    def test_split_fuse_matches(self, model, devices):
+        cfg, params = model
+        eng = serving_engine(params, cfg, max_batch=2, page_size=8,
+                             num_pages=32, max_seq=64, prefill_chunk=4,
+                             decode_chunk=2)
+        long_prompt = list(range(2, 21))
+        eng.submit("long", long_prompt, max_new_tokens=5)
+        eng.submit("a", PROMPTS["a"][0], max_new_tokens=PROMPTS["a"][1])
+        outs = eng.run()
+        assert outs["long"] == offline_expected(cfg, params,
+                                                long_prompt, 5)
+        assert outs["a"] == offline_expected(cfg, params, *PROMPTS["a"])
+
+    def test_sharded_refused(self, model, devices):
+        from deepspeed_tpu.topology import MeshSpec
+
+        cfg, params = model
+        with pytest.raises(NotImplementedError, match="GPT-2"):
+            serving_engine(params, cfg, mesh=MeshSpec.build(
+                {"model": 2}, devices=jax.devices()[:2]))
