@@ -37,6 +37,9 @@ def main():
     ap.add_argument("--weight-dtype", default="bfloat16",
                     choices=["bfloat16", "int8"],
                     help="int8 = weight-only quantized serving")
+    ap.add_argument("--model", default="llama",
+                    choices=["llama", "mixtral", "gpt2"],
+                    help="model family served through the registry")
     ap.add_argument("--json-out", default=os.path.join(REPO, "SERVING_BENCH.json"))
     args = ap.parse_args()
 
@@ -46,21 +49,42 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
-    from deepspeed_tpu.inference.serving import llama_serving_engine
-    from deepspeed_tpu.models import llama
+    from deepspeed_tpu.inference.serving import serving_engine
+    from deepspeed_tpu.models import gpt2, llama, mixtral
 
-    if args.cpu:
-        cfg = llama.LlamaConfig.tiny(dim=64, n_layers=2, n_heads=4,
-                                     n_kv_heads=2)
+    if args.model == "mixtral":
+        mod = mixtral
+        cfg = (mixtral.MixtralConfig.tiny(dim=64, n_layers=2, n_heads=4,
+                                          n_kv_heads=2, num_experts=4)
+               if args.cpu else
+               # ~0.24B-active / ~0.76B-total MoE decode model (8
+               # experts, top-2) — smaller active than the 0.42B dense
+               # llama row; compare per-active-param, not head-to-head
+               mixtral.MixtralConfig(
+                   vocab_size=16384, dim=1024, n_layers=8, n_heads=8,
+                   n_kv_heads=4, ffn_dim=3584, num_experts=8, top_k=2,
+                   max_seq_len=1024, rope_theta=500000.0))
+    elif args.model == "gpt2":
+        mod = gpt2
+        cfg = (gpt2.GPT2Config.tiny(dim=64, n_layers=2, n_heads=4,
+                                    max_seq_len=256)
+               if args.cpu else
+               gpt2.GPT2Config(vocab_size=16384, dim=1536, n_layers=12,
+                               n_heads=12, max_seq_len=1024))
     else:
-        # ~0.5B decode model; paged decode attention is the hot kernel
-        cfg = llama.LlamaConfig(
-            vocab_size=16384, dim=1536, n_layers=12, n_heads=12,
-            n_kv_heads=4, ffn_dim=5376, max_seq_len=1024,
-            rope_theta=500000.0)
-    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        mod = llama
+        cfg = (llama.LlamaConfig.tiny(dim=64, n_layers=2, n_heads=4,
+                                      n_kv_heads=2)
+               if args.cpu else
+               # ~0.5B decode model; paged decode attention is the hot
+               # kernel
+               llama.LlamaConfig(
+                   vocab_size=16384, dim=1536, n_layers=12, n_heads=12,
+                   n_kv_heads=4, ffn_dim=5376, max_seq_len=1024,
+                   rope_theta=500000.0))
+    params = mod.init_params(jax.random.PRNGKey(0), cfg)
     max_seq = args.prompt_len + args.new_tokens
-    engine = llama_serving_engine(
+    engine = serving_engine(
         params, cfg, max_batch=args.slots, page_size=16,
         num_pages=args.slots * (-(-max_seq // 16)) + 32,
         max_seq=max_seq, prefill_bucket=args.prompt_len,
@@ -89,7 +113,8 @@ def main():
         "unit": "tokens/s",
         "detail": {
             "backend": jax.default_backend(),
-            "model_params": llama.param_count(cfg),
+            "model": args.model,
+            "model_params": mod.param_count(cfg),
             "decode_chunk": args.decode_chunk,
             "slots": args.slots,
             "requests": args.requests,

@@ -52,6 +52,14 @@ class GPT2Config:
         return cls(**kw)
 
 
+def param_count(cfg: GPT2Config) -> int:
+    d, L = cfg.dim, cfg.n_layers
+    per_layer = (d * 3 * d + 3 * d) + (d * d + d) + \
+        (d * 4 * d + 4 * d) + (4 * d * d + d) + 4 * d
+    return int(L * per_layer + cfg.vocab_size * d
+               + cfg.max_seq_len * d + 2 * d)
+
+
 def init_params(rng: jax.Array, cfg: GPT2Config, dtype=jnp.float32) -> Dict[str, Any]:
     k = jax.random.split(rng, 6)
     d, L = cfg.dim, cfg.n_layers

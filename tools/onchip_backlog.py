@@ -75,7 +75,7 @@ ITEMS = {
     "probe": ([PY, "-c", "import jax; print(jax.devices())"], 120),
     "bench": ([PY, "bench.py"], 900),
     "kernels": ([PY, "tools/kernel_bench.py"], 1800),
-    "serving": None,   # expanded below: three rows
+    "serving": None,   # expanded below: four rows (base/splitfuse/int8/moe)
     "tuning": ([PY, "tools/train_tuning_sweep.py"], 1800),
     "autotune": ([PY, "tools/autotune_onchip.py"], 2400),
     # re-run after autotune: bench.py consumes AUTOTUNE_TABLE.json's
@@ -117,7 +117,10 @@ def main():
                       "--json-out", "SERVING_SPLITFUSE.json"]),
                     ("serving_int8",
                      ["--weight-dtype", "int8",
-                      "--json-out", "SERVING_INT8.json"])):
+                      "--json-out", "SERVING_INT8.json"]),
+                    ("serving_moe",
+                     ["--model", "mixtral",
+                      "--json-out", "SERVING_MOE.json"])):
                 log[sub] = run_item(
                     sub, [PY, "bench_serving.py"] + extra, 900)
             continue
