@@ -77,8 +77,12 @@ class _Tier:
     def put(self, name: str, arr: np.ndarray) -> None:
         raise NotImplementedError
 
-    def get_submit(self, name: str, shape, dtype) -> np.ndarray:
-        """Begin fetching; returns the buffer (valid after fence())."""
+    def get_submit(self, name: str, shape, dtype, out=None) -> np.ndarray:
+        """Begin fetching; returns the buffer (valid after fence()).
+        ``out``: optional preallocated destination — honored by the NVMe
+        tier (reads land in place, letting callers batch many reads
+        into one array at full queue depth); the RAM tier returns its
+        stored array regardless."""
         raise NotImplementedError
 
     def fence_reads(self) -> None:
@@ -95,7 +99,7 @@ class _RamTier(_Tier):
     def put(self, name, arr):
         self.store[name] = arr
 
-    def get_submit(self, name, shape, dtype):
+    def get_submit(self, name, shape, dtype, out=None):
         return self.store[name]
 
 
@@ -132,9 +136,9 @@ class _NvmeTier(_Tier):
         self._wbufs[self.wslot].append(arr)  # keep alive until fence
         pool.pwrite(self._fd(pool, name, True), arr, 0)
 
-    def get_submit(self, name, shape, dtype):
+    def get_submit(self, name, shape, dtype, out=None):
         pool = self.rpools[self.rslot]
-        buf = np.empty(shape, dtype)
+        buf = np.empty(shape, dtype) if out is None else out
         pool.pread(self._fd(pool, name, False), buf, 0)
         return buf
 

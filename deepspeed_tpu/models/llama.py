@@ -482,7 +482,11 @@ def layered_model(cfg: LlamaConfig, params):
         stem={"embed": params["embed"]}, blocks=params["blocks"],
         head={"final_norm": params["final_norm"],
               "lm_head": params["lm_head"]},
-        n_layers=cfg.n_layers)
+        n_layers=cfg.n_layers,
+        assemble=lambda stem, blocks, head: {
+            "embed": stem["embed"], "blocks": blocks,
+            "final_norm": head["final_norm"],
+            "lm_head": head["lm_head"]})
 
 
 def loss_fn(cfg: LlamaConfig, n_micro: Optional[int] = None):

@@ -80,6 +80,9 @@ class LayeredModel:
     blocks: Any
     head: Any
     n_layers: int
+    # optional: (stem, blocks, head) -> the ORIGINAL param-tree layout,
+    # so master_params() round-trips into init_params-shaped models
+    assemble: Optional[Callable] = None
 
 
 class ParamStreamEngine:
@@ -513,6 +516,39 @@ class ParamStreamEngine:
         n += sum(int(np.prod(s["shape"])) for s in self._stem_state)
         n += sum(int(np.prod(s["shape"])) for s in self._head_state)
         return n
+
+    def master_params(self) -> Any:
+        """Consolidated f32 masters — in the ORIGINAL model layout when
+        the LayeredModel provides ``assemble`` (llama's does, so the
+        export round-trips into init_params-shaped models exactly like
+        InfinityEngine.master_params); otherwise the factored
+        {stem, blocks, head} dict.  NVMe reads batch per leaf: all L
+        rows submitted into one preallocated stack, one fence."""
+        nvme = isinstance(self.tier, _NvmeTier)
+        blocks = []
+        for nm, sz, shape in zip(self._bnames, self._bsizes,
+                                 self._bshapes):
+            stack = np.empty((self.L,) + shape, np.float32)
+            bufs = [self.tier.get_submit(
+                f"w_{l}_{nm}", (sz,), np.float32,
+                out=stack[l].reshape(-1)) for l in range(self.L)]
+            self.tier.fence_reads()
+            if not nvme:          # RAM tier returned its stored arrays
+                for l, b in enumerate(bufs):
+                    stack[l] = np.asarray(b).reshape(shape)
+            blocks.append(stack)
+        blocks_tree = jax.tree_util.tree_unflatten(self._btree, blocks)
+        stem, head = ({pre: jax.tree_util.tree_unflatten(
+            td, [s["w"].reshape(s["shape"]).copy() for s in st])
+            for pre, st, td in ((0, self._stem_state, self._stem_td),
+                                (1, self._head_state, self._head_td))}[i]
+            for i in (0, 1))
+        if self.layered.assemble is not None:
+            return self.layered.assemble(stem, blocks_tree, head)
+        return {"stem": stem, "blocks": blocks_tree, "head": head}
+
+    def wait_for_checkpoint(self) -> None:
+        """Drop-in parity: saves here are synchronous."""
 
     # ---------------------------------------------------------- checkpoint
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,

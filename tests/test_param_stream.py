@@ -77,6 +77,11 @@ class TestParamStream:
         _, _, e_cpu = tiny()
         l_cpu = [float(e_cpu.train_batch(batch)) for _ in range(3)]
         np.testing.assert_allclose(l_nvme, l_cpu, rtol=1e-6, atol=1e-6)
+        # the batched-aio export must read back exactly what the RAM
+        # tier holds (covers the NVMe read path of master_params)
+        for a, b in zip(jax.tree.leaves(e_nvme.master_params()),
+                        jax.tree.leaves(e_cpu.master_params())):
+            np.testing.assert_array_equal(a, b)
 
     @pytest.mark.slow
     def test_grad_accumulation(self, devices):
@@ -134,6 +139,18 @@ class TestParamStream:
         lp = [float(ep.train_batch(batch)) for _ in range(3)]
         np.testing.assert_allclose(ls, lp, rtol=2e-2, atol=2e-2)
         assert es.get_global_grad_norm() is not None
+
+    def test_master_params_export(self, devices):
+        cfg, params, eng = tiny()
+        batch = batch_for(cfg, eng)
+        eng.train_batch(batch)
+        m = eng.master_params()
+        # ORIGINAL model layout (llama's assemble hook), f32, updated
+        assert jax.tree.structure(m) == jax.tree.structure(params)
+        assert m["blocks"]["wq"].shape == params["blocks"]["wq"].shape
+        assert m["embed"].dtype == np.float32
+        assert not np.allclose(m["embed"],
+                               np.asarray(params["embed"], np.float32))
 
     def test_rejects_plain_pytree_with_scheduled_offload(self, devices):
         cfg = llama.LlamaConfig.tiny(**CFG)
