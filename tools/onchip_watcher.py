@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Detached watcher: probe the axon tunnel periodically; on the first
+success, run the on-chip backlog in stages (fast evidence first) so a
+short tunnel window still captures the headline numbers.
+
+    nohup python tools/onchip_watcher.py > /tmp/onchip_watcher.log 2>&1 &
+
+Stages run as separate onchip_backlog.py invocations so each stage's
+evidence files are durably on disk before the next (longer) stage
+starts.  Status in ONCHIP_WATCHER_STATUS.json; exits after one full
+capture (or when the tunnel drops mid-run — rerun to resume remaining
+stages).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+STATUS = os.path.join(REPO, "ONCHIP_WATCHER_STATUS.json")
+PIDFILE = "/tmp/dstpu_onchip_watcher.pid"
+
+STAGES = [
+    ("fast", ["bench", "kernels"], 3600),
+    ("serving", ["serving"], 4000),
+    ("tuning", ["tuning", "autotune", "bench_tuned"], 6000),
+    ("infinity", ["infinity"], 7500),
+    ("pstream", ["pstream"], 7500),
+]
+
+
+def put_status(**kw):
+    kw["t"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(STATUS, "w") as f:
+        json.dump(kw, f, indent=1)
+
+
+def probe() -> bool:
+    try:
+        p = subprocess.run(
+            [PY, "-c", "import jax; print(jax.devices())"],
+            timeout=120, capture_output=True, text=True)
+        return p.returncode == 0 and "Tpu" in p.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
+    if os.path.exists(PIDFILE):
+        try:
+            pid = int(open(PIDFILE).read())
+            os.kill(pid, 0)
+            print(f"watcher already running (pid {pid})")
+            return
+        except (ProcessLookupError, ValueError):
+            pass
+    with open(PIDFILE, "w") as f:
+        f.write(str(os.getpid()))
+
+    n = 0
+    while True:
+        n += 1
+        up = probe()
+        put_status(state="probing", attempt=n, chip_up=up)
+        print(f"probe {n}: chip_up={up}", flush=True)
+        if up:
+            break
+        time.sleep(600)
+
+    done = []
+    for name, items, deadline in STAGES:
+        put_status(state="running", stage=name, done=done)
+        print(f"=== stage {name}: {items}", flush=True)
+        try:
+            p = subprocess.run(
+                [PY, "tools/onchip_backlog.py", "--only",
+                 ",".join(["probe"] + items),
+                 "--log", f"ONCHIP_RUNLOG_{name}.json"],
+                cwd=REPO, timeout=deadline)
+            done.append({name: p.returncode})
+        except subprocess.TimeoutExpired:
+            done.append({name: "timeout"})
+        # tunnel may have dropped mid-capture: re-probe between stages
+        if not probe():
+            put_status(state="tunnel_dropped_midway", done=done)
+            print("tunnel dropped — stopping; rerun to resume", flush=True)
+            return
+    put_status(state="complete", done=done)
+    print("backlog capture complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()
