@@ -123,9 +123,15 @@ def main():
                       "--json-out", "SERVING_MOE.json"])):
                 log[sub] = run_item(
                     sub, [PY, "bench_serving.py"] + extra, 900)
+                with open(args.log, "w") as f:
+                    json.dump(log, f, indent=1)
             continue
         argv, deadline = ITEMS[name]
         log[name] = run_item(name, argv, deadline)
+        # incremental: a caller-killed run must not lose the outcomes of
+        # items that DID complete
+        with open(args.log, "w") as f:
+            json.dump(log, f, indent=1)
         if name == "probe" and log[name]["rc"] != 0:
             print("TPU probe failed — aborting the backlog run",
                   flush=True)

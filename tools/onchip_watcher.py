@@ -1,24 +1,32 @@
 #!/usr/bin/env python
-"""Detached watcher: probe the axon tunnel periodically; on the first
-success, run the on-chip backlog in stages (fast evidence first) so a
-short tunnel window still captures the headline numbers.
+"""Detached watcher: probe the axon tunnel periodically; on success run
+the on-chip backlog in stages (fast evidence first) so a short tunnel
+window still captures the headline numbers.
 
     nohup python tools/onchip_watcher.py > /tmp/onchip_watcher.log 2>&1 &
 
-Stages run as separate onchip_backlog.py invocations so each stage's
-evidence files are durably on disk before the next (longer) stage
-starts.  Status in ONCHIP_WATCHER_STATUS.json; exits after one full
-capture (or when the tunnel drops mid-run — rerun to resume remaining
-stages).
+- Resume: a stage that completed leaves ONCHIP_STAGE_<name>.done and is
+  skipped on rerun, so interrupted runs pick up at the first missing
+  stage instead of burning the window on re-captures.
+- The watcher owns probing (one probe recipe, imported from
+  onchip_backlog.ITEMS): it probes before EVERY stage and stops when
+  the tunnel drops — stages never run against a dead chip.
+- Stage timeouts kill the whole process GROUP (start_new_session), so a
+  wedged grandchild bench cannot survive to contend with the next stage.
+- Status in ONCHIP_WATCHER_STATUS.json; per-stage item outcomes in
+  ONCHIP_RUNLOG_<stage>.json (written incrementally by the backlog).
 """
 
+import atexit
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
 PY = sys.executable
 STATUS = os.path.join(REPO, "ONCHIP_WATCHER_STATUS.json")
 PIDFILE = "/tmp/dstpu_onchip_watcher.pid"
@@ -39,26 +47,58 @@ def put_status(**kw):
 
 
 def probe() -> bool:
+    """One probe recipe for watcher and backlog alike."""
+    from onchip_backlog import ITEMS
+
+    argv, deadline = ITEMS["probe"]
     try:
-        p = subprocess.run(
-            [PY, "-c", "import jax; print(jax.devices())"],
-            timeout=120, capture_output=True, text=True)
+        p = subprocess.run(argv, timeout=deadline, capture_output=True,
+                           text=True)
         return p.returncode == 0 and "Tpu" in p.stdout
     except subprocess.TimeoutExpired:
         return False
 
 
-def main():
+def run_stage(name, items, deadline) -> str:
+    """Run one backlog stage in its own process group; returns outcome."""
+    proc = subprocess.Popen(
+        [PY, "tools/onchip_backlog.py", "--only", ",".join(items),
+         "--log", f"ONCHIP_RUNLOG_{name}.json"],
+        cwd=REPO, start_new_session=True)
+    try:
+        rc = proc.wait(timeout=deadline)
+        return f"rc={rc}"
+    except subprocess.TimeoutExpired:
+        # kill the whole group: a wedged grandchild holding the chip
+        # must not survive into the next stage
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        return "timeout"
+
+
+def pidfile_guard() -> bool:
+    """True if another live watcher owns the pidfile."""
     if os.path.exists(PIDFILE):
         try:
             pid = int(open(PIDFILE).read())
-            os.kill(pid, 0)
-            print(f"watcher already running (pid {pid})")
-            return
-        except (ProcessLookupError, ValueError):
+            with open(f"/proc/{pid}/cmdline") as f:
+                if "onchip_watcher" in f.read():
+                    return True
+        except (ValueError, FileNotFoundError, PermissionError):
             pass
     with open(PIDFILE, "w") as f:
         f.write(str(os.getpid()))
+    atexit.register(lambda: os.path.exists(PIDFILE) and os.remove(PIDFILE))
+    return False
+
+
+def main():
+    if pidfile_guard():
+        print("watcher already running")
+        return
 
     n = 0
     while True:
@@ -72,22 +112,21 @@ def main():
 
     done = []
     for name, items, deadline in STAGES:
-        put_status(state="running", stage=name, done=done)
-        print(f"=== stage {name}: {items}", flush=True)
-        try:
-            p = subprocess.run(
-                [PY, "tools/onchip_backlog.py", "--only",
-                 ",".join(["probe"] + items),
-                 "--log", f"ONCHIP_RUNLOG_{name}.json"],
-                cwd=REPO, timeout=deadline)
-            done.append({name: p.returncode})
-        except subprocess.TimeoutExpired:
-            done.append({name: "timeout"})
-        # tunnel may have dropped mid-capture: re-probe between stages
-        if not probe():
-            put_status(state="tunnel_dropped_midway", done=done)
+        marker = os.path.join(REPO, f"ONCHIP_STAGE_{name}.done")
+        if os.path.exists(marker):
+            done.append({name: "already-done"})
+            continue
+        if not probe():          # tunnel must be up RIGHT NOW
+            put_status(state="tunnel_dropped", done=done, next_stage=name)
             print("tunnel dropped — stopping; rerun to resume", flush=True)
             return
+        put_status(state="running", stage=name, done=done)
+        print(f"=== stage {name}: {items}", flush=True)
+        outcome = run_stage(name, items, deadline)
+        done.append({name: outcome})
+        if outcome == "rc=0":
+            with open(marker, "w") as f:
+                f.write(time.strftime("%Y-%m-%dT%H:%M:%S"))
     put_status(state="complete", done=done)
     print("backlog capture complete", flush=True)
 
