@@ -44,6 +44,7 @@ class MixtralConfig:
     norm_eps: float = 1e-5
     remat: str = "none"
     attn_impl: str = "auto"
+    loss_chunk: int = 0                    # >0: fused chunked-vocab CE
 
     def __post_init__(self):
         if self.ffn_dim is None:
@@ -149,6 +150,20 @@ def param_specs(cfg: MixtralConfig) -> Dict[str, Any]:
     }
 
 
+def _attn_block(cfg: MixtralConfig, lcfg, x, lp, cos, sin):
+    """The attention half of a Mixtral block (pre-norm attn + residual),
+    shared by the training forward, the eval forward, and the layered
+    streaming block so the four paths cannot drift."""
+    B, T, _ = x.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    h = _llama.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = _llama.apply_rope((h @ lp["wq"]).reshape(B, T, nh, hd), cos, sin)
+    k = _llama.apply_rope((h @ lp["wk"]).reshape(B, T, nkv, hd), cos, sin)
+    v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
+    attn = _llama._attention(q, k, v, lcfg).reshape(B, T, nh * hd)
+    return x + attn @ lp["wo"]
+
+
 def _moe_ffn(cfg: MixtralConfig, x, lp, mesh):
     """x: [B, T, d] → (y, aux) via top-k expert dispatch."""
     def expert_fn(p, h):
@@ -175,15 +190,7 @@ def forward(params, tokens, cfg: MixtralConfig, positions=None):
 
     def block(carry, lp):
         x, aux_acc = carry
-        h = _llama.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
-        q = (h @ lp["wq"]).reshape(B, T, nh, hd)
-        k = (h @ lp["wk"]).reshape(B, T, nkv, hd)
-        v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
-        q = _llama.apply_rope(q, cos, sin)
-        k = _llama.apply_rope(k, cos, sin)
-        attn = _llama._attention(q, k, v, lcfg).reshape(B, T, nh * hd)
-        x = x + attn @ lp["wo"]
+        x = _attn_block(cfg, lcfg, x, lp, cos, sin)
         h = _llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         y, aux = _moe_ffn(cfg, h, lp, mesh)
         x = x + y
@@ -261,15 +268,7 @@ def forward_eval(params, tokens, cfg: MixtralConfig, positions=None):
     cos, sin = _llama.rope_tables(lcfg, positions)
 
     def block(x, lp):
-        h = _llama.rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
-        q = (h @ lp["wq"]).reshape(B, T, nh, hd)
-        k = (h @ lp["wk"]).reshape(B, T, nkv, hd)
-        v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
-        q = _llama.apply_rope(q, cos, sin)
-        k = _llama.apply_rope(k, cos, sin)
-        attn = _llama._attention(q, k, v, lcfg).reshape(B, T, nh * hd)
-        x = x + attn @ lp["wo"]
+        x = _attn_block(cfg, lcfg, x, lp, cos, sin)
         h = _llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         return x + _moe_ffn_dense(cfg, h, lp), None
 
@@ -314,6 +313,54 @@ def forward_with_cache(params, tokens, cfg: MixtralConfig, cache):
                         preferred_element_type=jnp.float32)
     cache = cache._replace(k=new_k, v=new_v, length=start + T)
     return logits, cache
+
+
+def layered_model(cfg: MixtralConfig, params):
+    """Factor a Mixtral tree for the layer-streaming engine — MoE x
+    parameter offload (ref: ZeRO-Infinity param swapping composed with
+    deepspeed.moe; the expert stacks dominate MoE param bytes, so layer
+    streaming is what lifts MoE past the HBM ceiling).  Each block
+    returns (x, aux_scalar): the capacity-based training MoE's
+    load-balance + z losses, which the engine adds to the total loss and
+    back-propagates with cotangent 1 — identical routing gradients to
+    the fused train step."""
+    from deepspeed_tpu.param_stream import LayeredModel
+
+    lcfg = cfg.llama_view()
+
+    def stem_fn(sp, batch):
+        return sp["embed"][batch["tokens"][:, :-1]]
+
+    def block_fn(lp, x):
+        T = x.shape[1]
+        cos, sin = _llama.rope_tables(lcfg,
+                                      jnp.arange(T, dtype=jnp.int32))
+        x = _attn_block(cfg, lcfg, x, lp, cos, sin)
+        h = _llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        y, aux = _moe_ffn(cfg, h, lp, mesh=None)
+        return x + y, (aux["moe_aux_loss"]
+                       + aux["moe_z_loss"]).astype(jnp.float32)
+
+    def head_fn(hp, x, batch):
+        from deepspeed_tpu.ops.losses import chunked_lm_loss
+
+        x = _llama.rms_norm(x, hp["final_norm"], cfg.norm_eps)
+        # loss_chunk matters MOST here: this engine's budget is a
+        # 2-layer param working set, so the [B,T,V] dense logits would
+        # dominate HBM at scale
+        return chunked_lm_loss(x, hp["lm_head"], batch["tokens"][:, 1:],
+                               chunk=cfg.loss_chunk or cfg.vocab_size)
+
+    return LayeredModel(
+        stem_fn=stem_fn, block_fn=block_fn, head_fn=head_fn,
+        stem={"embed": params["embed"]}, blocks=params["blocks"],
+        head={"final_norm": params["final_norm"],
+              "lm_head": params["lm_head"]},
+        n_layers=cfg.n_layers, block_has_aux=True,
+        assemble=lambda stem, blocks, head: {
+            "embed": stem["embed"], "blocks": blocks,
+            "final_norm": head["final_norm"],
+            "lm_head": head["lm_head"]})
 
 
 def forward_paged(params, tokens, cfg: MixtralConfig, cache,

@@ -83,6 +83,11 @@ class LayeredModel:
     # optional: (stem, blocks, head) -> the ORIGINAL param-tree layout,
     # so master_params() round-trips into init_params-shaped models
     assemble: Optional[Callable] = None
+    # True: block_fn returns (x, aux_scalar) — per-layer auxiliary loss
+    # terms (MoE load-balance + z losses) that ADD to the total loss;
+    # the backward pulls cotangent 1.0 on each layer's aux output, so
+    # router gradients flow exactly as in the fused training step
+    block_has_aux: bool = False
 
 
 class ParamStreamEngine:
@@ -218,7 +223,13 @@ class ParamStreamEngine:
 
         # donate lp: the uploaded double-buffer entry is dead after its
         # single use (re-uploaded for the backward pass)
-        self._block_jit = jax.jit(lm.block_fn, donate_argnums=(0,))
+        if lm.block_has_aux:
+            def block_fwd(lp, x, aux_acc):
+                x, aux = lm.block_fn(lp, x)
+                return x, aux_acc + aux.astype(jnp.float32)
+            self._block_jit = jax.jit(block_fwd, donate_argnums=(0,))
+        else:
+            self._block_jit = jax.jit(lm.block_fn, donate_argnums=(0,))
 
         def head_grad(hp, x, batch):
             (loss, _), (dh, dx) = jax.value_and_grad(
@@ -232,7 +243,13 @@ class ParamStreamEngine:
 
         def block_vjp(lp, x_in, dy):
             _, pull = jax.vjp(lm.block_fn, lp, x_in)
-            dlp, dx = pull(dy)
+            if lm.block_has_aux:
+                # total = head(x_L) + sum_l aux_l, so each layer's aux
+                # output carries cotangent 1; dx already carries the
+                # downstream layers' aux dependence by induction
+                dlp, dx = pull((dy, jnp.float32(1.0)))
+            else:
+                dlp, dx = pull(dy)
             return dlp, dx
 
         # donate dy → dx reuses its buffer; lp dead after the pull
@@ -300,6 +317,7 @@ class ParamStreamEngine:
             # ---------------- forward: stream layers up
             t1 = time.perf_counter()
             x = self._stem_jit(self.stem_c, mb)
+            aux_acc = jnp.float32(0.0)
             xs: List[Any] = []
             pending = self._submit_layer_read(0)
             for l in range(self.L):
@@ -312,13 +330,18 @@ class ParamStreamEngine:
                 if l + 1 < self.L:
                     pending = self._submit_layer_read(l + 1)
                 xs.append(x)
-                x = self._block_jit(lp, x)
+                if self.layered.block_has_aux:
+                    x, aux_acc = self._block_jit(lp, x, aux_acc)
+                else:
+                    x = self._block_jit(lp, x)
             ph["fwd_compute"] += time.perf_counter() - t1
 
             # ---------------- head
             t1 = time.perf_counter()
             loss, dhead, dx = self._head_grad_jit(self.head_c, x, mb)
             loss_sum += float(loss)              # sync: fwd+head done
+            if self.layered.block_has_aux:
+                loss_sum += float(aux_acc)       # total = lm + aux terms
             ph["bwd_compute"] += time.perf_counter() - t1
 
             def fetch(tree_or_list):

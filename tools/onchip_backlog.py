@@ -103,7 +103,24 @@ def main():
         raise SystemExit(f"unknown --only items {unknown}; "
                          f"valid: {','.join(ORDER)}")
 
+    # item-granular resume: completed items (rc 0 in an existing runlog)
+    # are not re-run — a rerun after a mid-stage timeout must not burn
+    # the tunnel window re-capturing what already succeeded
     log = {}
+    if os.path.exists(args.log):
+        try:
+            with open(args.log) as f:
+                log = {k: v for k, v in json.load(f).items()
+                       if v.get("rc") == 0}
+        except ValueError:
+            log = {}
+
+    def fresh(sub):
+        if sub in log:
+            print(f"--- {sub}: already captured, skipping", flush=True)
+            return False
+        return True
+
     for name in ORDER:
         if name not in picked:
             continue
@@ -121,10 +138,14 @@ def main():
                     ("serving_moe",
                      ["--model", "mixtral",
                       "--json-out", "SERVING_MOE.json"])):
+                if not fresh(sub):
+                    continue
                 log[sub] = run_item(
                     sub, [PY, "bench_serving.py"] + extra, 900)
                 with open(args.log, "w") as f:
                     json.dump(log, f, indent=1)
+            continue
+        if not fresh(name):
             continue
         argv, deadline = ITEMS[name]
         log[name] = run_item(name, argv, deadline)

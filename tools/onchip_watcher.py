@@ -123,8 +123,20 @@ def main():
         put_status(state="running", stage=name, done=done)
         print(f"=== stage {name}: {items}", flush=True)
         outcome = run_stage(name, items, deadline)
-        done.append({name: outcome})
-        if outcome == "rc=0":
+        # the backlog exits 0 even when items inside failed: the marker
+        # must key off the per-item outcomes, or a failed capture gets
+        # permanently skipped as "done"
+        ok = False
+        try:
+            with open(os.path.join(
+                    REPO, f"ONCHIP_RUNLOG_{name}.json")) as f:
+                runlog = json.load(f)
+            ok = (outcome == "rc=0" and runlog
+                  and all(v.get("rc") == 0 for v in runlog.values()))
+        except (FileNotFoundError, ValueError):
+            pass
+        done.append({name: outcome if not ok else "ok"})
+        if ok:
             with open(marker, "w") as f:
                 f.write(time.strftime("%Y-%m-%dT%H:%M:%S"))
     put_status(state="complete", done=done)
