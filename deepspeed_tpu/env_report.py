@@ -90,9 +90,20 @@ def report() -> dict:
                        "error": pallas_err},
             "csrc (aio/hostruntime)": {"ok": native_ok,
                                        "error": native_err},
+            "csrc (cpu_adam)": dict(zip(("ok", "error"),
+                                        _probe_cpu_adam())),
             "g++": {"ok": shutil.which("g++") is not None},
         },
     }
+
+
+def _probe_cpu_adam() -> tuple:
+    try:
+        from deepspeed_tpu.ops.cpu_adam import native_available
+
+        return native_available(), None
+    except Exception as e:
+        return False, str(e)
 
 
 def main(argv=None):
