@@ -120,3 +120,24 @@ def test_e2e_student_learns_teacher(devices):
         llama.forward(jax.device_get(hard_eng.state.params), toks[:, :-1],
                       cfg_s), t_logits))
     assert kd_dist < kd_hard, (kd_dist, kd_hard)
+
+
+def test_masked_distillation(devices):
+    """loss_mask flows through both the hard-CE and KD terms."""
+    k = jax.random.PRNGKey(0)
+    s = jax.random.normal(k, (2, 6, 11))
+    t = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 11))
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (2, 6), 0, 11)
+    mask = jnp.asarray([[1, 1, 1, 0, 0, 0], [1, 1, 1, 1, 1, 1]],
+                       jnp.float32)
+    full, _ = distillation_loss(s, t, tgt, alpha=0.5, temperature=2.0)
+    masked, _ = distillation_loss(s, t, tgt, alpha=0.5, temperature=2.0,
+                                  mask=mask)
+    assert float(masked) != pytest.approx(float(full), rel=1e-4)
+    # masking everything but one position equals that position's loss
+    one = jnp.zeros((2, 6)).at[0, 0].set(1.0)
+    l_one, _ = distillation_loss(s, t, tgt, alpha=0.5, temperature=2.0,
+                                 mask=one)
+    l_ref, _ = distillation_loss(s[:1, :1], t[:1, :1], tgt[:1, :1],
+                                 alpha=0.5, temperature=2.0)
+    assert float(l_one) == pytest.approx(float(l_ref), rel=1e-5)

@@ -81,3 +81,9 @@ class TestGPT2Serving:
         with pytest.raises(NotImplementedError, match="GPT-2"):
             serving_engine(params, cfg, mesh=MeshSpec.build(
                 {"model": 2}, devices=jax.devices()[:2]))
+
+
+def test_param_count_matches_init(model, devices):
+    cfg, params = model
+    actual = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(params))
+    assert gpt2.param_count(cfg) == actual
