@@ -571,30 +571,22 @@ def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
     all apply to the MoE model unchanged."""
     from deepspeed_tpu.models import mixtral
 
-    if mesh is not None and mesh.size("model") > 1:
-        raise NotImplementedError(
-            "model-axis TP MoE serving needs attention+expert shardings "
-            "threaded together — use an EXPERT-parallel mesh "
-            "({'expert': N}, ref deepspeed/moe/sharded_moe.py inference) "
-            "or serve unsharded")
-
-    # expert-parallel serving (ref: DeepSpeed-MoE inference — experts
-    # partitioned across ranks, attention replicated): the stacked
-    # [L, E, ...] expert FFNs shard over the expert axis, the dense
-    # top-k combine's vmap over E partitions with them, and XLA inserts
-    # the expert-axis psum at the weighted combine.  Attention params,
-    # router, and the KV cache stay replicated.
-    ep = mesh is not None and mesh.size("expert") > 1
-    if ep:
+    # sharded MoE serving (ref: DeepSpeed-MoE inference — expert
+    # parallelism, optionally composed with Megatron TP): the stacked
+    # [L, E, ...] expert FFNs shard over the expert axis (XLA inserts
+    # the expert psum at the weighted combine), attention shards
+    # Megatron-style over the model axis, and the KV cache's head axis
+    # follows it.  The model's own param_specs is the single source of
+    # truth for which leaves shard; unused axes are size-1 no-ops.
+    sharded = mesh is not None and any(
+        mesh.size(ax) > 1 for ax in ("model", "expert"))
+    if sharded:
         from deepspeed_tpu import zero as _zero
 
         if cfg.num_experts % mesh.size("expert"):
             raise ValueError(
                 f"num_experts {cfg.num_experts} not divisible by "
                 f"expert-axis size {mesh.size('expert')}")
-        # spec-driven placement, same as the llama TP path: the model's
-        # own param_specs is the single source of truth for which leaves
-        # shard (its model-axis entries are no-ops at model size 1)
         specs = _zero.resolve_specs(params, mixtral.param_specs(cfg))
         params = jax.tree.map(
             lambda a, sp: jax.device_put(jnp.asarray(a),
@@ -602,19 +594,20 @@ def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
             params, specs)
 
     def step(params, tokens, cache):
-        return mixtral.forward_paged(params, tokens, cfg, cache, tp=ep)
+        return mixtral.forward_paged(params, tokens, cfg, cache,
+                                     tp=sharded)
 
     def chunk_step(params, tokens, cache):
         return mixtral.forward_paged(params, tokens, cfg, cache,
-                                     continuation=True, tp=ep)
+                                     continuation=True, tp=sharded)
 
     if weight_dtype != "bfloat16":
         from deepspeed_tpu.inference.quantized import quantize_for_inference
 
-        if ep:
+        if sharded:
             raise NotImplementedError(
-                "int8 weight-only quant + expert-parallel serving: the "
-                "group-scale layout is not expert-sharded yet — pick one")
+                "int8 weight-only quant + sharded MoE serving: the "
+                "group-scale layout is not axis-sharded yet — pick one")
         # the router stays exact (int8 gate logits could flip a
         # near-tied top-k choice) and so do the stacked norm gains
         params, step, chunk_step = quantize_for_inference(

@@ -124,14 +124,35 @@ class TestMixtralServing:
             eng.submit(rid, p, max_new_tokens=n)
         assert eng.run() == want
 
+    def test_tp_x_ep_matches_unsharded(self, model, devices):
+        """TP x EP composed (ref: DeepSpeed-MoE inference's
+        tensor-slicing + expert-parallel deployment): exact tokens."""
+        from deepspeed_tpu.topology import MeshSpec
+
+        cfg, params = model
+        base = mixtral_serving_engine(
+            params, cfg, max_batch=2, page_size=8, num_pages=32,
+            max_seq=64, prefill_bucket=8)
+        for rid, (p, n) in PROMPTS.items():
+            base.submit(rid, p, max_new_tokens=n)
+        want = base.run()
+        mesh = MeshSpec.build({"model": 2, "expert": 2},
+                              devices=jax.devices()[:4])
+        eng = mixtral_serving_engine(
+            params, cfg, mesh=mesh, max_batch=2, page_size=8,
+            num_pages=32, max_seq=64, prefill_bucket=8)
+        wq_spec = eng.params["blocks"]["wq"].sharding.spec
+        w1_spec = eng.params["blocks"]["w1"].sharding.spec
+        assert any(sp == "model" for sp in wq_spec if sp is not None)
+        assert any(sp == "expert" for sp in w1_spec if sp is not None)
+        for rid, (p, n) in PROMPTS.items():
+            eng.submit(rid, p, max_new_tokens=n)
+        assert eng.run() == want
+
     def test_ep_refusals(self, model, devices):
         from deepspeed_tpu.topology import MeshSpec
 
         cfg, params = model
-        with pytest.raises(NotImplementedError, match="expert"):
-            mixtral_serving_engine(
-                params, cfg, mesh=MeshSpec.build(
-                    {"model": 2}, devices=jax.devices()[:2]))
         with pytest.raises(NotImplementedError, match="int8"):
             mixtral_serving_engine(
                 params, cfg, weight_dtype="int8",
