@@ -31,12 +31,27 @@ and step time by link bandwidth — not by the 2N bf16 residency that caps
 :class:`~deepspeed_tpu.infinity.InfinityEngine` at ~HBM/2.
 
 Gradients land in pinned host f32 buffers as the backward drains them
-(device→host overlaps the next layer's vjp), the whole-step finite check
-runs on the host, and only then does the fused C++ CPU-Adam
-(ops/cpu_adam.py) update each layer's f32 master+moments on the tier and
-emit the fresh bf16 image — so a nonfinite anywhere skips the WHOLE step
-(reference overflow semantics), at the cost of holding one f32 grad copy
-in host RAM.
+(device→host overlaps the next layer's vjp); each layer's finite check
+and grad-norm contribution are computed in the drain worker, hidden
+behind the next layer's vjp.  By default (``offload_param.overlap_step``,
+on unless gradient clipping needs the global norm first) layer ``l``'s
+fused C++ CPU-Adam update (ops/cpu_adam.py) launches the moment its
+grads finish draining, so the optimizer pass and tier writes overlap the
+vjps of layers ``l-1..0`` — the analogue of the reference overlapping
+``swap_out_and_release`` with backward compute.  Updates run on a
+dedicated worker with their OWN aio channel (per-key tier files make
+concurrent access to distinct keys safe; the read/write slot state of an
+aio channel is single-thread only).
+
+Overflow semantics: a nonfinite LOSS (the overwhelmingly common case —
+bf16 shares f32's exponent range, so compute overflow propagates to the
+loss) is detected before the backward starts and skips the whole step
+exactly, updates never launched.  The pathological remainder — a
+nonfinite grad under a finite loss — raises ``FloatingPointError`` in
+overlapped mode (earlier layers have already committed their update);
+set ``offload_param.overlap_step: false`` to restore the reference's
+strict whole-step skip at the cost of serializing the optimizer pass
+after the backward.
 
 Single-controller only for now (every device addressable from this
 process); the [dp, chunk] cross-host row partition of the optimizer-only
@@ -106,7 +121,7 @@ class ParamStreamEngine:
                 "per-process row IO, not implemented yet")
         self.layered = layered
         self.L = layered.n_layers
-        self._last_grad_norm: Optional[float] = None
+        self._last_grad_norm = 0.0     # TrainingEngine pre-step parity
         if config.curriculum is not None and config.curriculum.enabled:
             raise ValueError(
                 "curriculum_learning does not compose with the "
@@ -115,11 +130,24 @@ class ParamStreamEngine:
         off = dict(config.zero.offload_param or {})
         opt_off = config.zero.offload_optimizer or {}
         self.device_tier = off.get("device", "cpu")
+        # overlap_step: launch layer l's CPU-Adam as soon as its grads
+        # drain, behind the remaining vjps.  Clipping forces the strict
+        # path — the global norm isn't known until every grad is home.
+        self.overlap_step = bool(off.get("overlap_step", True)) and not (
+            config.gradient_clipping and config.gradient_clipping > 0)
         if self.device_tier == "nvme":
-            self.tier: _Tier = _NvmeTier(os.path.join(
-                off.get("nvme_path", "/tmp/dstpu_nvme_swap"), "pstream"))
+            swap = os.path.join(
+                off.get("nvme_path", "/tmp/dstpu_nvme_swap"), "pstream")
+            self.tier: _Tier = _NvmeTier(swap)
+            # the update worker's own aio channel: slot state is
+            # single-thread, but per-key files make cross-channel access
+            # to distinct keys safe (and same-key access is ordered by
+            # the schedule: p_l is re-written only after its last read
+            # of the step has fenced)
+            self._utier: _Tier = _NvmeTier(swap)
         else:
             self.tier = _RamTier()
+            self._utier = self.tier
 
         opt_type = config.optimizer.type.lower()
         if opt_type not in ("adam", "adamw", "fusedadam"):
@@ -203,10 +231,17 @@ class ParamStreamEngine:
         self.step_times: List[float] = []
         self.phase_times: Dict[str, float] = {}
         self._last_metrics: Dict[str, Any] = {}
+        import threading
         from concurrent.futures import ThreadPoolExecutor
 
         self._d2h_pool = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="dstpu-pstream-d2h")
+            max_workers=2, thread_name_prefix="dstpu-pstream-d2h")
+        # single worker: tier updates must serialize among themselves
+        # (one aio channel, and layer-ordered writes keep the NVMe queue
+        # depth steady); overlap comes from running BESIDE the vjps
+        self._upd_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="dstpu-pstream-upd")
+        self._ph_lock = threading.Lock()
         logger.info(
             "ParamStreamEngine: tier=%s layers=%d block-leaves=%d "
             "per-layer=%d params (%.1f MB bf16), stem+head resident",
@@ -282,12 +317,22 @@ class ParamStreamEngine:
         self.phase_times = {
             "fwd_compute": 0.0, "bwd_compute": 0.0, "param_read_wait": 0.0,
             "grad_d2h_wait": 0.0, "host_adam": 0.0, "tier_write": 0.0,
-            "total": 0.0}
+            "update_wait": 0.0, "total": 0.0}
         return self.phase_times
 
+    def _ph_add(self, ph, key, dt):
+        """Worker-thread-safe phase accounting (+= is not atomic)."""
+        with self._ph_lock:
+            ph[key] += dt
+
     def phase_report(self) -> Dict[str, float]:
-        """Per-phase seconds of the last step (phases overlap by design:
-        param reads and grad D2H run behind the layer computes)."""
+        """Per-phase seconds of the last step.  Phases overlap by design
+        (param reads and grad D2H run behind the layer computes; in
+        overlap_step mode host_adam/tier_write run behind bwd_compute),
+        so the parts can sum past 'total'.  The exposed cost of the
+        optimizer pass is 'update_wait' — how long the step blocked at
+        the end for in-flight layer updates to finish; host_adam largely
+        hidden means update_wait ≪ host_adam."""
         return dict(self.phase_times)
 
     # ------------------------------------------------------------------ step
@@ -311,8 +356,15 @@ class ParamStreamEngine:
         gbuf: List[Optional[List[np.ndarray]]] = [None] * self.L
         gstem = ghead = None
         loss_sum = 0.0
+        loss_bad = False               # nonfinite loss → exact whole-step skip
+        stats: Dict[int, tuple] = {}   # layer → (ssq, finite) of final grads
+        upd_futs: List[Any] = []       # in-flight overlapped layer updates
+        t_step = self._opt_steps + 1
+        lr = float(self.lr_schedule(jnp.int32(t_step)))
+        inv = 1.0 / accum
 
-        for mb in micros:
+        for im, mb in enumerate(micros):
+            final_mb = im == accum - 1
             mb = jax.device_put(mb, self.batch_sharding)
             # ---------------- forward: stream layers up
             t1 = time.perf_counter()
@@ -339,9 +391,15 @@ class ParamStreamEngine:
             # ---------------- head
             t1 = time.perf_counter()
             loss, dhead, dx = self._head_grad_jit(self.head_c, x, mb)
-            loss_sum += float(loss)              # sync: fwd+head done
+            mb_loss = float(loss)                # sync: fwd+head done
             if self.layered.block_has_aux:
-                loss_sum += float(aux_acc)       # total = lm + aux terms
+                mb_loss += float(aux_acc)        # total = lm + aux terms
+            loss_sum += mb_loss
+            # the loss gate: checked BEFORE any update can launch, so a
+            # compute overflow (which propagates to the loss under bf16)
+            # always skips the step exactly, even in overlap mode
+            if not math.isfinite(mb_loss):
+                loss_bad = True
             ph["bwd_compute"] += time.perf_counter() - t1
 
             def fetch(tree_or_list):
@@ -353,27 +411,30 @@ class ParamStreamEngine:
             # ---------------- backward: stream layers down
             t1 = time.perf_counter()
             pending = self._submit_layer_read(self.L - 1)
-            dfut = None
+            can_update = final_mb and not loss_bad and self.overlap_step
+            dfuts: List[Any] = []
             for l in range(self.L - 1, -1, -1):
                 if nvme:
                     tr = time.perf_counter()
                     self.tier.fence_reads()
-                    ph["param_read_wait"] += time.perf_counter() - tr
+                    # locked: the update worker adds to the same key
+                    self._ph_add(ph, "param_read_wait",
+                                 time.perf_counter() - tr)
                     self.tier.next_read_slot()
                 lp = self._bufs_to_device(pending)
                 if l - 1 >= 0:
                     pending = self._submit_layer_read(l - 1)
                 dlp, dx = self._block_vjp_jit(lp, xs[l], dx)
                 xs[l] = None
-                # drain the PREVIOUS layer's grads while this one computes
-                if dfut is not None:
-                    lprev, fut = dfut
+                # bound in-flight drains (device grad buffers alive until
+                # their fetch lands) at the pool width
+                while len(dfuts) >= 2:
                     tw = time.perf_counter()
-                    self._accum_layer(gbuf, lprev, fut.result())
+                    dfuts.pop(0).result()
                     ph["grad_d2h_wait"] += time.perf_counter() - tw
-                dfut = (l, self._d2h_pool.submit(fetch, dlp))
-            lprev, fut = dfut
-            self._accum_layer(gbuf, lprev, fut.result())
+                dfuts.append(self._d2h_pool.submit(
+                    self._drain_block, l, dlp, gbuf, final_mb, can_update,
+                    stats, upd_futs, lr, t_step, inv, ph))
             ds = self._stem_vjp_jit(self.stem_c, mb, dx)
             sflat = fetch(ds)
             gstem = sflat if gstem is None else [
@@ -381,17 +442,19 @@ class ParamStreamEngine:
             hflat = hfut.result()
             ghead = hflat if ghead is None else [
                 a + b for a, b in zip(ghead, hflat)]
+            tw = time.perf_counter()
+            for f in dfuts:
+                f.result()
+            ph["grad_d2h_wait"] += time.perf_counter() - tw
             ph["bwd_compute"] += time.perf_counter() - t1
 
-        inv = 1.0 / accum
         loss = loss_sum * inv
 
-        # ---------------- whole-step finite consensus, then update
-        finite = math.isfinite(loss) and all(
-            np.isfinite(g).all()
-            for gs in ([gstem, ghead] + [g for g in gbuf if g])
-            for g in gs)
-        if not finite:
+        # ---------------- finite consensus + unconditional grad norm
+        if loss_bad:
+            # no update launched anywhere (the loss gate precedes every
+            # drain finalize): the reference's exact whole-step skip
+            self._last_grad_norm = float("inf")
             self.global_steps += 1
             self.skipped_steps += 1
             self._last_metrics = {"loss": jnp.float32(loss),
@@ -400,23 +463,54 @@ class ParamStreamEngine:
             ph["total"] = self.step_times[-1]
             return jnp.float32(loss)
 
-        t = self._opt_steps + 1
-        lr = float(self.lr_schedule(jnp.int32(t)))
+        res_ssq, res_fin = 0.0, True
+        for gs in (gstem, ghead):
+            for g in gs:
+                res_ssq += float(np.vdot(g, g))
+                res_fin = res_fin and bool(np.isfinite(g).all())
+        ssq = res_ssq + sum(s[0] for s in stats.values())
+        norm = math.sqrt(ssq) * inv if math.isfinite(ssq) else float("inf")
+        self._last_grad_norm = norm          # every step, clip or not
+        finite = res_fin and all(s[1] for s in stats.values())
+        if not finite:
+            if upd_futs:
+                # overlap mode already committed earlier layers: torn
+                # step — unrecoverable by design, so fail loudly
+                for f in upd_futs:
+                    f.result()
+                if isinstance(self._utier, _NvmeTier):
+                    self._utier.fence_all()
+                raise FloatingPointError(
+                    "param-stream overlap_step: nonfinite gradient under "
+                    "a finite loss after some layers already updated; "
+                    "set offload_param.overlap_step=false for strict "
+                    "whole-step overflow skipping")
+            self.global_steps += 1
+            self.skipped_steps += 1
+            self._last_metrics = {"loss": jnp.float32(loss),
+                                  "overflow": jnp.int32(1)}
+            self.step_times.append(time.perf_counter() - t0)
+            ph["total"] = self.step_times[-1]
+            return jnp.float32(loss)
+
         clip = self.config.gradient_clipping
         if clip and clip > 0:
             # same semantics as engine.clip_by_global_norm, on the host
             # copies: the clipped quantity is the MEAN grad (hence inv²)
-            ssq = sum(float(np.vdot(g, g))
-                      for gs in ([gstem, ghead] + [g for g in gbuf if g])
-                      for g in gs)
-            norm = math.sqrt(ssq) * inv
             inv = inv * min(1.0, clip / (norm + 1e-6))
-            self._last_grad_norm = norm
-        self._update_blocks(gbuf, lr, t, inv, ph, nvme)
-        self._update_resident(self._stem_state, gstem, "stem", lr, t, inv,
-                              ph)
-        self._update_resident(self._head_state, ghead, "head", lr, t, inv,
-                              ph)
+        if self.overlap_step:
+            tw = time.perf_counter()
+            for f in upd_futs:
+                f.result()               # propagate worker errors too
+            if isinstance(self._utier, _NvmeTier):
+                self._utier.fence_all()
+            ph["update_wait"] += time.perf_counter() - tw
+        else:
+            self._update_blocks(gbuf, lr, t_step, inv, ph, nvme)
+        self._update_resident(self._stem_state, gstem, "stem", lr, t_step,
+                              inv, ph)
+        self._update_resident(self._head_state, ghead, "head", lr, t_step,
+                              inv, ph)
         if nvme:
             t1 = time.perf_counter()
             self.tier.fence_all()
@@ -437,6 +531,70 @@ class ParamStreamEngine:
         else:
             for a, b in zip(gbuf[l], flat):
                 a += b
+
+    def _drain_block(self, l, dlp, gbuf, finalize, can_update, stats,
+                     upd_futs, lr, t, inv, ph):
+        """d2h-pool job: land layer ``l``'s device grads on the host and
+        accumulate.  On the final microbatch also compute the layer's
+        finite bit + norm contribution (hidden behind the next vjp) and,
+        in overlap mode, hand the grads straight to the update worker —
+        the vjps of layers ``l-1..0`` then hide the CPU-Adam + tier
+        write.  Jobs for different layers touch disjoint ``gbuf``/
+        ``stats`` slots, so two drain workers never race."""
+        flat = [np.asarray(a, np.float32).reshape(-1)
+                for a in jax.tree.leaves(dlp)]
+        self._accum_layer(gbuf, l, flat)
+        if not finalize:
+            return
+        g = gbuf[l]
+        ssq = sum(float(np.vdot(a, a)) for a in g)
+        fin = all(bool(np.isfinite(a).all()) for a in g)
+        stats[l] = (ssq, fin)
+        if can_update and fin:
+            upd_futs.append(self._upd_pool.submit(
+                self._update_one_layer, l, g, gbuf, lr, t, inv, ph))
+
+    def _update_one_layer(self, l, grads, gbuf, lr, t, inv, ph):
+        """Update worker: fused CPU-Adam for one layer's leaves + fresh
+        bf16 image, on the update channel (own aio slots)."""
+        nvme = isinstance(self._utier, _NvmeTier)
+        bufs = [(self._utier.get_submit(f"w_{l}_{nm}", (sz,), np.float32),
+                 self._utier.get_submit(f"m_{l}_{nm}", (sz,), np.float32),
+                 self._utier.get_submit(f"v_{l}_{nm}", (sz,), np.float32))
+                for nm, sz in zip(self._bnames, self._bsizes)]
+        if nvme:
+            t1 = time.perf_counter()
+            self._utier.fence_reads()
+            self._ph_add(ph, "param_read_wait", time.perf_counter() - t1)
+            self._utier.next_read_slot()
+        self._apply_layer_update(self._utier, l, bufs, grads, lr, t, inv,
+                                 ph)
+        gbuf[l] = None
+
+    def _apply_layer_update(self, tier, l, bufs, grads, lr, t, inv, ph):
+        """Per-leaf adam + write-back sequence shared by the overlap
+        (update worker, ``_utier``) and strict (main thread, ``tier``)
+        paths — one body so the slot protocol can never diverge."""
+        nvme = isinstance(tier, _NvmeTier)
+        for (w, m, v), g, nm in zip(bufs, grads, self._bnames):
+            if inv != 1.0:
+                g *= inv
+            t1 = time.perf_counter()
+            w = np.asarray(w, np.float32)
+            m = np.asarray(m, np.float32)
+            v = np.asarray(v, np.float32)
+            bf16 = self._adam_inplace(w, m, v, g, lr, t, True)
+            self._ph_add(ph, "host_adam", time.perf_counter() - t1)
+            t1 = time.perf_counter()
+            if nvme:
+                tier.fence_writes()
+            tier.put(f"w_{l}_{nm}", w)
+            tier.put(f"m_{l}_{nm}", m)
+            tier.put(f"v_{l}_{nm}", v)
+            tier.put(f"p_{l}_{nm}", bf16.view(self._cdt_np))
+            if nvme:
+                tier.next_write_slot()
+            self._ph_add(ph, "tier_write", time.perf_counter() - t1)
 
     def _adam_inplace(self, w, m, v, g, lr, t, emit_bf16):
         from deepspeed_tpu.ops.cpu_adam import cpu_adam_step
@@ -467,25 +625,8 @@ class ParamStreamEngine:
             bufs = pending
             if l + 1 < self.L:
                 pending = read_layer(l + 1)
-            for (w, m, v), g, nm in zip(bufs, gbuf[l], self._bnames):
-                if inv != 1.0:
-                    g *= inv
-                t1 = time.perf_counter()
-                w = np.asarray(w, np.float32)
-                m = np.asarray(m, np.float32)
-                v = np.asarray(v, np.float32)
-                bf16 = self._adam_inplace(w, m, v, g, lr, t, True)
-                ph["host_adam"] += time.perf_counter() - t1
-                t1 = time.perf_counter()
-                if nvme:
-                    self.tier.fence_writes()
-                self.tier.put(f"w_{l}_{nm}", w)
-                self.tier.put(f"m_{l}_{nm}", m)
-                self.tier.put(f"v_{l}_{nm}", v)
-                self.tier.put(f"p_{l}_{nm}", bf16.view(self._cdt_np))
-                if nvme:
-                    self.tier.next_write_slot()
-                ph["tier_write"] += time.perf_counter() - t1
+            self._apply_layer_update(self.tier, l, bufs, gbuf[l], lr, t,
+                                     inv, ph)
             gbuf[l] = None
 
     def _update_resident(self, state, grads, which, lr, t, inv, ph) -> None:
@@ -517,8 +658,11 @@ class ParamStreamEngine:
         return [float(self.lr_schedule(jnp.int32(self._opt_steps)))]
 
     def get_global_grad_norm(self):
-        """Pre-clip global norm of the last applied mean grad (None until
-        a clipped step has run — norm is only computed when clipping)."""
+        """Pre-clip global norm of the last step's mean grad, computed
+        every step (clipping on or off) from the per-layer partial sums
+        the drain workers already produce; ``inf`` on overflow-skipped
+        steps, 0.0 before the first step — metric parity with
+        TrainingEngine."""
         return self._last_grad_norm
 
     @property
