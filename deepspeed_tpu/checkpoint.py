@@ -40,6 +40,36 @@ def _resolve_tag(load_dir: str, tag: Optional[str],
     return None
 
 
+class UniversalLeafCheckpointer:
+    """Per-leaf orbax universal layout shared by the offload engines
+    (Infinity and param-stream): each state leaf is its own orbax item
+    under ``<tag_dir>/state/<key>``, saved as a flat unpadded f32 global
+    array — restorable under any dp width, process count, or engine
+    (ref: deepspeed/checkpoint/ ds_to_universal; here it is the native
+    offload format).  One item per leaf keeps the transient footprint to
+    a single leaf, never the whole 12N state (which by the offload
+    engines' premise does not fit); orbax commits in the background, so
+    the next leaf's tier read overlaps this leaf's disk write."""
+
+    def __init__(self, tag_dir: str):
+        import orbax.checkpoint as ocp
+
+        self.state_dir = os.path.join(tag_dir, "state")
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def save(self, key: str, arr) -> None:
+        """Queue one leaf; returns immediately (background commit)."""
+        self._ckptr.save(os.path.join(self.state_dir, key), {"a": arr},
+                         force=True)
+
+    def restore(self, key: str) -> np.ndarray:
+        return np.ascontiguousarray(
+            self._ckptr.restore(os.path.join(self.state_dir, key))["a"])
+
+    def wait(self) -> None:
+        self._ckptr.wait_until_finished()
+
+
 _async_ckptr = None     # one StandardCheckpointer owns the background save
 _pending_finalize = None  # its in-flight save's meta/latest writer — module
 #                           scope, PAIRED with _async_ckptr: any engine's
@@ -170,6 +200,53 @@ def consolidate_to_fp32(engine):
                         else np.asarray(p), params)
 
 
+def _pstream_to_fp32(tag_dir: str, manifest: dict, output: str):
+    """Offline consolidation of a param-stream universal checkpoint:
+    stack each block leaf's L per-layer items into its [L, ...] array,
+    restore stem/head leaves, and write one .npz keyed by the factored
+    pytree paths recorded in the manifest (``blocks/<leaf>`` stacked,
+    ``stem/<leaf>``, ``head/<leaf>``) — engine- and model-free.  Arrays
+    stream into the zip one at a time (np.savez would hold the whole
+    fp32 model; these checkpoints exist precisely because that does not
+    fit), so the transient is a single stacked leaf.  Returns the lazy
+    NpzFile, not a dict, for the same reason."""
+    import re
+    import zipfile
+
+    ulc = UniversalLeafCheckpointer(tag_dir)
+    L = int(manifest["n_layers"])
+
+    def leaf_name(path: str) -> str:
+        # "['attn']['wq']" → "attn/wq": '/' joins segments and survives
+        # sanitization, so nested paths can never collide
+        return re.sub(r"[^0-9A-Za-z_./]", "", path.replace("][", "/"))
+
+    n = 0
+    with zipfile.ZipFile(output, "w", zipfile.ZIP_STORED,
+                         allowZip64=True) as zf:
+        def add(name, arr):
+            with zf.open(name + ".npy", "w", force_zip64=True) as f:
+                np.lib.format.write_array(f, np.ascontiguousarray(arr))
+
+        for b in manifest["blocks"]:
+            shape = tuple(b["shape"])
+            stack = np.empty((L,) + shape, np.float32)
+            for l in range(L):
+                stack[l] = ulc.restore(
+                    f"w{l:04d}_{b['key']}").reshape(shape)
+            add(f"blocks/{leaf_name(b['path'])}", stack)
+            n += 1
+        for pre in ("stem", "head"):
+            for i, s in enumerate(manifest[pre]):
+                add(f"{pre}/{leaf_name(s['path'])}",
+                    ulc.restore(f"{pre}w_{i:03d}").reshape(
+                        tuple(s["shape"])))
+                n += 1
+    logger.info("wrote %d fp32 tensors (pstream universal layout) to %s",
+                n, output)
+    return np.load(output)
+
+
 # ------------------------------------------------------------ offline CLI
 def zero_to_fp32(ckpt_dir: str, output: str, tag: Optional[str] = None):
     """Offline checkpoint → consolidated fp32 params file, engine-free
@@ -189,7 +266,8 @@ def zero_to_fp32(ckpt_dir: str, output: str, tag: Optional[str] = None):
     meta_path = os.path.join(_ckpt_dir(ckpt_dir, tag), "meta.json")
     if os.path.exists(meta_path):
         with open(meta_path) as f:
-            cfg = json.load(f).get("config", {})
+            meta = json.load(f)
+        cfg = meta.get("config", {})
         if (cfg.get("zero_optimization") or {}).get(
                 "zero_quantized_weights"):
             raise ValueError(
@@ -197,6 +275,10 @@ def zero_to_fp32(ckpt_dir: str, output: str, tag: Optional[str] = None):
                 "params are one flat [world, chunk] buffer, not a module "
                 "pytree — consolidate in-process via "
                 "engine.module_params() / consolidate_to_fp32(engine)")
+        if "pstream_universal" in meta:
+            return _pstream_to_fp32(
+                _ckpt_dir(ckpt_dir, tag), meta["pstream_universal"],
+                output)
     state_path = os.path.join(_ckpt_dir(ckpt_dir, tag), "state")
     restored = ocp.StandardCheckpointer().restore(state_path)
     params = restored["params"] if "params" in restored else restored

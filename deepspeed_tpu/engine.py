@@ -945,13 +945,14 @@ def initialize(args=None, *, loss_fn: Optional[Callable] = None,
                 "and needs the model factored for it: pass params="
                 "<model>.layered_model(cfg, params) (llama provides one); "
                 "plain pytrees only support the memory-kind offload path")
-        if optimizer is not None or param_specs is not None or has_aux:
+        if optimizer is not None or has_aux:
             raise ValueError(
-                "the param-stream engine drives its own CPU-Adam and "
-                "parameter layout; configure the optimizer via the config "
-                "block and drop param_specs/has_aux")
+                "the param-stream engine drives its own CPU-Adam; "
+                "configure the optimizer via the config block and drop "
+                "has_aux (LayeredModel.block_has_aux covers it)")
         engine = ParamStreamEngine(params, config, mesh=mesh,
-                                   lr_scheduler=lr_scheduler)
+                                   lr_scheduler=lr_scheduler,
+                                   param_specs=param_specs)
         return _finish_initialize(engine, config, training_data)
 
     if loss_fn is None or params is None:

@@ -826,25 +826,20 @@ class InfinityEngine:
         if async_save:
             logger.info("InfinityEngine.save_checkpoint: async_save "
                         "degrades to synchronous (state is host-resident)")
-        import orbax.checkpoint as ocp
-
-        from deepspeed_tpu.checkpoint import finalize_checkpoint_dir
+        from deepspeed_tpu.checkpoint import (UniversalLeafCheckpointer,
+                                              finalize_checkpoint_dir)
 
         tag = tag or f"global_step{self.global_steps}"
         d = os.path.join(save_dir, tag)
         os.makedirs(d, exist_ok=True)
         n_local = len(self._local_rows)
-        # UNIVERSAL layout (ref: deepspeed/checkpoint/ ds_to_universal):
-        # each leaf saved as its FLAT UNPADDED f32 global array via orbax
-        # — restorable under any dp width or process count (the
-        # [dp, chunk] padding is a save-time topology detail that must
-        # not leak into the format).  One orbax item PER LEAF-STATE so
-        # the transient footprint is a single sub-group leaf, never the
-        # whole 12N state (which by this engine's premise does not fit):
-        # single-controller assembles on host (no device roundtrip);
-        # multi-host lifts the leaf through the device sharded, and each
-        # process writes only the shards it owns.
-        ckptr = ocp.StandardCheckpointer()
+        # UNIVERSAL layout (shared UniversalLeafCheckpointer): each leaf
+        # a flat unpadded f32 global array — the [dp, chunk] padding is
+        # a save-time topology detail that must not leak into the
+        # format.  Single-controller assembles on host (no device
+        # roundtrip); multi-host lifts the leaf through the device
+        # sharded, and each process writes only the shards it owns.
+        ulc = UniversalLeafCheckpointer(d)
         single = jax.process_count() == 1
         for i, n in enumerate(self._names):
             for kind in ("", "m", "v"):
@@ -856,13 +851,8 @@ class InfinityEngine:
                 else:
                     item = self._flatten_fns[i](
                         self._rows_to_device(np.array(buf), i))
-                key = self._ckpt_key(kind or "w", i)
-                # no per-leaf wait: orbax serializes/commits in the
-                # background and self-orders successive saves, so the
-                # next leaf's tier read overlaps this leaf's disk commit
-                ckptr.save(os.path.join(d, "state", key), {"a": item},
-                           force=True)
-        ckptr.wait_until_finished()
+                ulc.save(self._ckpt_key(kind or "w", i), item)
+        ulc.wait()
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
         finalize_checkpoint_dir(save_dir, tag, {
@@ -879,9 +869,8 @@ class InfinityEngine:
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
         import json
 
-        import orbax.checkpoint as ocp
-
-        from deepspeed_tpu.checkpoint import _resolve_tag
+        from deepspeed_tpu.checkpoint import (UniversalLeafCheckpointer,
+                                              _resolve_tag)
 
         tag = _resolve_tag(load_dir, tag, required=False)
         if tag is None:
@@ -899,7 +888,7 @@ class InfinityEngine:
         d = os.path.join(load_dir, tag)
         legacy = os.path.join(d, "infinity_state.npz")
         arrays = np.load(legacy) if os.path.exists(legacy) else None
-        ckptr = None if arrays is not None else ocp.StandardCheckpointer()
+        ulc = None if arrays is not None else UniversalLeafCheckpointer(d)
         for i, n in enumerate(self._names):
             leaf = {}
             for kind in ("w", "m", "v"):
@@ -911,9 +900,7 @@ class InfinityEngine:
                     # one sub-group leaf at a time, no HBM transient —
                     # this is also what makes the load topology-free
                     # (any dp width / process count re-partitions below)
-                    leaf[kind] = np.ascontiguousarray(ckptr.restore(
-                        os.path.join(d, "state",
-                                     self._ckpt_key(kind, i)))["a"])
+                    leaf[kind] = ulc.restore(self._ckpt_key(kind, i))
             for kind, key in (("", "w"), ("m", "m"), ("v", "v")):
                 self.tier.put(kind + n,
                               self._partition_host(leaf[key], i))

@@ -486,7 +486,13 @@ def layered_model(cfg: LlamaConfig, params):
         assemble=lambda stem, blocks, head: {
             "embed": stem["embed"], "blocks": blocks,
             "final_norm": head["final_norm"],
-            "lm_head": head["lm_head"]})
+            "lm_head": head["lm_head"]},
+        # same split as the param factoring: TP specs (param_specs(cfg))
+        # ride into the streaming engine per-layer
+        factor_specs=lambda specs: (
+            {"embed": specs["embed"]}, specs["blocks"],
+            {"final_norm": specs["final_norm"],
+             "lm_head": specs["lm_head"]}))
 
 
 def loss_fn(cfg: LlamaConfig, n_micro: Optional[int] = None):

