@@ -58,6 +58,14 @@ def init_distributed(dist_backend: str = "xla",
     process_id = process_id if process_id is not None else int(
         os.environ.get("PROCESS_ID", os.environ.get("RANK", "0")))
     if num_processes > 1 and coordinator_address:
+        # CPU backend (multi-host simulation / DCN-only hosts): XLA's
+        # cross-process CPU collectives need an implementation picked
+        # before backend init — gloo ships in jaxlib (ref analogue: the
+        # reference's gloo fallback next to NCCL in comm/comm.py)
+        platforms = str(getattr(jax.config, "jax_platforms", "") or
+                        os.environ.get("JAX_PLATFORMS", ""))
+        if "cpu" in platforms:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

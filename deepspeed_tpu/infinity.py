@@ -417,6 +417,7 @@ class InfinityEngine:
         self._flatten_fns = [
             jax.jit(lambda a, _i=i: a.reshape(-1)[:sizes[_i]])
             for i in range(len(leaves))]
+        self._replicate_fn = None      # multi-host _assemble, lazy-built
 
         self.global_steps = 0
         self._opt_steps = 0            # advances only on finite steps
@@ -461,13 +462,22 @@ class InfinityEngine:
         return np.concatenate([rows[r] for r in sorted(rows)], axis=0)
 
     def _assemble(self, rows: np.ndarray, i: int) -> np.ndarray:
-        """Local rows → full unpadded leaf.  Single-controller only (every
-        row local); multi-host consolidation would need a cross-host
-        gather, which checkpoint/export callers should do via the sharded
-        arrays instead."""
+        """Local rows → full unpadded leaf.  Single-controller assembles
+        on host; multi-host lifts the rows through the devices and
+        replicates (an all-gather over the data axis) — COLLECTIVE: every
+        process must call in the same leaf order, which ``master_params``
+        / ``save_checkpoint`` do by construction (ref: zero_to_fp32's
+        rank-shard stitching, done here over ICI/DCN instead of files)."""
         if len(self._local_rows) != self._dp:
-            raise NotImplementedError(
-                "consolidating a partitioned tier across processes")
+            garr = self._flatten_fns[i](self._rows_to_device(rows, i))
+            if self._replicate_fn is None:
+                # cached like _flatten_fns: one compile serves every
+                # leaf and every later consolidation call
+                self._replicate_fn = jax.jit(
+                    lambda a: a, out_shardings=self.mesh.replicated())
+            rep = self._replicate_fn(garr)
+            return np.asarray(rep)[:self._sizes[i]].reshape(
+                self._shapes[i])
         return rows.reshape(-1)[:self._sizes[i]].reshape(self._shapes[i])
 
     # ------------------------------------------------------------------ step

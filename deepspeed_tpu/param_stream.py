@@ -53,9 +53,18 @@ set ``offload_param.overlap_step: false`` to restore the reference's
 strict whole-step skip at the cost of serializing the optimizer pass
 after the backward.
 
-Single-controller only for now (every device addressable from this
-process); the [dp, chunk] cross-host row partition of the optimizer-only
-engine does not apply because host updates here are whole-leaf.
+Multi-process: each process stores a contiguous 1/process_count row
+slice of every block leaf's f32 master/moment state (the per-process
+row IO analogue of the optimizer-only engine's [dp, chunk] partition),
+so the 12-byte/param state footprint splits across hosts.  Grads are
+replicated across processes (the data axis spans them, XLA's psum makes
+every drained grad global), updates run on the local rows only, and the
+fresh bf16 image is re-assembled with a per-leaf cross-process
+all-gather.  Collective ordering requires the strict update path, so
+``overlap_step`` is forced off when ``process_count > 1`` (the stem/
+head state is small and updated redundantly on every process — zero
+communication, deterministic).  The bf16 compute image on the tier
+stays full per process (it is what streams to the local devices).
 """
 
 from __future__ import annotations
@@ -121,10 +130,8 @@ class ParamStreamEngine:
         self.mesh = mesh or MeshSpec.build(
             config.mesh.axis_sizes(jax.device_count()))
         config.resolve_batch_sizes(self.mesh.size("data"))
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "param-stream engine: multi-host layer streaming needs "
-                "per-process row IO, not implemented yet")
+        self._pc = jax.process_count()
+        self._pid = jax.process_index()
         self.layered = layered
         self.L = layered.n_layers
         self._last_grad_norm = 0.0     # TrainingEngine pre-step parity
@@ -147,8 +154,16 @@ class ParamStreamEngine:
         # overlap_step: launch layer l's CPU-Adam as soon as its grads
         # drain, behind the remaining vjps.  Clipping forces the strict
         # path — the global norm isn't known until every grad is home.
+        # Multi-process also forces strict: the update path all-gathers
+        # the fresh bf16 image, and cross-process collectives must be
+        # enqueued in identical order on every process, which an update
+        # worker racing the vjp launches cannot guarantee.
         self.overlap_step = bool(off.get("overlap_step", True)) and not (
-            config.gradient_clipping and config.gradient_clipping > 0)
+            config.gradient_clipping and config.gradient_clipping > 0
+        ) and self._pc == 1
+        if self._pc > 1 and off.get("overlap_step", True):
+            logger.info("param-stream: overlap_step disabled under "
+                        "process_count=%d (collective ordering)", self._pc)
         if self.device_tier == "nvme":
             swap = os.path.join(
                 off.get("nvme_path", "/tmp/dstpu_nvme_swap"), "pstream")
@@ -201,8 +216,13 @@ class ParamStreamEngine:
         self._bshapes = [tuple(a.shape[1:]) for a in leaves]   # per-layer
         self._bsizes = [int(np.prod(s)) for s in self._bshapes]
         self._bnames = [f"b{i}" for i in range(len(leaves))]
+        # per-process row partition of the f32 state: leaf rows pad to
+        # pc x chunk and each process's tier holds one chunk (pc=1:
+        # chunk == size, no padding, identical to single-controller)
+        self._schunks = [-(-sz // self._pc) for sz in self._bsizes]
         for l in range(self.L):
-            for nm, leaf in zip(self._bnames, leaves):
+            for nm, leaf, i in zip(self._bnames, leaves,
+                                   range(len(leaves))):
                 # np.array: force copies — asarray views of jax CPU
                 # buffers must never land on the (mutating) tier
                 a = np.array(leaf[l])
@@ -210,9 +230,10 @@ class ParamStreamEngine:
                               if a.dtype != self._cdt_np else a)
                 f32 = np.ascontiguousarray(
                     a.astype(np.float32, copy=True)).reshape(-1)
-                self.tier.put(f"w_{l}_{nm}", f32)               # f32 master
-                self.tier.put(f"m_{l}_{nm}", np.zeros_like(f32))
-                self.tier.put(f"v_{l}_{nm}", np.zeros_like(f32))
+                self.tier.put(f"w_{l}_{nm}", self._local_slice(f32, i))
+                z = np.zeros(self._schunks[i], np.float32)
+                self.tier.put(f"m_{l}_{nm}", z)
+                self.tier.put(f"v_{l}_{nm}", z.copy())
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
         del leaves
@@ -371,6 +392,34 @@ class ParamStreamEngine:
             for b, s, sh in zip(bufs, self._bshapes,
                                 self._lp_shards_flat)]
         return jax.tree_util.tree_unflatten(self._btree, flat)
+
+    # ------------------------------------------- per-process row partition
+    def _local_slice(self, flat: np.ndarray, i: int) -> np.ndarray:
+        """This process's chunk of leaf ``i``'s flat array (zero-padded
+        at the tail process); pc=1 returns the array unchanged.  This is
+        on the per-leaf per-layer update path, so non-tail processes
+        slice directly — O(chunk) copy, never O(leaf)."""
+        if self._pc == 1:
+            return flat
+        c = self._schunks[i]
+        lo = self._pid * c
+        if lo + c <= flat.size:
+            return np.ascontiguousarray(flat[lo:lo + c])
+        out = np.zeros(c, flat.dtype)
+        if lo < flat.size:
+            out[:flat.size - lo] = flat[lo:]
+        return out
+
+    def _allgather_slices(self, local: np.ndarray, i: int) -> np.ndarray:
+        """Re-assemble a full flat leaf from per-process chunks (COLLECTIVE
+        across processes — every process must call in the same order)."""
+        if self._pc == 1:
+            return local
+        from jax.experimental import multihost_utils
+
+        full = np.asarray(
+            multihost_utils.process_allgather(local, tiled=True))
+        return full[:self._bsizes[i]]
 
     def _phase_reset(self):
         self.phase_times = {
@@ -620,7 +669,7 @@ class ParamStreamEngine:
         bufs = [(self._utier.get_submit(f"w_{l}_{nm}", (sz,), np.float32),
                  self._utier.get_submit(f"m_{l}_{nm}", (sz,), np.float32),
                  self._utier.get_submit(f"v_{l}_{nm}", (sz,), np.float32))
-                for nm, sz in zip(self._bnames, self._bsizes)]
+                for nm, sz in zip(self._bnames, self._schunks)]
         if nvme:
             t1 = time.perf_counter()
             self._utier.fence_reads()
@@ -633,9 +682,14 @@ class ParamStreamEngine:
     def _apply_layer_update(self, tier, l, bufs, grads, lr, t, inv, ph):
         """Per-leaf adam + write-back sequence shared by the overlap
         (update worker, ``_utier``) and strict (main thread, ``tier``)
-        paths — one body so the slot protocol can never diverge."""
+        paths — one body so the slot protocol can never diverge.
+        Multi-process: adam runs on this process's row slice and the
+        fresh bf16 image is re-assembled collectively (strict path
+        only — overlap is forced off under process_count > 1)."""
         nvme = isinstance(tier, _NvmeTier)
-        for (w, m, v), g, nm in zip(bufs, grads, self._bnames):
+        for i, ((w, m, v), g) in enumerate(zip(bufs, grads)):
+            nm = self._bnames[i]
+            g = self._local_slice(g, i)
             if inv != 1.0:
                 g *= inv
             t1 = time.perf_counter()
@@ -645,12 +699,14 @@ class ParamStreamEngine:
             bf16 = self._adam_inplace(w, m, v, g, lr, t, True)
             self._ph_add(ph, "host_adam", time.perf_counter() - t1)
             t1 = time.perf_counter()
+            full_bf16 = self._allgather_slices(
+                bf16.view(self._cdt_np), i)
             if nvme:
                 tier.fence_writes()
             tier.put(f"w_{l}_{nm}", w)
             tier.put(f"m_{l}_{nm}", m)
             tier.put(f"v_{l}_{nm}", v)
-            tier.put(f"p_{l}_{nm}", bf16.view(self._cdt_np))
+            tier.put(f"p_{l}_{nm}", full_bf16)
             if nvme:
                 tier.next_write_slot()
             self._ph_add(ph, "tier_write", time.perf_counter() - t1)
@@ -672,7 +728,7 @@ class ParamStreamEngine:
             return [(self.tier.get_submit(f"w_{l}_{nm}", (sz,), np.float32),
                      self.tier.get_submit(f"m_{l}_{nm}", (sz,), np.float32),
                      self.tier.get_submit(f"v_{l}_{nm}", (sz,), np.float32))
-                    for nm, sz in zip(self._bnames, self._bsizes)]
+                    for nm, sz in zip(self._bnames, self._schunks)]
 
         pending = read_layer(0)
         for l in range(self.L):
@@ -754,9 +810,20 @@ class ParamStreamEngine:
         rows submitted into one preallocated stack, one fence."""
         nvme = isinstance(self.tier, _NvmeTier)
         blocks = []
-        for nm, sz, shape in zip(self._bnames, self._bsizes,
-                                 self._bshapes):
+        for i, (nm, sz, shape) in enumerate(zip(
+                self._bnames, self._bsizes, self._bshapes)):
             stack = np.empty((self.L,) + shape, np.float32)
+            if self._pc > 1:
+                # COLLECTIVE consolidation: local rows → full leaf, one
+                # layer at a time, identical call order on all processes
+                for l in range(self.L):
+                    buf = self.tier.get_submit(
+                        f"w_{l}_{nm}", (self._schunks[i],), np.float32)
+                    self.tier.fence_reads()
+                    stack[l] = self._allgather_slices(
+                        np.asarray(buf), i).reshape(shape)
+                blocks.append(stack)
+                continue
             bufs = [self.tier.get_submit(
                 f"w_{l}_{nm}", (sz,), np.float32,
                 out=stack[l].reshape(-1)) for l in range(self.L)]
@@ -812,15 +879,19 @@ class ParamStreamEngine:
         os.makedirs(d, exist_ok=True)
         ulc = UniversalLeafCheckpointer(d)
         for l in range(self.L):
-            for nm, sz in zip(self._bnames, self._bsizes):
+            for i, nm in enumerate(self._bnames):
                 for kind in ("w", "m", "v"):
                     buf = self.tier.get_submit(
-                        f"{kind}_{l}_{nm}", (sz,), np.float32)
+                        f"{kind}_{l}_{nm}", (self._schunks[i],),
+                        np.float32)
                     self.tier.fence_reads()
                     # copy: the RAM tier returns its live array, which
                     # the next step's in-place CPU-Adam would mutate
-                    # under orbax's background serializer
-                    ulc.save(f"{kind}{l:04d}_{nm}", np.array(buf))
+                    # under orbax's background serializer.  Multi-
+                    # process: consolidate collectively — the universal
+                    # format stores full unpadded leaves, topology-free.
+                    item = self._allgather_slices(np.array(buf), i)
+                    ulc.save(f"{kind}{l:04d}_{nm}", item)
         for pre, st in (("stem", self._stem_state),
                         ("head", self._head_state)):
             for i, s in enumerate(st):
@@ -878,11 +949,15 @@ class ParamStreamEngine:
                 return ulc.restore(f"{pre}{kind}_{i:03d}")
 
         for l in range(self.L):
-            for nm in self._bnames:
+            for i, nm in enumerate(self._bnames):
                 w = block_item("w", l, nm)
-                self.tier.put(f"w_{l}_{nm}", w)
-                self.tier.put(f"m_{l}_{nm}", block_item("m", l, nm))
-                self.tier.put(f"v_{l}_{nm}", block_item("v", l, nm))
+                # items are full unpadded leaves; each process keeps its
+                # row slice (any process count restores any checkpoint)
+                self.tier.put(f"w_{l}_{nm}", self._local_slice(w, i))
+                self.tier.put(f"m_{l}_{nm}",
+                              self._local_slice(block_item("m", l, nm), i))
+                self.tier.put(f"v_{l}_{nm}",
+                              self._local_slice(block_item("v", l, nm), i))
                 self.tier.put(f"p_{l}_{nm}",
                               f32_to_bf16(w).view(self._cdt_np))
         fresh = {"stem": [], "head": []}

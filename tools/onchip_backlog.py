@@ -64,6 +64,15 @@ def run_item(name, argv, deadline_s):
                 with open(os.path.join(
                         REPO, f"BENCH_PREVIEW_{name}.json"), "w") as f:
                     json.dump(row, f, indent=1)
+                if row.get("detail", {}).get("backend") != "tpu":
+                    # bench.py degrades to a CPU number on probe/OOM
+                    # failure and still exits 0 — that is NOT a capture;
+                    # leave the item failed so resume re-runs it
+                    out["rc"] = 2
+                    # head-truncate: the marker must survive the cap
+                    out["stdout_tail"] = (
+                        "cpu fallback (backend != tpu) — not captured; " +
+                        out["stdout_tail"])[:800]
                 break
     except subprocess.TimeoutExpired:
         out = {"rc": None, "s": deadline_s, "stdout_tail": "TIMEOUT"}
