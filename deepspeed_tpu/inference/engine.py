@@ -31,27 +31,36 @@ class InferenceEngine:
                  param_specs: SpecTree = None,
                  dtype: str = "bfloat16", quant_group_size: int = 128):
         self.mesh = mesh or default_mesh()
+        placed = None
         if dtype == "int8":
             # weight-only quantization (ref: init_inference(dtype=int8)):
             # int8 codes + group scales resident in HBM, dequant traced
             # into the forward so it fuses with each weight's consumer
-            if param_specs is not None:
-                raise ValueError(
-                    "param_specs (TP shardings) do not compose with "
-                    "weight-only int8 yet — quantize after sharding or "
-                    "drop one of the two")
             from deepspeed_tpu.inference.quantized import (
-                quantize_for_inference)
+                quantize_for_inference, shard_quantized)
+            from deepspeed_tpu.zero import resolve_specs
 
+            # resolve TP specs against the ORIGINAL tree: after
+            # quantization the leaves are (codes, scales) pairs
+            specs = (None if param_specs is None
+                     else resolve_specs(params, param_specs))
             params, apply_fn = quantize_for_inference(
                 params, apply_fn, group_size=quant_group_size)
+            if specs is not None:
+                # int8 composes with TP: codes take the weight's spec,
+                # per-row scales shard alongside (ref: module_inject's
+                # int8 + mp_size injection)
+                placed = shard_quantized(params, specs, self.mesh)
         else:
             pcfg = PrecisionConfig(dtype=dtype)
             params = precision.cast_for_compute(params, pcfg)
         self.apply_fn = apply_fn
-        shardings = param_shardings(params, self.mesh, stage=0,
-                                    param_specs=param_specs)
-        self.params = jax.jit(lambda p: p, out_shardings=shardings)(params)
+        if placed is None:
+            shardings = param_shardings(params, self.mesh, stage=0,
+                                        param_specs=param_specs
+                                        if dtype != "int8" else None)
+            placed = jax.jit(lambda p: p, out_shardings=shardings)(params)
+        self.params = placed
 
         def fwd(p, *inputs):
             # publish this engine's mesh at trace time (model code may read

@@ -25,12 +25,16 @@ from deepspeed_tpu.ops.quant import dequantize, quantize
 class QuantizedTensor(NamedTuple):
     """A group-quantized weight: int8 codes + per-group scales.
 
-    Groups are rows of the raveled tensor (``num_groups`` divides size);
-    dequantize reproduces the original shape.
+    Groups are contiguous runs along the LAST axis, so the scale is
+    stored ``q.shape[:-1] + (groups_per_row,)`` — the same leading dims
+    as the weight.  That makes the scale shard with the weight under
+    tensor parallelism: the weight's own PartitionSpec applies to the
+    scale directly (any axis the grouped shape can't honor falls back to
+    replication — see :func:`shard_quantized`).
     """
 
     q: jnp.ndarray          # int8, original shape
-    scale: jnp.ndarray      # f32 [num_groups]
+    scale: jnp.ndarray      # f32, q.shape[:-1] + (groups_per_row,)
 
     @property
     def shape(self):
@@ -46,25 +50,29 @@ def _is_qt(x) -> bool:
 
 
 def _pick_groups(leaf, group_size: int) -> int:
+    """Number of groups for ``leaf``: the widest divisor of the LAST dim
+    that is ≤ ``group_size`` (so every group sits inside one row and the
+    scale reshapes to ``leaf.shape[:-1] + (-1,)``).  A last dim with no
+    usable divisor (e.g. prime) degrades to one group per row — wider
+    than requested, so warn when it is much wider."""
     n = leaf.size
-    g = max(1, n // max(group_size, 1))
-    while n % g:
-        g -= 1
-    if n // g > 8 * group_size and leaf.ndim >= 2:
-        # awkward factorization (e.g. a prime row count): the divisor
-        # search collapsed to huge groups, where one outlier crushes the
-        # scale for thousands of weights — fall back to per-row groups,
-        # which always divide the raveled size
-        rows = n // leaf.shape[-1]
-        g = max(g, rows)
-        if n // g > 8 * group_size:
-            from deepspeed_tpu.utils.logging import logger
+    last = leaf.shape[-1] if leaf.ndim else n
+    gs = min(max(group_size, 1), last)
+    while last % gs:
+        gs -= 1
+    if gs * 8 <= group_size:
+        # degenerate factorization: per-element-ish groups would burn 4
+        # scale bytes per weight byte — per-row groups cost less and
+        # match the reference's row-granularity fallback
+        gs = last
+    if gs > 8 * group_size:
+        from deepspeed_tpu.utils.logging import logger
 
-            logger.warning(
-                "int8 quantization of a %s-shaped weight uses groups of "
-                "%d elements (requested %d) — expect elevated "
-                "quantization error", leaf.shape, n // g, group_size)
-    return g
+        logger.warning(
+            "int8 quantization of a %s-shaped weight uses groups of "
+            "%d elements (requested %d) — expect elevated "
+            "quantization error", leaf.shape, gs, group_size)
+    return n // gs
 
 
 def quantize_params(params: Any, *, bits: int = 8, group_size: int = 128,
@@ -90,7 +98,8 @@ def quantize_params(params: Any, *, bits: int = 8, group_size: int = 128,
             return leaf
         q, scale, _ = quantize(leaf, bits=8,
                                num_groups=_pick_groups(leaf, group_size))
-        return QuantizedTensor(q=q, scale=scale)
+        return QuantizedTensor(q=q, scale=scale.reshape(
+            leaf.shape[:-1] + (-1,)))
 
     return jax.tree_util.tree_map_with_path(one, params)
 
@@ -130,6 +139,42 @@ def quantize_for_inference(params: Any, *apply_fns,
     qparams = quantize_params(params, group_size=group_size,
                               skip_paths=skip_paths)
     return (qparams, *[quantized_apply(f, dtype) for f in apply_fns])
+
+
+def shard_quantized(qparams: Any, specs: Any, mesh) -> Any:
+    """Place a (possibly partially) quantized param tree on ``mesh``.
+
+    Exact leaves and int8 codes take the weight's own PartitionSpec; the
+    per-row scale takes the SAME spec — its leading dims are the
+    weight's — except any axis whose grouped extent the mesh can't
+    divide evenly, which is replicated instead (scales are tiny, so a
+    replicated axis costs ~nothing).  This is the composition the
+    reference's module_inject performs when int8 kernels are injected
+    into TP-sharded layers (ref: deepspeed/module_inject/
+    replace_module.py + ops/quantizer).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def _scale_spec(spec, scale):
+        out = []
+        for k, ax in enumerate(tuple(spec)[:scale.ndim]):
+            names = (ax,) if isinstance(ax, str) else tuple(ax or ())
+            w = 1
+            for nm in names:
+                w *= mesh.size(nm)
+            out.append(ax if w > 1 and scale.shape[k] % w == 0 else None)
+        return P(*out)
+
+    def put(leaf, spec):
+        if _is_qt(leaf):
+            return QuantizedTensor(
+                q=jax.device_put(leaf.q, mesh.sharding(spec)),
+                scale=jax.device_put(
+                    leaf.scale,
+                    mesh.sharding(_scale_spec(spec, leaf.scale))))
+        return jax.device_put(jnp.asarray(leaf), mesh.sharding(spec))
+
+    return jax.tree.map(put, qparams, specs, is_leaf=_is_qt)
 
 
 def quantization_error(params: Any, qparams: Any) -> float:

@@ -505,6 +505,18 @@ class ServingEngine:
         return out
 
 
+def _shard_params_for_serving(params, specs_tree, mesh):
+    """Place a serving param tree (bf16 or int8-quantized) on ``mesh``
+    under the model's own TP/EP specs — int8 codes take the weight's
+    spec, per-row group scales ride alongside (ref: module_inject's
+    int8 + mp_size injection composing with TP)."""
+    from deepspeed_tpu import zero as _zero
+    from deepspeed_tpu.inference.quantized import shard_quantized
+
+    return shard_quantized(params, _zero.resolve_specs(None, specs_tree),
+                           mesh)
+
+
 def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
                          quant_group_size: int = 128, mesh=None,
                          **kw) -> ServingEngine:
@@ -537,10 +549,6 @@ def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
     if weight_dtype != "bfloat16":
         from deepspeed_tpu.inference.quantized import quantize_for_inference
 
-        if mesh is not None and mesh.size("model") > 1:
-            raise NotImplementedError(
-                "int8 weight-only quant + TP serving: the group-scale "
-                "layout is not model-axis sharded yet — pick one")
         # raises on anything but "int8" — never silently serve
         # unquantized; stacked [L, d] norm gains stay exact
         params, step, chunk_step = quantize_for_inference(
@@ -548,13 +556,9 @@ def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
             group_size=quant_group_size,
             skip_paths=("attn_norm", "mlp_norm", "final_norm"))
 
-    if mesh is not None and mesh.size("model") > 1:
-        from deepspeed_tpu import zero as _zero
-
-        specs = _zero.resolve_specs(params, llama.param_specs(cfg))
-        params = jax.tree.map(
-            lambda a, s: jax.device_put(jnp.asarray(a), mesh.sharding(s)),
-            params, specs)
+    if tp:
+        params = _shard_params_for_serving(params, llama.param_specs(cfg),
+                                           mesh)
 
     return ServingEngine(
         params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
@@ -580,18 +584,10 @@ def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
     # truth for which leaves shard; unused axes are size-1 no-ops.
     sharded = mesh is not None and any(
         mesh.size(ax) > 1 for ax in ("model", "expert"))
-    if sharded:
-        from deepspeed_tpu import zero as _zero
-
-        if cfg.num_experts % mesh.size("expert"):
-            raise ValueError(
-                f"num_experts {cfg.num_experts} not divisible by "
-                f"expert-axis size {mesh.size('expert')}")
-        specs = _zero.resolve_specs(params, mixtral.param_specs(cfg))
-        params = jax.tree.map(
-            lambda a, sp: jax.device_put(jnp.asarray(a),
-                                         mesh.sharding(sp)),
-            params, specs)
+    if sharded and cfg.num_experts % mesh.size("expert"):
+        raise ValueError(
+            f"num_experts {cfg.num_experts} not divisible by "
+            f"expert-axis size {mesh.size('expert')}")
 
     def step(params, tokens, cache):
         return mixtral.forward_paged(params, tokens, cfg, cache,
@@ -604,16 +600,18 @@ def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
     if weight_dtype != "bfloat16":
         from deepspeed_tpu.inference.quantized import quantize_for_inference
 
-        if sharded:
-            raise NotImplementedError(
-                "int8 weight-only quant + sharded MoE serving: the "
-                "group-scale layout is not axis-sharded yet — pick one")
         # the router stays exact (int8 gate logits could flip a
         # near-tied top-k choice) and so do the stacked norm gains
         params, step, chunk_step = quantize_for_inference(
             params, step, chunk_step, weight_dtype=weight_dtype,
             group_size=quant_group_size,
             skip_paths=("gate", "attn_norm", "mlp_norm", "final_norm"))
+
+    if sharded:
+        # expert FFNs shard over the expert axis, attention
+        # Megatron-style over model (ref: DeepSpeed-MoE inference)
+        params = _shard_params_for_serving(params,
+                                           mixtral.param_specs(cfg), mesh)
 
     return ServingEngine(
         params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
@@ -629,11 +627,13 @@ def gpt2_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
     deepspeed/module_inject/containers/gpt2.py)."""
     from deepspeed_tpu.models import gpt2
 
-    if mesh is not None and any(mesh.size(ax) > 1
-                                for ax in ("model", "expert")):
-        raise NotImplementedError(
-            "sharded GPT-2 serving: thread param_specs through like the "
-            "llama TP builder — unsharded serving works today")
+    # TP baked in at build time, like the llama builder: the compiled
+    # paths must not re-read the mutable ambient mesh on a retrace
+    tp = mesh is not None and mesh.size("model") > 1
+    if mesh is not None and mesh.size("expert") > 1:
+        raise ValueError(
+            "GPT-2 has no expert-parallel dimension — shard over the "
+            "model axis instead")
     max_seq = kw.get("max_seq", 256)
     if max_seq > cfg.max_seq_len:
         # learned positions are HARD-bounded by the wpe table (unlike
@@ -644,11 +644,11 @@ def gpt2_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
             f"(cfg.max_seq_len={cfg.max_seq_len})")
 
     def step(params, tokens, cache):
-        return gpt2.forward_paged(params, tokens, cfg, cache, tp=False)
+        return gpt2.forward_paged(params, tokens, cfg, cache, tp=tp)
 
     def chunk_step(params, tokens, cache):
         return gpt2.forward_paged(params, tokens, cfg, cache,
-                                  continuation=True, tp=False)
+                                  continuation=True, tp=tp)
 
     if weight_dtype != "bfloat16":
         from deepspeed_tpu.inference.quantized import quantize_for_inference
@@ -663,9 +663,17 @@ def gpt2_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
                         "proj_b", "fc_b", "out_b", "lnf_w", "lnf_b",
                         "wpe"))
 
+    if tp:
+        # ref: module_inject/containers/gpt2.py — fused qkv shards its
+        # output dim, proj/out row-parallel; biases on sharded outputs
+        # follow the column split
+        params = _shard_params_for_serving(params, gpt2.param_specs(cfg),
+                                           mesh)
+
     return ServingEngine(
         params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
-        head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, **kw)
+        head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, mesh=mesh,
+        **kw)
 
 
 def serving_engine(params, cfg, **kw) -> ServingEngine:

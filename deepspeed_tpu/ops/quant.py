@@ -61,11 +61,16 @@ def dequantize(q: jnp.ndarray, scale: jnp.ndarray,
                zero: Optional[jnp.ndarray] = None, bits: int = 8,
                dtype=jnp.float32) -> jnp.ndarray:
     shape = q.shape
+    # scale may be ND (inference quant stores it per-row,
+    # ``q.shape[:-1] + (groups,)``, so it shards with the weight); groups
+    # are raveled-contiguous either way
+    scale = scale.reshape(-1)
     g = _group(q.astype(jnp.float32), scale.shape[0])
     if zero is None:
         out = g * scale[:, None]
     else:
-        out = (g + INT_BOUNDS[bits]) * scale[:, None] + zero[:, None]
+        out = (g + INT_BOUNDS[bits]) * scale[:, None] \
+            + zero.reshape(-1)[:, None]
     return out.reshape(shape).astype(dtype)
 
 

@@ -165,8 +165,13 @@ class ParamStreamEngine:
             logger.info("param-stream: overlap_step disabled under "
                         "process_count=%d (collective ordering)", self._pc)
         if self.device_tier == "nvme":
+            # per-process subdir (like infinity.py's tiers): each
+            # process's tier holds a DIFFERENT row-partition of the
+            # master state, so co-hosted processes sharing an nvme_path
+            # must not write the same leaf files
             swap = os.path.join(
-                off.get("nvme_path", "/tmp/dstpu_nvme_swap"), "pstream")
+                off.get("nvme_path", "/tmp/dstpu_nvme_swap"),
+                f"pstream_proc{self._pid}")
             self.tier: _Tier = _NvmeTier(swap)
             # the update worker's own aio channel: slot state is
             # single-thread, but per-key files make cross-channel access
@@ -404,7 +409,10 @@ class ParamStreamEngine:
         c = self._schunks[i]
         lo = self._pid * c
         if lo + c <= flat.size:
-            return np.ascontiguousarray(flat[lo:lo + c])
+            # .copy(), not a view: a contiguous slice would keep the
+            # FULL leaf alive via .base for the tier's lifetime,
+            # defeating the 1/pc state-footprint split
+            return flat[lo:lo + c].copy()
         out = np.zeros(c, flat.dtype)
         if lo < flat.size:
             out[:flat.size - lo] = flat[lo:]

@@ -61,6 +61,35 @@ class TestInt8Inference:
         agree = float(jnp.mean(jnp.argmax(got, -1) == jnp.argmax(ref, -1)))
         assert agree > 0.9, agree
 
+    def test_init_inference_int8_composes_with_tp_specs(self, model,
+                                                        devices):
+        """int8 + param_specs through the generic entrypoint (ref:
+        init_inference(dtype=int8, mp_size>1)): codes and per-row
+        scales land model-axis sharded and logits match the replicated
+        int8 engine bit-for-bit — sharding is an execution strategy."""
+        from deepspeed_tpu.topology import MeshSpec, set_current_mesh
+
+        cfg, params = model
+        toks = jnp.asarray(np.random.default_rng(1).integers(
+            0, cfg.vocab_size, (2, 12)), jnp.int32)
+        fwd = lambda p, t: llama.forward(p, t, cfg)
+        want = dstpu.init_inference(apply_fn=fwd, params=params,
+                                    dtype="int8",
+                                    quant_group_size=16)(toks)
+        mesh = MeshSpec.build({"model": 2}, devices=jax.devices()[:2])
+        try:
+            eng = dstpu.init_inference(
+                apply_fn=fwd, params=params, dtype="int8",
+                quant_group_size=16, mesh=mesh,
+                param_specs=llama.param_specs(cfg))
+            wq = eng.params["blocks"]["wq"]
+            assert "model" in [s for s in wq.q.sharding.spec if s]
+            assert "model" in [s for s in wq.scale.sharding.spec if s]
+            got = eng(toks)
+        finally:
+            set_current_mesh(None)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
     @pytest.mark.slow
     def test_int8_serving_runs_and_matches_int8_offline(self, model, devices):
         from deepspeed_tpu.inference.serving import llama_serving_engine

@@ -74,13 +74,31 @@ class TestGPT2Serving:
                                                 long_prompt, 5)
         assert outs["a"] == offline_expected(cfg, params, *PROMPTS["a"])
 
-    def test_sharded_refused(self, model, devices):
-        from deepspeed_tpu.topology import MeshSpec
+    def test_tp2_matches_unsharded(self, model, devices):
+        """TP-sharded GPT-2 serving (ref: module_inject/containers/
+        gpt2.py — fused qkv column-parallel, proj/out row-parallel) is
+        an execution strategy: served tokens match exactly."""
+        from deepspeed_tpu.topology import MeshSpec, set_current_mesh
 
         cfg, params = model
-        with pytest.raises(NotImplementedError, match="GPT-2"):
-            serving_engine(params, cfg, mesh=MeshSpec.build(
-                {"model": 2}, devices=jax.devices()[:2]))
+        kw = dict(max_batch=2, page_size=8, num_pages=32, max_seq=64,
+                  prefill_bucket=8)
+        base = serving_engine(params, cfg, **kw)
+        for rid, (p, n) in PROMPTS.items():
+            base.submit(rid, p, max_new_tokens=n)
+        want = base.run()
+
+        mesh = MeshSpec.build({"model": 2}, devices=jax.devices()[:2])
+        try:
+            eng = serving_engine(params, cfg, mesh=mesh, **kw)
+            spec = eng.params["blocks"]["qkv_w"].sharding.spec
+            assert "model" in [s for s in spec if s]
+            for rid, (p, n) in PROMPTS.items():
+                eng.submit(rid, p, max_new_tokens=n)
+            got = eng.run()
+        finally:
+            set_current_mesh(None)
+        assert got == want
 
 
 def test_param_count_matches_init(model, devices):

@@ -149,15 +149,38 @@ class TestMixtralServing:
             eng.submit(rid, p, max_new_tokens=n)
         assert eng.run() == want
 
-    def test_ep_refusals(self, model, devices):
-        from deepspeed_tpu.topology import MeshSpec
+    def test_int8_ep2_matches_unsharded_int8(self, model, devices):
+        """int8 weight-only quant composes with expert parallelism: the
+        expert FFN codes shard over the expert axis and their per-row
+        scales ride along (ref: DeepSpeed-MoE inference + int8 module
+        injection).  Served tokens match the unsharded int8 engine."""
+        from deepspeed_tpu.inference.quantized import QuantizedTensor
+        from deepspeed_tpu.topology import MeshSpec, set_current_mesh
 
         cfg, params = model
-        with pytest.raises(NotImplementedError, match="int8"):
-            mixtral_serving_engine(
-                params, cfg, weight_dtype="int8",
-                mesh=MeshSpec.build({"expert": 2},
-                                    devices=jax.devices()[:2]))
+        kw = dict(max_batch=2, page_size=8, num_pages=32, max_seq=64,
+                  prefill_bucket=8)
+        base = mixtral_serving_engine(params, cfg, weight_dtype="int8",
+                                      quant_group_size=16, **kw)
+        for rid, (p, n) in PROMPTS.items():
+            base.submit(rid, p, max_new_tokens=n)
+        want = base.run()
+
+        mesh = MeshSpec.build({"expert": 2}, devices=jax.devices()[:2])
+        try:
+            eng = mixtral_serving_engine(params, cfg, mesh=mesh,
+                                         weight_dtype="int8",
+                                         quant_group_size=16, **kw)
+            w1 = eng.params["blocks"]["w1"]
+            assert isinstance(w1, QuantizedTensor)
+            assert "expert" in [s for s in w1.q.sharding.spec if s]
+            assert "expert" in [s for s in w1.scale.sharding.spec if s]
+            for rid, (p, n) in PROMPTS.items():
+                eng.submit(rid, p, max_new_tokens=n)
+            got = eng.run()
+        finally:
+            set_current_mesh(None)
+        assert got == want
 
     def test_registry_dispatch(self, model, devices):
         """Pin the dispatch itself: serving a Mixtral through the generic

@@ -76,15 +76,30 @@ class TestTPServing:
             set_current_mesh(None)
         assert got == want
 
-    def test_int8_plus_tp_refused(self, model, devices):
+    def test_int8_tp2_matches_unsharded_int8(self, model, devices):
+        """int8 weight-only quant composes with TP (ref: module_inject
+        int8+TP injection): per-row group scales shard with their
+        weights, so served tokens match the unsharded int8 engine
+        exactly — same codes, same scales, different placement."""
         cfg, params = model
+        base = llama_serving_engine(params, cfg, weight_dtype="int8",
+                                    quant_group_size=16, **KW)
+        want = serve_all(base)
+
         mesh = MeshSpec.build({"model": 2}, devices=jax.devices()[:2])
         try:
-            with pytest.raises(NotImplementedError, match="int8"):
-                llama_serving_engine(params, cfg, mesh=mesh,
-                                     weight_dtype="int8", **KW)
+            eng = llama_serving_engine(params, cfg, mesh=mesh,
+                                       weight_dtype="int8",
+                                       quant_group_size=16, **KW)
+            # the int8 codes AND their group scales are genuinely
+            # model-axis sharded (column-parallel wq: output dim)
+            qt = eng.params["blocks"]["wq"]
+            assert "model" in [s for s in qt.q.sharding.spec if s]
+            assert "model" in [s for s in qt.scale.sharding.spec if s]
+            got = serve_all(eng)
         finally:
             set_current_mesh(None)
+        assert got == want
 
     def test_indivisible_kv_heads_refused(self, devices):
         cfg = llama.LlamaConfig.tiny(dim=48, n_layers=1, n_heads=3,
