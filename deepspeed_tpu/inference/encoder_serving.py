@@ -1,0 +1,202 @@
+"""Encoder-model serving: shape-bucketed micro-batching (ref: the
+reference kernel-injects BERT-class encoders and serves them through
+``init_inference`` — deepspeed/module_inject/containers/bert.py; its
+inference engine covers non-autoregressive models as a first-class
+case).
+
+TPU design: an encoder has no decode loop, so FastGen-style
+iteration-level scheduling degenerates to LOT BATCHING — queued
+requests are grouped into static ``(max_batch, bucket_len)`` lots, one
+jit per bucket length, no retraces.  Padding rows/positions are masked
+(the pad tokens attend only each other and their outputs are sliced
+off on the host), so a request's result is independent of its
+lot-mates — the encoder analogue of continuous batching's isolation
+guarantee.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class EncoderServingEngine:
+    """Batched scoring over a pure ``apply_fn(params, tokens, mask)``.
+
+    ``apply_fn`` returns a per-row array (``[B, ...]``); ``run()`` hands
+    each request its own row (sliced to its true length when the output
+    carries the sequence axis, i.e. ``per_token=True``).
+    """
+
+    def __init__(self, apply_fn: Callable, params: Any, *,
+                 buckets: Tuple[int, ...] = (32, 64, 128),
+                 max_batch: int = 8, per_token: bool = False,
+                 mesh=None, specs_tree=None,
+                 weight_dtype: str = "bfloat16",
+                 quant_group_size: int = 128, quant_skip_paths=()):
+        if weight_dtype != "bfloat16":
+            from deepspeed_tpu.inference.quantized import (
+                quantize_for_inference)
+
+            params, apply_fn = quantize_for_inference(
+                params, apply_fn, weight_dtype=weight_dtype,
+                group_size=quant_group_size,
+                skip_paths=quant_skip_paths)
+        sharded = mesh is not None and any(
+            mesh.size(ax) > 1 for ax in ("model", "expert"))
+        if sharded:
+            if specs_tree is None:
+                raise ValueError(
+                    "sharded encoder serving needs the model's "
+                    "param_specs (specs_tree)")
+            from deepspeed_tpu.inference.serving import (
+                _shard_params_for_serving)
+
+            params = _shard_params_for_serving(params, specs_tree, mesh)
+        self.params = params
+        self.per_token = per_token
+        self.max_batch = int(max_batch)
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets:
+            raise ValueError("need at least one bucket length")
+        self._fn = jax.jit(apply_fn)
+        self.queue: "collections.deque" = collections.deque()
+        self.stats = {"lots": 0, "rows_padded": 0, "requests": 0}
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"request length {n} exceeds the largest bucket "
+            f"{self.buckets[-1]}")
+
+    def submit(self, req_id, tokens) -> None:
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise ValueError(f"request {req_id}: empty input")
+        self._bucket(len(tokens))  # validate now, not at lot time
+        self.queue.append((req_id, tokens))
+        self.stats["requests"] += 1
+
+    def run(self) -> Dict[Any, np.ndarray]:
+        """Drain the queue; returns {req_id: output row}.
+
+        Lots are formed greedily in arrival order from requests sharing
+        a bucket — a long request never blocks short ones behind it
+        (they board an earlier short-bucket lot)."""
+        out: Dict[Any, np.ndarray] = {}
+        while self.queue:
+            lead_bucket = self._bucket(len(self.queue[0][1]))
+            lot, keep = [], collections.deque()
+            while self.queue and len(lot) < self.max_batch:
+                rid, toks = self.queue.popleft()
+                if self._bucket(len(toks)) == lead_bucket:
+                    lot.append((rid, toks))
+                else:
+                    keep.append((rid, toks))
+            keep.extend(self.queue)
+            self.queue = keep
+
+            B, T = self.max_batch, lead_bucket
+            tokens = np.zeros((B, T), np.int32)
+            mask = np.zeros((B, T), np.int32)
+            for r, (_, toks) in enumerate(lot):
+                tokens[r, :len(toks)] = toks
+                mask[r, :len(toks)] = 1
+            res = np.asarray(self._fn(self.params, jnp.asarray(tokens),
+                                      jnp.asarray(mask)))
+            self.stats["lots"] += 1
+            self.stats["rows_padded"] += B - len(lot)
+            for r, (rid, toks) in enumerate(lot):
+                row = res[r]
+                out[rid] = row[:len(toks)] if self.per_token else row
+        return out
+
+
+def bert_serving_engine(params, cfg, head: str = "pooled", mesh=None,
+                        weight_dtype: str = "bfloat16", **kw):
+    """Serve a BERT encoder (ref: module_inject/containers/bert.py).
+
+    ``head``: "pooled" ([CLS] pooler vector per request), "mlm"
+    (per-token vocab logits), or "hidden" (per-token hidden states).
+    Composes with TP over the model axis and with int8 weight-only
+    quantization like the decoder builders.
+    """
+    from deepspeed_tpu.models import bert
+
+    if head not in ("pooled", "mlm", "hidden"):
+        raise ValueError(f"unknown head {head!r}: pooled | mlm | hidden")
+
+    def apply(p, tokens, mask):
+        hidden = bert.forward(p, tokens, cfg, attention_mask=mask)
+        if head == "pooled":
+            return bert.pooled_output(p, hidden)
+        if head == "mlm":
+            return bert.mlm_logits(p, hidden, cfg)
+        return hidden
+
+    # every default bucket is clamped to the learned position table —
+    # a request the model cannot encode must fail at submit(), not when
+    # its lot pads past pos_embed
+    kw.setdefault("buckets", tuple(sorted(
+        {min(32, cfg.max_seq_len), min(64, cfg.max_seq_len),
+         cfg.max_seq_len})))
+    return EncoderServingEngine(
+        apply, params, per_token=head != "pooled", mesh=mesh,
+        specs_tree=bert.param_specs(cfg), weight_dtype=weight_dtype,
+        # norm scales/biases, biases, the tiny embeddings tables'
+        # companions — everything that is not a matmul weight stays
+        # exact (embed itself is the tied MLM decoder: keep it exact
+        # so logits stay trustworthy)
+        quant_skip_paths=("scale", "bias", "b_in", "b_out", "bqkv", "bo",
+                          "attn_norm_scale", "attn_norm_bias",
+                          "mlp_norm_scale", "mlp_norm_bias",
+                          "embed", "pos_embed", "type_embed", "mlm_bias",
+                          "b"),
+        **kw)
+
+
+class CNNServingEngine:
+    """Batched image scoring for the CNN family — fixed input shape, so
+    the only scheduling is lot formation up to ``max_batch``."""
+
+    def __init__(self, params, *, cfg=None, max_batch: int = 8,
+                 image_shape: Tuple[int, int, int] = (32, 32, 3)):
+        from deepspeed_tpu.models import cnn
+
+        self.cfg = cfg
+        self.params = params
+        self.max_batch = int(max_batch)
+        self.image_shape = tuple(image_shape)
+        self._fn = jax.jit(cnn.forward)
+        self.queue: "collections.deque" = collections.deque()
+        self.stats = {"lots": 0, "requests": 0}
+
+    def submit(self, req_id, image) -> None:
+        image = np.asarray(image, np.float32)
+        if image.shape != self.image_shape:
+            raise ValueError(
+                f"request {req_id}: image shape {image.shape} != "
+                f"{self.image_shape}")
+        self.queue.append((req_id, image))
+        self.stats["requests"] += 1
+
+    def run(self) -> Dict[Any, np.ndarray]:
+        out: Dict[Any, np.ndarray] = {}
+        while self.queue:
+            lot = [self.queue.popleft()
+                   for _ in range(min(self.max_batch, len(self.queue)))]
+            batch = np.zeros((self.max_batch,) + self.image_shape,
+                             np.float32)
+            for r, (_, img) in enumerate(lot):
+                batch[r] = img
+            logits = np.asarray(self._fn(self.params, jnp.asarray(batch)))
+            self.stats["lots"] += 1
+            for r, (rid, _) in enumerate(lot):
+                out[rid] = logits[r]
+        return out

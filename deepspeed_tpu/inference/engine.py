@@ -56,9 +56,10 @@ class InferenceEngine:
             params = precision.cast_for_compute(params, pcfg)
         self.apply_fn = apply_fn
         if placed is None:
+            # reached with dtype != int8, or int8 + no specs (the int8 +
+            # specs case produced `placed` above)
             shardings = param_shardings(params, self.mesh, stage=0,
-                                        param_specs=param_specs
-                                        if dtype != "int8" else None)
+                                        param_specs=param_specs)
             placed = jax.jit(lambda p: p, out_shardings=shardings)(params)
         self.params = placed
 

@@ -676,10 +676,14 @@ def gpt2_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
         **kw)
 
 
-def serving_engine(params, cfg, **kw) -> ServingEngine:
+def serving_engine(params, cfg, **kw):
     """Model registry for serving: dispatch on the config type (ref:
-    init_inference accepting any supported model).  Covers every family
-    with a paged forward; others raise with the supported list."""
+    init_inference accepting any supported model).  Decoder LMs get the
+    paged continuous-batching engine; encoder families get the
+    lot-batching :class:`~deepspeed_tpu.inference.encoder_serving.
+    EncoderServingEngine` (same submit/run surface, no decode loop)."""
+    from deepspeed_tpu.models.bert import BertConfig
+    from deepspeed_tpu.models.cnn import CNNConfig
     from deepspeed_tpu.models.gpt2 import GPT2Config
     from deepspeed_tpu.models.llama import LlamaConfig
     from deepspeed_tpu.models.mixtral import MixtralConfig
@@ -690,6 +694,23 @@ def serving_engine(params, cfg, **kw) -> ServingEngine:
         return llama_serving_engine(params, cfg, **kw)
     if isinstance(cfg, GPT2Config):
         return gpt2_serving_engine(params, cfg, **kw)
+    if isinstance(cfg, BertConfig):
+        from deepspeed_tpu.inference.encoder_serving import (
+            bert_serving_engine)
+
+        return bert_serving_engine(params, cfg, **kw)
+    if isinstance(cfg, CNNConfig):
+        from deepspeed_tpu.inference.encoder_serving import (
+            CNNServingEngine)
+
+        for unsupported in ("mesh", "weight_dtype"):
+            if kw.get(unsupported) not in (None, "bfloat16"):
+                raise NotImplementedError(
+                    f"CNN serving does not support {unsupported!r} — "
+                    "it is a fixed-shape batched scorer")
+            kw.pop(unsupported, None)
+        return CNNServingEngine(params, cfg=cfg, **kw)
     raise TypeError(
         f"no serving path for config type {type(cfg).__name__}; "
-        "supported: LlamaConfig, MixtralConfig, GPT2Config")
+        "supported: LlamaConfig, MixtralConfig, GPT2Config, BertConfig, "
+        "CNNConfig")

@@ -1,0 +1,186 @@
+"""Encoder-family serving (ref: the reference kernel-injects BERT-class
+encoders through init_inference — module_inject/containers/bert.py —
+and serves CNN/vision models through the same engine).
+
+Oracle: each request run ALONE through the model's plain forward —
+lot-batching with padded rows/positions must not change any request's
+result beyond float tolerance.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.encoder_serving import (CNNServingEngine,
+                                                     bert_serving_engine)
+from deepspeed_tpu.inference.serving import serving_engine
+from deepspeed_tpu.models import bert, cnn
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = bert.BertConfig.tiny()
+    params = bert.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _reqs(cfg, lens, seed=0):
+    rng = np.random.default_rng(seed)
+    return {f"r{i}": rng.integers(1, cfg.vocab_size, n).tolist()
+            for i, n in enumerate(lens)}
+
+
+def _solo_hidden(cfg, params, toks):
+    t = jnp.asarray([toks], jnp.int32)
+    m = jnp.ones_like(t)
+    return bert.forward(params, t, cfg, attention_mask=m)
+
+
+class TestBertServing:
+    def test_pooled_matches_solo_forward(self, model, devices):
+        cfg, params = model
+        eng = bert_serving_engine(params, cfg, head="pooled", max_batch=4)
+        reqs = _reqs(cfg, [5, 12, 33, 7, 40, 3])
+        for rid, toks in reqs.items():
+            eng.submit(rid, toks)
+        out = eng.run()
+        assert set(out) == set(reqs)
+        for rid, toks in reqs.items():
+            want = bert.pooled_output(params,
+                                      _solo_hidden(cfg, params, toks))[0]
+            np.testing.assert_allclose(out[rid], np.asarray(want),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_mlm_head_slices_to_true_length(self, model, devices):
+        cfg, params = model
+        eng = bert_serving_engine(params, cfg, head="mlm", max_batch=2)
+        reqs = _reqs(cfg, [6, 17], seed=1)
+        for rid, toks in reqs.items():
+            eng.submit(rid, toks)
+        out = eng.run()
+        for rid, toks in reqs.items():
+            assert out[rid].shape == (len(toks), cfg.vocab_size)
+            want = bert.mlm_logits(
+                params, _solo_hidden(cfg, params, toks), cfg)[0]
+            np.testing.assert_allclose(out[rid], np.asarray(want),
+                                       rtol=2e-4, atol=2e-3)
+
+    def test_lot_formation_buckets_and_isolation(self, model, devices):
+        """A long request must not drag short ones into its bucket, and
+        results are order-independent."""
+        cfg, params = model
+        eng = bert_serving_engine(params, cfg, head="pooled", max_batch=8,
+                                  buckets=(8, 64))
+        reqs = _reqs(cfg, [4, 40, 5, 6], seed=2)
+        for rid, toks in reqs.items():
+            eng.submit(rid, toks)
+        out = eng.run()
+        # 3 short requests share the 8-bucket lot; the long one rides
+        # its own 64-bucket lot
+        assert eng.stats["lots"] == 2
+        for rid, toks in reqs.items():
+            want = bert.pooled_output(params,
+                                      _solo_hidden(cfg, params, toks))[0]
+            np.testing.assert_allclose(out[rid], np.asarray(want),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_registry_dispatches_bert(self, model, devices):
+        cfg, params = model
+        eng = serving_engine(params, cfg)
+        eng.submit("x", [3, 5, 8])
+        out = eng.run()
+        assert out["x"].shape == (cfg.dim,)
+
+    def test_oversize_request_refused(self, model, devices):
+        cfg, params = model
+        eng = bert_serving_engine(params, cfg)
+        with pytest.raises(ValueError, match="bucket"):
+            eng.submit("x", list(range(1, cfg.max_seq_len + 10)))
+
+    def test_default_buckets_clamped_to_position_table(self, devices):
+        """A model shorter than the default bucket ladder must refuse a
+        request past pos_embed AT SUBMIT, not crash at lot time."""
+        cfg = bert.BertConfig.tiny(max_seq_len=16)
+        params = bert.init_params(jax.random.PRNGKey(2), cfg)
+        eng = bert_serving_engine(params, cfg)
+        assert max(eng.buckets) == 16
+        with pytest.raises(ValueError, match="bucket"):
+            eng.submit("x", list(range(1, 22)))
+
+    def test_tp2_matches_unsharded(self, model, devices):
+        from deepspeed_tpu.topology import MeshSpec, set_current_mesh
+
+        cfg, params = model
+        base = bert_serving_engine(params, cfg, head="pooled")
+        reqs = _reqs(cfg, [5, 11], seed=3)
+        for rid, toks in reqs.items():
+            base.submit(rid, toks)
+        want = base.run()
+        mesh = MeshSpec.build({"model": 2}, devices=jax.devices()[:2])
+        try:
+            eng = bert_serving_engine(params, cfg, head="pooled",
+                                      mesh=mesh)
+            spec = eng.params["blocks"]["wqkv"].sharding.spec
+            assert "model" in [s for s in spec if s]
+            for rid, toks in reqs.items():
+                eng.submit(rid, toks)
+            got = eng.run()
+        finally:
+            set_current_mesh(None)
+        for rid in reqs:
+            np.testing.assert_allclose(got[rid], want[rid], rtol=2e-4,
+                                       atol=2e-4)
+
+    def test_int8_close_to_bf16(self, model, devices):
+        cfg, params = model
+        base = bert_serving_engine(params, cfg, head="pooled")
+        base.submit("x", [2, 9, 4, 7])
+        want = base.run()["x"]
+        eng = bert_serving_engine(params, cfg, head="pooled",
+                                  weight_dtype="int8")
+        eng.submit("x", [2, 9, 4, 7])
+        got = eng.run()["x"]
+        # int8 quant error, not exactness: pooled vectors stay close
+        assert float(np.max(np.abs(got - want))) < 0.15
+
+
+class TestCNNServing:
+    def test_batched_scoring_matches_solo(self, devices):
+        cfg = cnn.CNNConfig()
+        params = cnn.init_params(jax.random.PRNGKey(0), cfg)
+        eng = CNNServingEngine(params, max_batch=4)
+        rng = np.random.default_rng(0)
+        imgs = {f"i{k}": rng.normal(size=(32, 32, 3)).astype(np.float32)
+                for k in range(6)}
+        for rid, img in imgs.items():
+            eng.submit(rid, img)
+        out = eng.run()
+        assert eng.stats["lots"] == 2
+        for rid, img in imgs.items():
+            want = cnn.forward(params, jnp.asarray(img[None]))[0]
+            np.testing.assert_allclose(out[rid], np.asarray(want),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_registry_dispatches_cnn(self, devices):
+        cfg = cnn.CNNConfig()
+        params = cnn.init_params(jax.random.PRNGKey(0), cfg)
+        eng = serving_engine(params, cfg, max_batch=2)
+        eng.submit("a", np.zeros((32, 32, 3), np.float32))
+        assert eng.run()["a"].shape == (cfg.num_classes,)
+
+    def test_wrong_shape_refused(self, devices):
+        cfg = cnn.CNNConfig()
+        params = cnn.init_params(jax.random.PRNGKey(0), cfg)
+        eng = CNNServingEngine(params)
+        with pytest.raises(ValueError, match="shape"):
+            eng.submit("a", np.zeros((16, 16, 3), np.float32))
+
+    def test_registry_refuses_unsupported_cnn_kwargs(self, devices):
+        """Generic registry kwargs valid for other families must raise a
+        clear unsupported error on the CNN path, not a TypeError."""
+        cfg = cnn.CNNConfig()
+        params = cnn.init_params(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(NotImplementedError, match="weight_dtype"):
+            serving_engine(params, cfg, weight_dtype="int8")

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Staged TPU-tunnel health probe: time compile+execute at increasing
+scale to localize where the axon tunnel degrades (round-5: probe-scale
+work returned in 2.7 s while the 0.6B bench and the kernel sweep both
+wedged past their deadlines with ~0 local CPU time — everything blocked
+in RPC).
+
+Each stage prints one line immediately (flush) so a caller tailing the
+output sees exactly where the stall begins even if the process is later
+killed.  Times are wall-clock through float() fetches (under the tunnel
+block_until_ready returns early).
+"""
+
+import json
+import sys
+import time
+
+
+def stage(name, fn):
+    t0 = time.perf_counter()
+    try:
+        out = fn()
+        dt = time.perf_counter() - t0
+        print(json.dumps({"stage": name, "s": round(dt, 2),
+                          "out": out}), flush=True)
+        return True
+    except Exception as e:  # noqa: BLE001 — diagnostic tool
+        dt = time.perf_counter() - t0
+        print(json.dumps({"stage": name, "s": round(dt, 2),
+                          "error": f"{type(e).__name__}: {str(e)[:200]}"}),
+              flush=True)
+        return False
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    stage("import+devices", lambda: str(jax.devices()))
+
+    def mm(n):
+        x = jnp.ones((n, n), jnp.bfloat16)
+        f = jax.jit(lambda a: (a @ a).sum())
+        return float(f(x))
+
+    for n in (128, 1024, 4096, 8192):
+        if not stage(f"matmul_{n}", lambda n=n: mm(n)):
+            return
+
+    def mm_loop(n, k):
+        x = jnp.ones((n, n), jnp.bfloat16)
+        f = jax.jit(lambda a: (a @ a).sum())
+        float(f(x))  # compile
+        t0 = time.perf_counter()
+        for _ in range(k):
+            r = f(x)
+        v = float(r)
+        return {"per_call_ms": round(1000 * (time.perf_counter() - t0) / k,
+                                     2), "v": v}
+
+    stage("matmul_4096_x20", lambda: mm_loop(4096, 20))
+
+    # a transfer-heavy stage: 256 MB host->device->host
+    def xfer():
+        import numpy as np
+
+        a = np.ones((64, 1024, 1024), jnp.float32)  # 256 MB
+        t0 = time.perf_counter()
+        d = jax.device_put(a)
+        d.block_until_ready()
+        up = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        b = jax.device_get(d)
+        down = time.perf_counter() - t0
+        return {"h2d_s": round(up, 2), "d2h_s": round(down, 2),
+                "ok": bool(b[0, 0, 0] == 1.0)}
+
+    stage("transfer_256MB", xfer)
+
+    # a small-but-real train graph: 4-layer 256-dim llama
+    def tiny_train():
+        sys.path.insert(0, ".")
+        import deepspeed_tpu as dstpu
+        from deepspeed_tpu.models import llama
+        import numpy as np
+
+        cfg = llama.LlamaConfig(
+            vocab_size=1024, dim=256, n_layers=4, n_heads=4, n_kv_heads=4,
+            ffn_dim=512, max_seq_len=256)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        engine, _, _, _ = dstpu.initialize(
+            loss_fn=llama.loss_fn(cfg), params=params,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "zero_optimization": {"stage": 0},
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+                    "bf16": {"enabled": True}})
+        tokens = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 257)), jnp.int32)
+        t0 = time.perf_counter()
+        l0 = float(engine.train_batch({"tokens": tokens}))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(5):
+            loss = engine.train_batch({"tokens": tokens})
+        v = float(loss)
+        return {"compile_s": round(compile_s, 1),
+                "step_ms": round(1000 * (time.perf_counter() - t0) / 5, 1),
+                "loss0": round(l0, 3), "loss5": round(v, 3)}
+
+    stage("tiny_train_4L_256d", tiny_train)
+
+
+if __name__ == "__main__":
+    main()
