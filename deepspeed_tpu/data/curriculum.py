@@ -101,6 +101,24 @@ def truncate_to_difficulty(batch: Dict[str, jnp.ndarray] | jnp.ndarray,
     return batch[:, :seqlen]
 
 
+# the one list of batch keys that carry a sequence axis — shared by
+# every engine's curriculum hook so the engines cannot drift
+ENGINE_SEQ_KEYS = ("tokens", "input_ids", "labels", "attention_mask",
+                   "position_ids", "loss_mask", "segment_ids")
+
+
+def apply_seqlen_curriculum(batch, scheduler, global_step: int):
+    """One engine-facing entrypoint (TrainingEngine and
+    ParamStreamEngine both call this): truncate the batch to the
+    scheduler's current difficulty when the curriculum is seqlen-typed,
+    pass the batch through untouched otherwise."""
+    if scheduler is None or scheduler.cfg.curriculum_type != "seqlen":
+        return batch
+    return truncate_to_difficulty(
+        batch, scheduler.get_difficulty(global_step),
+        seq_keys=ENGINE_SEQ_KEYS)
+
+
 # ------------------------------------------------- difficulty-ordered sampling
 class DifficultyIndexer:
     """Data-analysis half of curriculum (ref: data_pipeline/data_sampling/

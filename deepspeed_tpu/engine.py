@@ -770,15 +770,10 @@ class TrainingEngine:
         return self.curriculum_scheduler.get_difficulty(self.global_steps)
 
     def _apply_curriculum(self, batch):
-        if self.curriculum_scheduler is None or \
-                self.curriculum_scheduler.cfg.curriculum_type != "seqlen":
-            return batch
-        from deepspeed_tpu.data.curriculum import truncate_to_difficulty
+        from deepspeed_tpu.data.curriculum import apply_seqlen_curriculum
 
-        return truncate_to_difficulty(
-            batch, self.curriculum_difficulty(),
-            seq_keys=("tokens", "input_ids", "labels", "attention_mask",
-                      "position_ids", "loss_mask", "segment_ids"))
+        return apply_seqlen_curriculum(batch, self.curriculum_scheduler,
+                                       self.global_steps)
 
     def train_batch(self, batch) -> jnp.ndarray:
         """Run one full optimizer step on a global batch; returns the loss.

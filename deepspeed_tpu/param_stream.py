@@ -135,10 +135,16 @@ class ParamStreamEngine:
         self.layered = layered
         self.L = layered.n_layers
         self._last_grad_norm = 0.0     # TrainingEngine pre-step parity
+        # seqlen curriculum (ref: engine.curriculum_scheduler + megatron
+        # truncation): same batch preprocessing as TrainingEngine — the
+        # layer jits compile once per quantized curriculum length, the
+        # identical trade the monolithic step makes
+        self.curriculum_scheduler = None
         if config.curriculum is not None and config.curriculum.enabled:
-            raise ValueError(
-                "curriculum_learning does not compose with the "
-                "param-stream engine yet — it would be a silent no-op")
+            from deepspeed_tpu.data.curriculum import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(
+                config.curriculum)
         self._specs = None
         if param_specs is not None:
             if layered.factor_specs is None:
@@ -452,8 +458,22 @@ class ParamStreamEngine:
         return dict(self.phase_times)
 
     # ------------------------------------------------------------------ step
+    def curriculum_difficulty(self):
+        """Current curriculum difficulty (TrainingEngine parity), or
+        None when no curriculum is configured."""
+        if self.curriculum_scheduler is None:
+            return None
+        return self.curriculum_scheduler.get_difficulty(self.global_steps)
+
+    def _apply_curriculum(self, batch):
+        from deepspeed_tpu.data.curriculum import apply_seqlen_curriculum
+
+        return apply_seqlen_curriculum(batch, self.curriculum_scheduler,
+                                       self.global_steps)
+
     def train_batch(self, batch) -> jnp.ndarray:
         t0 = time.perf_counter()
+        batch = self._apply_curriculum(batch)
         if not self._jits_built:
             self._build_jits()
         ph = self._phase_reset()

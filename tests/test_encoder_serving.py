@@ -67,6 +67,7 @@ class TestBertServing:
             np.testing.assert_allclose(out[rid], np.asarray(want),
                                        rtol=2e-4, atol=2e-3)
 
+    @pytest.mark.slow
     def test_lot_formation_buckets_and_isolation(self, model, devices):
         """A long request must not drag short ones into its bucket, and
         results are order-independent."""

@@ -37,6 +37,7 @@ def _prompts(cfg, b=8, t=8):
 
 
 class TestHybridEngine:
+    @pytest.mark.slow
     def test_generate_matches_standalone_generator(self, devices, setup):
         cfg, engine, hybrid = setup
         from deepspeed_tpu.inference.generation import llama_generator

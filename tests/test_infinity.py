@@ -80,6 +80,7 @@ class TestInfinityEngine:
         lm = [float(many.train_batch(batch)) for _ in range(4)]
         np.testing.assert_allclose(lm, lo, rtol=1e-6, atol=1e-6)
 
+    @pytest.mark.slow
     def test_master_params_consolidation(self, devices):
         cfg, params, batch = tiny_setup()
         inf = build(cfg, params, {"device": "cpu", "scheduled": True})
@@ -113,6 +114,7 @@ class TestInfinityEngine:
         n = llama.param_count(cfg)
         assert inf.hbm_state_bytes() == 2 * n  # bf16 compute copy only
 
+    @pytest.mark.slow
     def test_plain_cpu_offload_stays_on_training_engine(self, devices):
         # no "scheduled" opt-in → the memory-kind sharding path
         # (graceful no-op on backends without pinned_host)
@@ -139,6 +141,7 @@ class TestInfinityEngine:
         for a, b in zip(master_before, master_after):
             np.testing.assert_array_equal(a, b)
 
+    @pytest.mark.slow
     def test_checkpoint_roundtrip(self, devices, tmp_path):
         cfg, params, batch = tiny_setup()
         inf = build(cfg, params, {"device": "cpu", "scheduled": True})
@@ -151,6 +154,7 @@ class TestInfinityEngine:
         l4b = float(inf2.train_batch(batch))
         np.testing.assert_allclose(l4b, l4, rtol=1e-6)
 
+    @pytest.mark.slow
     def test_state_is_partitioned_over_dp(self, devices):
         # ref partitioned_optimizer_swapper.py: each RANK owns 1/dp of the
         # f32 state and swaps only its partition.  Here: the tier holds
@@ -201,6 +205,7 @@ class TestInfinityEngine:
         li = [float(inf.train_batch(batch)) for _ in range(4)]
         np.testing.assert_allclose(li, lp, rtol=2e-3, atol=2e-3)
 
+    @pytest.mark.slow
     def test_comms_digest_shows_grad_reduce_scatter(self, devices):
         cfg, params, batch = tiny_setup()
         inf = build(cfg, params, {"device": "cpu", "scheduled": True})
@@ -211,6 +216,7 @@ class TestInfinityEngine:
         assert kinds & {"reduce-scatter", "all-reduce", "all-to-all",
                         "collective-permute"}, kinds
 
+    @pytest.mark.slow
     def test_host_update_matches_device_update(self, devices):
         # ref DeepSpeedCPUAdam: the host-side numpy Adam must walk the
         # same trajectory as the on-device sharded update
@@ -223,6 +229,7 @@ class TestInfinityEngine:
         np.testing.assert_allclose(lh, ld, rtol=2e-3, atol=2e-3)
         assert lh[-1] < lh[0]
 
+    @pytest.mark.slow
     def test_host_update_nvme_tier(self, devices):
         import tempfile
         cfg, params, batch = tiny_setup()
@@ -259,6 +266,7 @@ class TestInfinityTP:
                     "bf16": {"enabled": True}})
         return engine
 
+    @pytest.mark.slow
     def test_tp_sharded_compute_matches_no_tp(self, devices):
         from jax.sharding import PartitionSpec as P
 

@@ -37,6 +37,7 @@ class TestLoRA:
             init_lora(jax.random.PRNGKey(0), params,
                       LoRAConfig(target_modules=("nope",)))
 
+    @pytest.mark.slow
     def test_engine_trains_adapters_only(self, base, devices):
         cfg, params = base
         lcfg = LoRAConfig(lora_r=4, lora_alpha=8,

@@ -124,6 +124,7 @@ class TestMixtralServing:
             eng.submit(rid, p, max_new_tokens=n)
         assert eng.run() == want
 
+    @pytest.mark.slow
     def test_tp_x_ep_matches_unsharded(self, model, devices):
         """TP x EP composed (ref: DeepSpeed-MoE inference's
         tensor-slicing + expert-parallel deployment): exact tokens."""

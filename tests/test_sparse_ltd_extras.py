@@ -259,6 +259,7 @@ class TestLlamaSparseAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-3, atol=2e-3)
 
+    @pytest.mark.slow
     def test_sliding_window_trains(self, devices):
         import deepspeed_tpu as dstpu
         from deepspeed_tpu.models import llama

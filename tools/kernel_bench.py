@@ -271,14 +271,25 @@ def main():
         attn_shapes, adam_sizes = attn_shapes[:1], adam_sizes[:1]
         paged_cfgs, chunk_cfgs = paged_cfgs[:1], chunk_cfgs[:1]
 
-    result = {
-        "backend": jax.default_backend(),
-        "flash_vs_xla": flash_vs_ref(attn_shapes, iters),
-        "adam_pallas_vs_xla": adam_vs_xla(adam_sizes, iters),
-        "paged_decode_vs_gather": paged_vs_gather(paged_cfgs, iters),
-        "chunk_prefill_vs_gather": chunk_vs_gather(chunk_cfgs, iters),
-        "flash_block_sweep": block_sweep(iters),
-    }
+    # incremental commit after every sweep family: a tunnel that wedges
+    # mid-run (round-5: it dropped 13 min into the window) must not
+    # cost the families that DID complete
+    result = {"backend": jax.default_backend(), "partial": True}
+    sweeps = [
+        ("flash_vs_xla", lambda: flash_vs_ref(attn_shapes, iters)),
+        ("adam_pallas_vs_xla", lambda: adam_vs_xla(adam_sizes, iters)),
+        ("paged_decode_vs_gather", lambda: paged_vs_gather(paged_cfgs,
+                                                           iters)),
+        ("chunk_prefill_vs_gather", lambda: chunk_vs_gather(chunk_cfgs,
+                                                            iters)),
+        ("flash_block_sweep", lambda: block_sweep(iters)),
+    ]
+    for name, fn in sweeps:
+        result[name] = fn()
+        print(f"--- {name} done", flush=True)
+        with open(args.json_out, "w") as f:
+            json.dump(result, f, indent=1)
+    result.pop("partial")
     with open(args.json_out, "w") as f:
         json.dump(result, f, indent=1)
     print("→", args.json_out)

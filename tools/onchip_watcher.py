@@ -32,7 +32,7 @@ STATUS = os.path.join(REPO, "ONCHIP_WATCHER_STATUS.json")
 PIDFILE = "/tmp/dstpu_onchip_watcher.pid"
 
 STAGES = [
-    ("fast", ["bench", "kernels"], 3600),
+    ("fast", ["bench", "kernels"], 4500),
     ("serving", ["serving"], 4000),
     ("tuning", ["tuning", "autotune", "bench_tuned"], 6000),
     ("infinity", ["infinity"], 7500),
@@ -54,7 +54,9 @@ def probe() -> bool:
     try:
         p = subprocess.run(argv, timeout=deadline, capture_output=True,
                            text=True)
-        return p.returncode == 0 and "Tpu" in p.stdout
+        # device repr varies by jax version/platform: TpuDevice(...) vs
+        # "[TPU v5 lite0]" — match case-insensitively
+        return p.returncode == 0 and "tpu" in p.stdout.lower()
     except subprocess.TimeoutExpired:
         return False
 
@@ -95,52 +97,104 @@ def pidfile_guard() -> bool:
     return False
 
 
+MAX_STAGE_ATTEMPTS = 3
+
+
 def main():
     if pidfile_guard():
         print("watcher already running")
         return
 
+    # outer loop: survive tunnel drops — go back to probing and resume
+    # at the first missing stage instead of exiting (round-5: the
+    # tunnel came up, wedged mid-bench, and an exit-on-drop watcher
+    # would have slept through any later recovery)
     n = 0
+    attempts = {name: 0 for name, _, _ in STAGES}
     while True:
-        n += 1
         up = probe()
-        put_status(state="probing", attempt=n, chip_up=up)
+        n += 1
+        put_status(state="probing", attempt=n, chip_up=up,
+                   stage_attempts=attempts)
         print(f"probe {n}: chip_up={up}", flush=True)
-        if up:
-            break
-        time.sleep(600)
-
-    done = []
-    for name, items, deadline in STAGES:
-        marker = os.path.join(REPO, f"ONCHIP_STAGE_{name}.done")
-        if os.path.exists(marker):
-            done.append({name: "already-done"})
+        if not up:
+            time.sleep(600)
             continue
-        if not probe():          # tunnel must be up RIGHT NOW
-            put_status(state="tunnel_dropped", done=done, next_stage=name)
-            print("tunnel dropped — stopping; rerun to resume", flush=True)
+
+        done, dropped = [], False
+        for name, items, deadline in STAGES:
+            marker = os.path.join(REPO, f"ONCHIP_STAGE_{name}.done")
+            if os.path.exists(marker):
+                done.append({name: "already-done"})
+                continue
+            if attempts[name] >= MAX_STAGE_ATTEMPTS:
+                # a stage that fails repeatedly on a healthy chip is a
+                # broken workload, not a tunnel blip — don't burn the
+                # window re-running it
+                done.append({name: "attempts-exhausted"})
+                continue
+            if not probe():          # tunnel must be up RIGHT NOW
+                put_status(state="tunnel_dropped", done=done,
+                           next_stage=name, stage_attempts=attempts)
+                print("tunnel dropped — back to probing", flush=True)
+                dropped = True
+                break
+            attempts[name] += 1
+            put_status(state="running", stage=name, done=done,
+                       stage_attempts=attempts)
+            print(f"=== stage {name}: {items}", flush=True)
+            outcome = run_stage(name, items, deadline)
+            # the backlog exits 0 even when items inside failed: the
+            # marker must key off the per-item outcomes, or a failed
+            # capture gets permanently skipped as "done"
+            ok = False
+            try:
+                with open(os.path.join(
+                        REPO, f"ONCHIP_RUNLOG_{name}.json")) as f:
+                    runlog = json.load(f)
+                ok = (outcome == "rc=0" and runlog
+                      and all(v.get("rc") == 0 for v in runlog.values()))
+            except (FileNotFoundError, ValueError):
+                pass
+            done.append({name: outcome if not ok else "ok"})
+            if ok:
+                with open(marker, "w") as f:
+                    f.write(time.strftime("%Y-%m-%dT%H:%M:%S"))
+            elif not probe():
+                # the tunnel died under the stage — that's a tunnel
+                # failure, not a workload failure: refund the attempt
+                # so 3 wedges can't permanently retire the stage
+                attempts[name] -= 1
+                put_status(state="tunnel_dropped", done=done,
+                           stage=name, stage_attempts=attempts)
+                print("tunnel dropped mid-stage — back to probing",
+                      flush=True)
+                dropped = True
+                break
+        if dropped:
+            time.sleep(600)
+            continue
+        missing = [name for name, _, _ in STAGES
+                   if not os.path.exists(
+                       os.path.join(REPO, f"ONCHIP_STAGE_{name}.done"))]
+        pending = [name for name in missing
+                   if attempts[name] < MAX_STAGE_ATTEMPTS]
+        if not missing:
+            put_status(state="complete", done=done,
+                       stage_attempts=attempts)
+            print("backlog capture complete", flush=True)
             return
-        put_status(state="running", stage=name, done=done)
-        print(f"=== stage {name}: {items}", flush=True)
-        outcome = run_stage(name, items, deadline)
-        # the backlog exits 0 even when items inside failed: the marker
-        # must key off the per-item outcomes, or a failed capture gets
-        # permanently skipped as "done"
-        ok = False
-        try:
-            with open(os.path.join(
-                    REPO, f"ONCHIP_RUNLOG_{name}.json")) as f:
-                runlog = json.load(f)
-            ok = (outcome == "rc=0" and runlog
-                  and all(v.get("rc") == 0 for v in runlog.values()))
-        except (FileNotFoundError, ValueError):
-            pass
-        done.append({name: outcome if not ok else "ok"})
-        if ok:
-            with open(marker, "w") as f:
-                f.write(time.strftime("%Y-%m-%dT%H:%M:%S"))
-    put_status(state="complete", done=done)
-    print("backlog capture complete", flush=True)
+        if not pending:
+            # every missing stage burned its attempts on a HEALTHY
+            # tunnel — that's a broken workload, not a blip; say so
+            # instead of claiming completion
+            put_status(state="gave_up", missing=missing, done=done,
+                       stage_attempts=attempts)
+            print(f"gave up: stages {missing} exhausted their attempts",
+                  flush=True)
+            return
+        print(f"stages pending retry: {pending}", flush=True)
+        time.sleep(300)
 
 
 if __name__ == "__main__":

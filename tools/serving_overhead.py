@@ -66,13 +66,17 @@ def measure_point(model_name, slots, decode_chunk, prompt_len=8,
     eng.run()
     eng.drain_finished()
 
+    warmup_steps = eng.stats["decode_steps"]
+
     for i, p in enumerate(prompts):
         eng.submit(i, p, max_new_tokens=new_tokens)
     t0 = time.perf_counter()
     out = eng.run()
     wall = time.perf_counter() - t0
     generated = sum(len(v) - prompt_len for v in out.values())
-    steps = eng.stats["decode_steps"]
+    # warmup's decode steps are outside the timed window — they must
+    # not dilute the per-step cost
+    steps = eng.stats["decode_steps"] - warmup_steps
     total_ms = 1000 * wall / max(steps, 1)
 
     # pure jit cost of one decode step: replay the engine's compiled
