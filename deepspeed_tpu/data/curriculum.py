@@ -111,7 +111,12 @@ def apply_seqlen_curriculum(batch, scheduler, global_step: int):
     """One engine-facing entrypoint (TrainingEngine and
     ParamStreamEngine both call this): truncate the batch to the
     scheduler's current difficulty when the curriculum is seqlen-typed,
-    pass the batch through untouched otherwise."""
+    pass the batch through untouched otherwise.  The untouched case is
+    deliberate, not a silent gap: non-seqlen curriculum types are
+    DATA-SAMPLING curricula — the loader/:class:`DifficultyIndexer`
+    restricts which samples are drawn, and there is nothing for the
+    engine's batch hook to do (same division of labor as the
+    reference's data_efficiency pipeline vs megatron truncation)."""
     if scheduler is None or scheduler.cfg.curriculum_type != "seqlen":
         return batch
     return truncate_to_difficulty(

@@ -495,6 +495,55 @@ def layered_model(cfg: LlamaConfig, params):
              "lm_head": specs["lm_head"]}))
 
 
+def layered_model_lazy(cfg: LlamaConfig, seed: int = 0,
+                       dtype=jnp.bfloat16):
+    """:func:`layered_model` for models whose FULL host image would not
+    fit in RAM — the host-side analogue of ``zero.Init`` (ref:
+    deepspeed.zero.Init partitioned construction): blocks are a
+    per-layer init callable + stacked abstract spec, so the streaming
+    engine materializes ONE layer at a time during tier ingest and peak
+    host memory is the tier state plus a single layer, never the whole
+    stacked tree."""
+    d, f, L = cfg.dim, cfg.ffn_dim, cfg.n_layers
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    npdt = np.dtype(dtype)
+
+    def nw(r, *sh):
+        scale = 1.0 / np.sqrt(sh[-2] if len(sh) > 1 else sh[-1])
+        return (r.standard_normal(sh, dtype=np.float32)
+                * scale).astype(npdt)
+
+    def blocks(l):
+        r = np.random.default_rng((seed, l))
+        return {
+            "attn_norm": np.ones((d,), npdt),
+            "wq": nw(r, d, nh * hd), "wk": nw(r, d, nkv * hd),
+            "wv": nw(r, d, nkv * hd), "wo": nw(r, nh * hd, d),
+            "mlp_norm": np.ones((d,), npdt),
+            "w1": nw(r, d, f), "w3": nw(r, d, f), "w2": nw(r, f, d),
+        }
+
+    sds = jax.ShapeDtypeStruct
+    blocks_spec = {
+        "attn_norm": sds((L, d), dtype),
+        "wq": sds((L, d, nh * hd), dtype),
+        "wk": sds((L, d, nkv * hd), dtype),
+        "wv": sds((L, d, nkv * hd), dtype),
+        "wo": sds((L, nh * hd, d), dtype),
+        "mlp_norm": sds((L, d), dtype),
+        "w1": sds((L, d, f), dtype), "w3": sds((L, d, f), dtype),
+        "w2": sds((L, f, d), dtype),
+    }
+    r0 = np.random.default_rng((seed, 1 << 30))
+    lm = layered_model(cfg, {
+        "embed": nw(r0, cfg.vocab_size, d),
+        "blocks": blocks,
+        "final_norm": np.ones((d,), npdt),
+        "lm_head": nw(r0, d, cfg.vocab_size),
+    })
+    return dataclasses.replace(lm, blocks_spec=blocks_spec)
+
+
 def loss_fn(cfg: LlamaConfig, n_micro: Optional[int] = None):
     """Causal-LM next-token cross entropy; batch = {tokens, (loss_mask)}.
 

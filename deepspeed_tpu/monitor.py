@@ -121,6 +121,57 @@ class WandbMonitor(Monitor):
             self._wandb.finish()
 
 
+class CometMonitor(Monitor):
+    """ref: deepspeed/monitor/comet.py.  Gated on comet_ml being
+    importable (it is not baked into this image, so the backend is a
+    no-op unless the user's environment provides it — same
+    import-gating as wandb/tensorboard)."""
+
+    def __init__(self, project: Optional[str] = None,
+                 workspace: Optional[str] = None,
+                 api_key: Optional[str] = None,
+                 experiment_name: Optional[str] = None,
+                 experiment_key: Optional[str] = None,
+                 online: Optional[bool] = None,
+                 mode: Optional[str] = None):
+        self.enabled = False
+        self._exp = None
+        try:
+            import comet_ml  # type: ignore
+
+            exp = comet_ml.start(
+                api_key=api_key, project=project, workspace=workspace,
+                experiment_key=experiment_key,
+                online=online,
+                mode=mode or "get_or_create")
+            try:
+                if experiment_name:
+                    exp.set_name(experiment_name)
+            except Exception:
+                # a started experiment must not leak its upload threads
+                # when the backend ends up disabled
+                exp.end()
+                raise
+            self._exp = exp
+            self.enabled = True
+        except Exception:
+            pass
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if self._exp is None:
+            return
+        for tag, value, step in events:
+            self._exp.log_metric(tag, float(value), step=step)
+
+    def flush(self) -> None:
+        if self._exp is not None:
+            self._exp.flush()
+
+    def close(self) -> None:
+        if self._exp is not None:
+            self._exp.end()
+
+
 class MonitorMaster(Monitor):
     """Fan-out to all enabled backends, rank-0 only (ref: monitor/monitor.py
 
@@ -152,6 +203,16 @@ class MonitorMaster(Monitor):
         if cm.get("enabled"):
             self.backends.append(CsvMonitor(cm.get("output_path", "ds_logs"),
                                             cm.get("job_name", "run")))
+        co = cfg.get("comet", {})
+        if co.get("enabled"):
+            m = CometMonitor(
+                project=co.get("project"), workspace=co.get("workspace"),
+                api_key=co.get("api_key"),
+                experiment_name=co.get("experiment_name"),
+                experiment_key=co.get("experiment_key"),
+                online=co.get("online"), mode=co.get("mode"))
+            if m.enabled:
+                self.backends.append(m)
 
     @property
     def enabled(self) -> bool:  # type: ignore[override]

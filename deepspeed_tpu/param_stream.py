@@ -117,6 +117,14 @@ class LayeredModel:
     # lets initialize(param_specs=...) compose TP with layer streaming
     # (blocks_specs are STACKED-layout: dim 0 is the layer axis)
     factor_specs: Optional[Callable] = None
+    # lazy blocks init (the host-side analogue of zero.Init, ref:
+    # deepspeed.zero.Init partitioned construction): ``blocks`` may be a
+    # CALLABLE ``blocks(l) -> per-layer pytree`` instead of a stacked
+    # tree; then ``blocks_spec`` must give the stacked abstract shapes
+    # (pytree of ShapeDtypeStruct with a leading [L] dim).  Only one
+    # layer is ever materialized outside the engine's tier, so a model
+    # whose FULL host image would not fit in RAM can still stream-init.
+    blocks_spec: Any = None
 
 
 class ParamStreamEngine:
@@ -220,23 +228,47 @@ class ParamStreamEngine:
         self._cdt_np = np.dtype(jnp.bfloat16)
 
         # ---- block leaves: per-layer files on the tier
-        leaves, self._btree = jax.tree_util.tree_flatten(layered.blocks)
+        lazy = callable(layered.blocks)
+        blocks_shape_src = layered.blocks_spec if lazy else layered.blocks
+        if lazy and blocks_shape_src is None:
+            raise ValueError(
+                "callable LayeredModel.blocks (lazy init) requires "
+                "blocks_spec — the stacked abstract shapes")
+        spec_leaves, self._btree = jax.tree_util.tree_flatten(
+            blocks_shape_src)
         self._bpaths = [
             jax.tree_util.keystr(p) for p, _ in
-            jax.tree_util.tree_flatten_with_path(layered.blocks)[0]]
-        self._bshapes = [tuple(a.shape[1:]) for a in leaves]   # per-layer
+            jax.tree_util.tree_flatten_with_path(blocks_shape_src)[0]]
+        self._bshapes = [tuple(a.shape[1:]) for a in spec_leaves]
         self._bsizes = [int(np.prod(s)) for s in self._bshapes]
-        self._bnames = [f"b{i}" for i in range(len(leaves))]
+        self._bnames = [f"b{i}" for i in range(len(spec_leaves))]
         # per-process row partition of the f32 state: leaf rows pad to
         # pc x chunk and each process's tier holds one chunk (pc=1:
         # chunk == size, no padding, identical to single-controller)
         self._schunks = [-(-sz // self._pc) for sz in self._bsizes]
+
+        def layer_arrays(l):
+            if lazy:
+                lv, td = jax.tree_util.tree_flatten(layered.blocks(l))
+                if td != self._btree:
+                    raise ValueError(
+                        f"blocks({l}) structure {td} != blocks_spec "
+                        f"structure {self._btree}")
+                # lazy leaves are freshly built per call — the tier may
+                # own them without a defensive copy
+                return [np.asarray(a) for a in lv]
+            # np.array: force copies — asarray views of jax CPU
+            # buffers must never land on the (mutating) tier.  In the
+            # eager path spec_leaves ARE the stacked block leaves.
+            return [np.array(leaf[l]) for leaf in spec_leaves]
+
         for l in range(self.L):
-            for nm, leaf, i in zip(self._bnames, leaves,
-                                   range(len(leaves))):
-                # np.array: force copies — asarray views of jax CPU
-                # buffers must never land on the (mutating) tier
-                a = np.array(leaf[l])
+            arrs = layer_arrays(l)
+            for nm, a, i in zip(self._bnames, arrs, range(len(arrs))):
+                if tuple(a.shape) != self._bshapes[i]:
+                    raise ValueError(
+                        f"layer {l} leaf {self._bpaths[i]}: shape "
+                        f"{a.shape} != spec {self._bshapes[i]}")
                 self.tier.put(f"p_{l}_{nm}", a.astype(self._cdt_np)
                               if a.dtype != self._cdt_np else a)
                 f32 = np.ascontiguousarray(
@@ -245,9 +277,9 @@ class ParamStreamEngine:
                 z = np.zeros(self._schunks[i], np.float32)
                 self.tier.put(f"m_{l}_{nm}", z)
                 self.tier.put(f"v_{l}_{nm}", z.copy())
+            del arrs
         if isinstance(self.tier, _NvmeTier):
             self.tier.fence_all()
-        del leaves
 
         # ---- shardings: TP composes with streaming — each uploaded
         # layer is sharded over the model axis (the 2-layer HBM working
@@ -687,6 +719,18 @@ class ParamStreamEngine:
         fin = all(bool(np.isfinite(a).all()) for a in g)
         stats[l] = (ssq, fin)
         if can_update and fin:
+            # backpressure: a lagging CPU-Adam must not let un-updated
+            # layers' f32 grads pile up on the host (at 8B+ scale the
+            # full-depth backlog is tens of GB).  Blocking HERE stalls
+            # the drain worker, which stalls the vjp loop at its dfuts
+            # bound — so device-side backward pauses until the update
+            # queue shrinks, and host grad residency stays O(5 layers).
+            while sum(1 for f in upd_futs if not f.done()) > 4:
+                pending = next((f for f in upd_futs if not f.done()),
+                               None)
+                if pending is None:
+                    break
+                pending.result()
             upd_futs.append(self._upd_pool.submit(
                 self._update_one_layer, l, g, gbuf, lr, t, inv, ph))
 

@@ -30,9 +30,19 @@ from deepspeed_tpu.models import llama
 
 def build_cfg(scale: str) -> llama.LlamaConfig:
     if scale == "10b":
-        # 40 layers x dim 4096 / ffn 14336 (+ 32k vocab) ≈ 9.8B params
+        # 40 layers x dim 4096 / ffn 14336 (+ 32k vocab) ≈ 9.8B params.
+        # NOTE: needs ~137 GB of tier storage (14 B/param) — more than
+        # this container's 80 GB disk / 123 GB free RAM; use "8b" here
         return llama.LlamaConfig(
             vocab_size=32000, dim=4096, n_layers=40, n_heads=32,
+            n_kv_heads=8, ffn_dim=14336, max_seq_len=512)
+    if scale == "8b":
+        # the >HBM proof SIZED TO THIS HOST: ~8.07B params → 16.1 GB of
+        # bf16 alone vs 15.75 GB usable HBM on one v5e, while the tier
+        # state (14 B/param ≈ 113 GB) still fits host RAM — lazy
+        # per-layer init keeps peak host memory at state + ONE layer
+        return llama.LlamaConfig(
+            vocab_size=16384, dim=4096, n_layers=37, n_heads=32,
             n_kv_heads=8, ffn_dim=14336, max_seq_len=512)
     if scale == "2b":
         return llama.LlamaConfig(
@@ -44,7 +54,7 @@ def build_cfg(scale: str) -> llama.LlamaConfig:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--scale", choices=["tiny", "2b", "10b"],
+    ap.add_argument("--scale", choices=["tiny", "2b", "8b", "10b"],
                     default="tiny")
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--seq", type=int, default=0)
@@ -56,28 +66,33 @@ def main():
     seq = args.seq or (64 if args.scale == "tiny" else 256)
     big = args.scale != "tiny"
 
-    # init per layer on HOST: a >HBM model must never materialize on
-    # device, and host RAM holds it transiently leaf-by-leaf
-    rng = jax.random.PRNGKey(0)
-    with jax.default_device(jax.local_devices(backend="cpu")[0]):
-        params = llama.init_params(
-            rng, cfg, dtype=jnp.bfloat16 if big else jnp.float32)
-    n_params = llama.param_count(cfg)
-
     off = {"device": args.tier}
     if args.tier == "nvme":
         off["nvme_path"] = tempfile.mkdtemp(prefix="dstpu_pstream_")
     else:
         off["scheduled"] = True
+    n_params = llama.param_count(cfg)
+    if args.scale == "8b":
+        # host zero.Init: one layer at a time straight into the tier —
+        # the full stacked tree (16 GB bf16) never exists on the host
+        layered = llama.layered_model_lazy(cfg, seed=0)
+    else:
+        # init on HOST: a >HBM model must never materialize on device,
+        # and host RAM holds it transiently leaf-by-leaf
+        rng = jax.random.PRNGKey(0)
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            params = llama.init_params(
+                rng, cfg, dtype=jnp.bfloat16 if big else jnp.float32)
+        layered = llama.layered_model(cfg, params)
+        del params
     engine, _, _, _ = dstpu.initialize(
-        params=llama.layered_model(cfg, params),
+        params=layered,
         config={
             "train_micro_batch_size_per_gpu": 1,
             "zero_optimization": {"stage": 3, "offload_param": off},
             "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
             "bf16": {"enabled": True},
         })
-    del params
     ws = engine.hbm_param_working_set_bytes()
     print(f"params={n_params/1e9:.2f}B  bf16-all={2*n_params/1e9:.1f} GB  "
           f"HBM param working set={ws/1e9:.2f} GB  layers={engine.L}  "

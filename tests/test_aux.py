@@ -68,6 +68,46 @@ def test_monitor_master(tmp_path):
     assert not MonitorMaster({}).enabled
 
 
+def test_comet_monitor_gated_and_logs(tmp_path, monkeypatch):
+    """ref: deepspeed/monitor/comet.py — import-gated like wandb; when
+    comet_ml IS importable, metrics flow through Experiment.log_metric."""
+    import sys
+    import types
+
+    from deepspeed_tpu.monitor import CometMonitor
+
+    # absent comet_ml → disabled backend, master skips it, no crash
+    # (forced: a developer machine may genuinely have comet_ml)
+    monkeypatch.setitem(sys.modules, "comet_ml", None)
+    assert not CometMonitor(project="p").enabled
+    mm = MonitorMaster({"comet": {"enabled": True, "project": "p"}})
+    assert not mm.enabled
+
+    logged = []
+
+    class _Exp:
+        def set_name(self, n):
+            logged.append(("name", n))
+
+        def log_metric(self, tag, value, step=None):
+            logged.append((tag, value, step))
+
+        def flush(self):
+            pass
+
+        def end(self):
+            logged.append(("end",))
+
+    fake = types.ModuleType("comet_ml")
+    fake.start = lambda **kw: _Exp()
+    monkeypatch.setitem(sys.modules, "comet_ml", fake)
+    m = CometMonitor(project="p", experiment_name="run1")
+    assert m.enabled
+    m.write_events([("loss", 0.5, 7)])
+    m.close()
+    assert ("name", "run1") in logged and ("loss", 0.5, 7) in logged
+
+
 def test_flops_profiler_matmul():
     a = jnp.ones((128, 256), jnp.float32)
     b = jnp.ones((256, 64), jnp.float32)

@@ -95,9 +95,11 @@ ITEMS = {
     # winner, so this is the tuned headline number
     "bench_tuned": ([PY, "bench.py"], 1800),
     "infinity": ([PY, "tools/infinity_evidence.py", "--steps", "3"], 7200),
-    "pstream": ([PY, "examples/param_stream_offload.py", "--scale", "10b",
-                 "--steps", "2", "--json-out", "PARAM_STREAM_BENCH.json"],
-                7200),
+    # 8b, cpu tier: the largest >HBM-bf16 proof this host can hold
+    # (10b needs 137 GB of tier state vs 80 GB disk / 123 GB free RAM)
+    "pstream": ([PY, "examples/param_stream_offload.py", "--scale", "8b",
+                 "--tier", "cpu", "--steps", "2",
+                 "--json-out", "PARAM_STREAM_BENCH.json"], 7200),
 }
 ORDER = ["probe", "bench", "kernels", "serving", "tuning", "autotune",
          "bench_tuned", "infinity", "pstream"]
