@@ -82,8 +82,18 @@ def main():
                    vocab_size=16384, dim=1536, n_layers=12, n_heads=12,
                    n_kv_heads=4, ffn_dim=5376, max_seq_len=1024,
                    rope_theta=500000.0))
+    # phase timestamps: when the tunnel drops mid-run the partial .out
+    # must show which phase was in flight (round-5 postmortem)
+    t_start = time.perf_counter()
+
+    def phase(msg):
+        print(f"[{time.perf_counter() - t_start:7.1f}s] {msg}",
+              flush=True)
+
+    phase(f"backend={jax.default_backend()} — init params")
     params = mod.init_params(jax.random.PRNGKey(0), cfg)
     max_seq = args.prompt_len + args.new_tokens
+    phase("build serving engine")
     engine = serving_engine(
         params, cfg, max_batch=args.slots, page_size=16,
         num_pages=args.slots * (-(-max_seq // 16)) + 32,
@@ -96,15 +106,18 @@ def main():
                for _ in range(args.requests)]
 
     # warmup: compile prefill + decode with one request
+    phase("warmup (compile prefill + decode)")
     engine.submit("warmup", prompts[0], max_new_tokens=4)
     engine.run()
     engine.drain_finished()
 
+    phase("timed run")
     for i, p in enumerate(prompts):
         engine.submit(i, p, max_new_tokens=args.new_tokens)
     t0 = time.perf_counter()
     out = engine.run()
     dt = time.perf_counter() - t0
+    phase("done")
     generated = sum(len(v) - args.prompt_len for v in out.values())
     tps = generated / dt
     result = {

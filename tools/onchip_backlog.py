@@ -44,19 +44,31 @@ def run_item(name, argv, deadline_s):
     print(f"=== {name} (deadline {deadline_s}s): {' '.join(argv)}",
           flush=True)
     t0 = time.perf_counter()
+    # stream the child's output STRAIGHT to the .out file: a timed-out
+    # run must still leave its partial output behind (round-5: the
+    # serving item hung 900 s on a dropped tunnel and capture_output
+    # left zero diagnostics)
+    out_path = os.path.join(REPO, f"ONCHIP_{name}.out")
+    err_path = os.path.join(REPO, f"ONCHIP_{name}.err")
     try:
-        p = subprocess.run(argv, cwd=REPO, timeout=deadline_s,
-                           capture_output=True, text=True)
-        # full stdout to a per-item file: the 800-char tail alone can
-        # push a JSON result line out behind stderr warnings, losing a
-        # measurement the tunnel window may not grant again
-        with open(os.path.join(REPO, f"ONCHIP_{name}.out"), "w") as f:
-            f.write(p.stdout + "\n--- stderr ---\n" + p.stderr)
+        # separate files: interleaving stderr into stdout can corrupt
+        # the final JSON result line the bench parser extracts
+        with open(out_path, "w") as fo, open(err_path, "w") as fe:
+            # unbuffered child stdio: a SIGKILL on timeout must not
+            # discard block-buffered output — the partial capture is
+            # the whole point
+            p = subprocess.run(argv, cwd=REPO, timeout=deadline_s,
+                               stdout=fo, stderr=fe, text=True,
+                               env={**os.environ,
+                                    "PYTHONUNBUFFERED": "1"})
+        with open(out_path) as f:
+            captured = f.read()
+        with open(err_path) as f:
+            err_tail = f.read()[-400:]
         out = {"rc": p.returncode, "s": round(time.perf_counter() - t0, 1),
-               "stdout_tail": p.stdout[-800:],
-               "stderr_tail": p.stderr[-400:]}
+               "stdout_tail": captured[-800:], "stderr_tail": err_tail}
         if name in ("bench", "bench_tuned") and p.returncode == 0:
-            for line in reversed(p.stdout.strip().splitlines()):
+            for line in reversed(captured.strip().splitlines()):
                 try:
                     row = json.loads(line)
                 except ValueError:
@@ -75,7 +87,16 @@ def run_item(name, argv, deadline_s):
                         out["stdout_tail"])[:800]
                 break
     except subprocess.TimeoutExpired:
-        out = {"rc": None, "s": deadline_s, "stdout_tail": "TIMEOUT"}
+        tails = []
+        for path in (out_path, err_path):
+            try:
+                with open(path) as f:
+                    tails.append(f.read()[-400:])
+            except OSError:
+                tails.append("")
+        out = {"rc": None, "s": deadline_s,
+               "stdout_tail": "TIMEOUT; partial: " + tails[0],
+               "stderr_tail": tails[1]}
     print(f"--- {name}: rc={out['rc']} in {out['s']}s", flush=True)
     return out
 

@@ -118,7 +118,9 @@ def main():
                    stage_attempts=attempts)
         print(f"probe {n}: chip_up={up}", flush=True)
         if not up:
-            time.sleep(600)
+            # 3 min, not 10: the round-5 tunnel window lasted ~20 min
+            # total — a 10-min probe cadence can eat half of one
+            time.sleep(180)
             continue
 
         done, dropped = [], False
@@ -172,7 +174,7 @@ def main():
                 dropped = True
                 break
         if dropped:
-            time.sleep(600)
+            time.sleep(180)   # same cadence as the probe loop
             continue
         missing = [name for name, _, _ in STAGES
                    if not os.path.exists(
