@@ -20,6 +20,7 @@ gather and is the numerics oracle for tests/CPU.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -381,15 +382,25 @@ def pallas_paged_gate(B: int, n_kv: int, head_dim: int, page_size: int,
                       max_pages: int, kv_itemsize: int,
                       interpret: bool, tp: bool) -> bool:
     """One policy for when the pallas paged kernels beat the XLA gather
-    references, shared by every model's paged forward.  Measured on v5e
-    for decode (KERNEL_BENCH.json paged_decode_vs_gather): the gather
-    wins ~1.2x at small/mid shapes; the kernel pays off only when the
-    gathered K/V transient ([B, KV, mp*ps, Dh] x2, in cache dtype PLUS
-    the f32 upcast) is too big to materialize.  TP forces the XLA paths
-    (GSPMD cannot partition a pallas custom call)."""
-    gather_bytes = (2 * B * n_kv * max_pages * page_size * head_dim
-                    * (kv_itemsize + 4))
-    return not interpret and not tp and gather_bytes >= (1 << 28)
+    references, shared by every model's paged forward.
+
+    Measured policy (KERNEL_BENCH.json r5, v5e): the XLA gather path
+    wins at EVERY tested decode shape — ~1.1-1.2x at small/mid sizes
+    and 25x at the largest (B=16 H=32 seq=4096: gather 5.8 ms vs
+    pallas 145 ms).  The old premise — "the kernel pays off once the
+    gathered K/V transient is too big to materialize" — is false: XLA
+    fuses the page gather into the attention without materializing it,
+    while the pallas grid walks one 16-token page per step (B*KV*mp
+    tiny DMAs).  So the gather is the default everywhere; the kernel
+    remains opt-in (DSTPU_FORCE_PAGED_PALLAS=1 — set it BEFORE the
+    first forward: the flag is read at trace time, so already-compiled
+    shapes keep whatever policy they were traced with) as the base for
+    a multi-page-per-step rewrite.  The shape parameters are unused by
+    the current policy but intentionally kept: that rewrite's gate will
+    be shape-dependent, and the call sites already plumb them."""
+    if interpret or tp:
+        return False
+    return os.environ.get("DSTPU_FORCE_PAGED_PALLAS", "") == "1"
 
 
 def paged_attention_step(q, k, v, kp, vp, table, start, page_size: int, *,

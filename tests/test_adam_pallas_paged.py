@@ -282,3 +282,32 @@ class TestPagedChunkAttention:
                                    np.asarray(base[:, :3]), atol=1e-6)
         assert not np.allclose(np.asarray(pert[:, 3]),
                                np.asarray(base[:, 3]))
+
+
+class TestPagedGatePolicy:
+    """Pin the measured dispatch policy (KERNEL_BENCH.json r5, v5e): the
+    XLA gather paths win at every tested decode shape, so the pallas
+    paged kernels are opt-in only."""
+
+    def test_default_is_gather_everywhere(self, monkeypatch):
+        from deepspeed_tpu.inference.kernels import pallas_paged_gate
+
+        monkeypatch.delenv("DSTPU_FORCE_PAGED_PALLAS", raising=False)
+        # the shape class the old transient-size heuristic routed to
+        # pallas (B=16 H=32 seq=4096 — measured 25x SLOWER on chip)
+        assert not pallas_paged_gate(16, 8, 128, 16, 288, 2,
+                                     interpret=False, tp=False)
+        assert not pallas_paged_gate(8, 4, 128, 16, 128, 2,
+                                     interpret=False, tp=False)
+
+    def test_env_opt_in(self, monkeypatch):
+        from deepspeed_tpu.inference.kernels import pallas_paged_gate
+
+        monkeypatch.setenv("DSTPU_FORCE_PAGED_PALLAS", "1")
+        assert pallas_paged_gate(16, 8, 128, 16, 288, 2,
+                                 interpret=False, tp=False)
+        # interpret / TP still force the XLA reference paths
+        assert not pallas_paged_gate(16, 8, 128, 16, 288, 2,
+                                     interpret=True, tp=False)
+        assert not pallas_paged_gate(16, 8, 128, 16, 288, 2,
+                                     interpret=False, tp=True)
