@@ -20,8 +20,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=4").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=4").strip()
+if "xla_backend_optimization_level" not in flags:
+    # same cold-compile cut as tests/conftest.py (the parent pops
+    # XLA_FLAGS before spawning, so this is set here too)
+    flags = (flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = flags
 
 import jax
 

@@ -305,6 +305,7 @@ class TestEngineCurriculum:
         assert engine.curriculum_scheduler is None
         assert engine.curriculum_difficulty() is None
 
+    @pytest.mark.slow
     def test_torch_idiom_applies_curriculum(self, devices):
         import deepspeed_tpu as dstpu
         from deepspeed_tpu.models import llama

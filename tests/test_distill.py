@@ -122,6 +122,7 @@ def test_e2e_student_learns_teacher(devices):
     assert kd_dist < kd_hard, (kd_dist, kd_hard)
 
 
+@pytest.mark.slow
 def test_masked_distillation(devices):
     """loss_mask flows through both the hard-CE and KD terms."""
     k = jax.random.PRNGKey(0)

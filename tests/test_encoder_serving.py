@@ -39,6 +39,7 @@ def _solo_hidden(cfg, params, toks):
 
 
 class TestBertServing:
+    @pytest.mark.slow
     def test_pooled_matches_solo_forward(self, model, devices):
         cfg, params = model
         eng = bert_serving_engine(params, cfg, head="pooled", max_batch=4)
@@ -53,6 +54,7 @@ class TestBertServing:
             np.testing.assert_allclose(out[rid], np.asarray(want),
                                        rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_mlm_head_slices_to_true_length(self, model, devices):
         cfg, params = model
         eng = bert_serving_engine(params, cfg, head="mlm", max_batch=2)
@@ -134,6 +136,7 @@ class TestBertServing:
             np.testing.assert_allclose(got[rid], want[rid], rtol=2e-4,
                                        atol=2e-4)
 
+    @pytest.mark.slow
     def test_int8_close_to_bf16(self, model, devices):
         cfg, params = model
         base = bert_serving_engine(params, cfg, head="pooled")
@@ -148,6 +151,7 @@ class TestBertServing:
 
 
 class TestCNNServing:
+    @pytest.mark.slow
     def test_batched_scoring_matches_solo(self, devices):
         cfg = cnn.CNNConfig()
         params = cnn.init_params(jax.random.PRNGKey(0), cfg)

@@ -34,6 +34,7 @@ def test_prefill_matches_forward():
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_incremental_decode_matches_full():
     cfg, params, toks = _setup(T=8)
     full = llama.forward(params, toks, cfg)

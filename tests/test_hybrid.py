@@ -68,6 +68,7 @@ class TestHybridEngine:
         r2 = hybrid.generate(prompts, max_new_tokens=8, temperature=0.0)
         assert r2.shape == r1.shape
 
+    @pytest.mark.slow
     def test_sampled_rollout_and_eos(self, devices, setup):
         cfg, engine, hybrid = setup
         hybrid.eos = 3

@@ -4,12 +4,14 @@ tiny models train, loss decreases)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models import bert, mixtral
 from deepspeed_tpu.topology import MeshSpec
 
 
+@pytest.mark.slow
 def test_mixtral_forward_shapes():
     cfg = mixtral.MixtralConfig.tiny()
     params = mixtral.init_params(jax.random.PRNGKey(0), cfg)

@@ -388,6 +388,7 @@ class TestParamStream:
         ls = [float(eng.train_batch(batch)) for _ in range(4)]
         assert all(np.isfinite(ls)) and ls[-1] < ls[0], ls
 
+    @pytest.mark.slow
     def test_seqlen_curriculum_matches_plain_engine(self, devices):
         """Curriculum composes with layer streaming (round-4 missing #6):
         the same truncation schedule drives both engines, so the loss
@@ -420,6 +421,32 @@ class TestParamStream:
         lp = [float(plain.train_batch({"tokens": toks}))
               for _ in range(5)]
         np.testing.assert_allclose(ls, lp, rtol=2e-2, atol=2e-2)
+
+    def test_seqlen_curriculum_ramps(self, devices):
+        """Fast-lane slice of the lockstep test above: curriculum drives
+        the streamed engine through ONE length transition (two compiled
+        lengths, no plain-engine oracle)."""
+        cfg = llama.LlamaConfig.tiny(**CFG)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        eng, _, _, _ = dstpu.initialize(
+            params=llama.layered_model(cfg, params),
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "bf16": {"enabled": True},
+                    "curriculum_learning": {
+                        "enabled": True, "curriculum_type": "seqlen",
+                        "min_difficulty": 16, "max_difficulty": 33,
+                        "schedule_config": {"total_curriculum_step": 2,
+                                            "difficulty_step": 16}},
+                    "zero_optimization": {
+                        "stage": 3, "offload_param": {
+                            "device": "cpu", "scheduled": True}}})
+        assert eng.curriculum_difficulty() == 16
+        toks = jnp.asarray(np.random.default_rng(3).integers(
+            0, cfg.vocab_size, (eng.train_batch_size, 33)), jnp.int32)
+        ls = [float(eng.train_batch({"tokens": toks})) for _ in range(3)]
+        assert eng.curriculum_difficulty() == 32
+        assert all(np.isfinite(ls)), ls
 
     def test_rejects_plain_pytree_with_scheduled_offload(self, devices):
         cfg = llama.LlamaConfig.tiny(**CFG)

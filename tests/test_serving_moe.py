@@ -150,6 +150,7 @@ class TestMixtralServing:
             eng.submit(rid, p, max_new_tokens=n)
         assert eng.run() == want
 
+    @pytest.mark.slow
     def test_int8_ep2_matches_unsharded_int8(self, model, devices):
         """int8 weight-only quant composes with expert parallelism: the
         expert FFN codes shard over the expert axis and their per-row
