@@ -5,20 +5,14 @@ Must set XLA flags BEFORE jax initializes (SURVEY.md §4).
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
-if "xla_backend_optimization_level" not in flags:
-    # the suite is COMPILE-bound on this image's single CPU core and
-    # the judge's lane runs with a cold jit cache: backend opt level 0
-    # cuts cold compile ~35% (measured on test_generation: 50.5 s ->
-    # 32.8 s) with identical results — these are semantics tests, not
-    # CPU perf tests.  Real-chip paths (bench.py etc.) never read this
-    # conftest and keep full optimization.
-    flags = (flags + " --xla_backend_optimization_level=0").strip()
-os.environ["XLA_FLAGS"] = flags
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _xla_flags  # noqa: E402  (lane flags shared with mp_child.py)
+
+_xla_flags.apply(device_count=8)
 
 import jax  # noqa: E402
 

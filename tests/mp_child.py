@@ -18,14 +18,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    flags = (flags + " --xla_force_host_platform_device_count=4").strip()
-if "xla_backend_optimization_level" not in flags:
-    # same cold-compile cut as tests/conftest.py (the parent pops
-    # XLA_FLAGS before spawning, so this is set here too)
-    flags = (flags + " --xla_backend_optimization_level=0").strip()
-os.environ["XLA_FLAGS"] = flags
+# the parent test pops XLA_FLAGS before spawning, so the lane flags are
+# (re)applied here, pre-jax, from the same shared helper as conftest.py
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _xla_flags  # noqa: E402
+
+_xla_flags.apply(device_count=4)
 
 import jax
 

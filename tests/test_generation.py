@@ -97,6 +97,7 @@ def test_paged_prefill_requires_empty_cache():
         llama.forward_paged(params, toks[:, 4:8], cfg, cache)
 
 
+@pytest.mark.slow
 def test_paged_decode_ragged_frontiers():
     """Batched decode with per-row seq_lens must equal per-sequence
     decode (per-row RoPE offsets + per-row page frontiers)."""

@@ -41,6 +41,40 @@ def build(cfg, params, offload, sub_group=0):
 
 
 class TestInfinityEngine:
+    def test_accum_grads_match_unaccumulated(self, devices):
+        """Fast-lane canary for the lane's XLA flags (tests/
+        _xla_flags.py): with identical micro-batch rows the accumulated
+        gradient must equal the single-shot gradient.  At
+        --xla_backend_optimization_level=0 XLA's CPU backend MISCOMPILES
+        this accum scan (max grad error ~0.36); levels 1/3 sit at the
+        bf16 noise floor (~0.01).  Guards the fast lane against anyone
+        lowering the opt level for speed."""
+        cfg, params, _ = tiny_setup()
+        row = np.random.default_rng(0).integers(0, cfg.vocab_size, (1, 65))
+        batch = {"tokens": jnp.asarray(np.repeat(row, 8, axis=0),
+                                       jnp.int32)}
+
+        def mk(accum):
+            e, _, _, _ = dstpu.initialize(
+                loss_fn=llama.loss_fn(cfg), params=params,
+                config={"train_micro_batch_size_per_gpu": 8 // accum,
+                        "gradient_accumulation_steps": accum,
+                        "zero_optimization": {
+                            "stage": 0, "offload_optimizer": {
+                                "device": "cpu", "scheduled": True}},
+                        "optimizer": {"type": "adamw",
+                                      "params": {"lr": 3e-3}},
+                        "bf16": {"enabled": True}})
+            return e
+
+        e1, e2 = mk(1), mk(2)
+        _, _, g1 = e1._grad_fn(e1.params_c, batch)
+        _, _, g2 = e2._grad_fn(e2.params_c, batch)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=0.05)
+
     def test_routing_and_trajectory_matches_plain_engine(self, devices):
         cfg, params, batch = tiny_setup()
         plain = build(cfg, params, None)

@@ -86,7 +86,6 @@ class TestSparseAttention:
         assert out.shape == q.shape
         assert 0 < sa.density(64) < 1.0
 
-    @pytest.mark.slow
     def test_key_padding_mask(self):
         q, k, v = _qkv(B=1)
         cfg = DenseSparsityConfig(num_heads=2, block=16)
