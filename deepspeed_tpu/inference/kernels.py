@@ -268,6 +268,137 @@ def paged_decode_attention(q, k_pages, v_pages, table, seq_lens,
         interpret=interpret)[:, 0]
 
 
+# --------------------------------------- multi-page-per-step decode kernel
+def _decode_v2_kernel(table_ref, lens_ref, q_ref, k_hbm, v_hbm, o_ref, *,
+                      scale, ps, kv_heads, max_pages, g8, ppcb):
+    """One grid step per (batch, kv_head); K/V pages stay in HBM and are
+    streamed ``ppcb`` pages at a time into a double-buffered VMEM
+    scratch by explicit DMA.  This is the fix for the measured v1
+    failure (KERNEL_BENCH r5: one 16-token page per GRID step = B*KV*mp
+    tiny dispatches, 145 ms where the XLA gather runs 5.8 ms): the page
+    sweep is an in-kernel fori_loop with a dynamic trip count, so dead
+    pages past each row's seq_len are never read at all."""
+    bk = pl.program_id(0)
+    b = bk // kv_heads
+    h = bk % kv_heads
+    lens = lens_ref[b]
+    pages_live = (lens + ps - 1) // ps
+    nch = (pages_live + ppcb - 1) // ppcb          # dynamic trip count
+
+    def body(kb, vb, sem):
+        def chunk_dmas(c, slot):
+            """The ppcb page copies of chunk c (same descriptors for
+            start and wait — recomputed, not carried)."""
+            dmas = []
+            for j in range(ppcb):                   # static unroll
+                p = c * ppcb + j
+                psafe = jnp.minimum(p, max_pages - 1)
+                pid = jnp.where(p < pages_live, table_ref[b, psafe], 0)
+                dmas.append(pltpu.make_async_copy(
+                    k_hbm.at[h, pid], kb.at[slot, pl.ds(j * ps, ps), :],
+                    sem.at[slot, 0]))
+                dmas.append(pltpu.make_async_copy(
+                    v_hbm.at[h, pid], vb.at[slot, pl.ds(j * ps, ps), :],
+                    sem.at[slot, 1]))
+            return dmas
+
+        @pl.when(nch > 0)
+        def _():
+            for d in chunk_dmas(0, 0):
+                d.start()
+
+        q = q_ref[0].astype(jnp.float32)            # [g8, Dh]
+
+        def loop(c, carry):
+            m, l, acc = carry
+            slot = jax.lax.rem(c, 2)
+
+            @pl.when(c + 1 < nch)
+            def _():
+                for d in chunk_dmas(c + 1, jax.lax.rem(c + 1, 2)):
+                    d.start()
+
+            for d in chunk_dmas(c, slot):
+                d.wait()
+            k = kb[slot].astype(jnp.float32)        # [ppcb*ps, Dh]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            kpos = c * (ppcb * ps) + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(kpos < lens, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            pr = jnp.exp(s - m_new)
+            l = l * alpha + jnp.sum(pr, axis=1, keepdims=True)
+            acc = acc * alpha + jax.lax.dot_general(
+                pr, vb[slot].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l, acc
+
+        init = (jnp.full((g8, 1), NEG_INF, jnp.float32),
+                jnp.zeros((g8, 1), jnp.float32),
+                jnp.zeros((g8, q_ref.shape[2]), jnp.float32))
+        m, l, acc = jax.lax.fori_loop(0, nch, loop, init)
+        l = jnp.where(l == 0.0, 1.0, l)             # empty sequence → zeros
+        o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        body,
+        kb=pltpu.VMEM((2, ppcb * ps, q_ref.shape[2]), k_hbm.dtype),
+        vb=pltpu.VMEM((2, ppcb * ps, q_ref.shape[2]), v_hbm.dtype),
+        sem=pltpu.SemaphoreType.DMA((2, 2)),
+    )
+
+
+def paged_decode_attention_v2(q, k_pages, v_pages, table, seq_lens,
+                              scale: Optional[float] = None,
+                              pages_per_block: int = 8,
+                              interpret: bool = False):
+    """Multi-page-per-step paged decode attention (same contract as
+    :func:`paged_attention_reference` / :func:`paged_decode_attention`).
+
+    q: [B, H, Dh] (one decode step), k/v_pages: [KV, P, ps, Dh],
+    table: [B, mp] int32, seq_lens: [B] int32.  Pages live in HBM
+    (``pl.ANY``) and are DMA-streamed ``pages_per_block`` at a time per
+    (batch, kv_head) grid step with double buffering; only live pages
+    are read.  Stale table entries past seq_len are never dereferenced
+    (clamped to page 0 and masked)."""
+    B, H, Dh = q.shape
+    KV, P, ps, _ = k_pages.shape
+    G = H // KV
+    mp = table.shape[1]
+    scale = scale if scale is not None else Dh ** -0.5
+    ppcb = max(1, min(pages_per_block, mp))
+    g8 = -(-G // 8) * 8                             # sublane alignment
+    qg = q.reshape(B, KV, G, Dh).reshape(B * KV, G, Dh)
+    if g8 != G:
+        qg = jnp.concatenate(
+            [qg, jnp.zeros((B * KV, g8 - G, Dh), q.dtype)], axis=1)
+
+    kernel = functools.partial(
+        _decode_v2_kernel, scale=scale, ps=ps, kv_heads=KV,
+        max_pages=mp, g8=g8, ppcb=ppcb)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,   # table, seq_lens
+            grid=(B * KV,),
+            in_specs=[
+                pl.BlockSpec((1, g8, Dh), lambda bk, tbl, lens: (bk, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, g8, Dh), lambda bk, tbl, lens: (bk, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * KV, g8, Dh), q.dtype),
+        interpret=interpret,
+    )(table, seq_lens, qg, k_pages, v_pages)
+    return out[:, :G].reshape(B, H, Dh)
+
+
 # ------------------------------------------- pallas chunked-prefill kernel
 def _chunk_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, scale, page_size, kv_heads,
@@ -427,8 +558,14 @@ def paged_attention_step(q, k, v, kp, vp, table, start, page_size: int, *,
     else:
         kp, vp = write_token_pages(kp, vp, k[:, 0], v[:, 0], table, start,
                                    page_size)
-        pa = (paged_decode_attention if use_pallas
-              else paged_attention_reference)
+        if use_pallas:
+            # v2 (multi-page DMA streaming) unless explicitly pinned to
+            # the one-page-per-grid-step v1 (DSTPU_PAGED_V1=1)
+            pa = (paged_decode_attention
+                  if os.environ.get("DSTPU_PAGED_V1", "") == "1"
+                  else paged_decode_attention_v2)
+        else:
+            pa = paged_attention_reference
         attn = pa(q[:, 0], kp, vp, table, start + 1)[:, None]
     return attn, kp, vp
 

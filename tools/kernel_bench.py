@@ -143,27 +143,32 @@ def adam_vs_xla(sizes, iters):
     return rows
 
 
+def _paged_inputs(B, H, KV, Dh, ps, pages, seq):
+    """Shared decode-shape inputs so v1/v2/gather sweeps measure the
+    SAME tables and live lengths."""
+    mp = -(-seq // ps)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, H, Dh), jnp.bfloat16)
+    kp = jax.random.normal(ks[1], (KV, pages, ps, Dh), jnp.bfloat16)
+    vp = jax.random.normal(ks[2], (KV, pages, ps, Dh), jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(
+        rng.permutation(pages)[:B * mp].reshape(B, mp), jnp.int32)
+    lens = jnp.asarray(rng.integers(seq // 2, seq, B), jnp.int32)
+    return q, kp, vp, table, lens
+
+
 def paged_vs_gather(configs, iters):
     rows = []
     for (B, H, KV, Dh, ps, pages, seq) in configs:
-        mp = -(-seq // ps)
-        ks = jax.random.split(jax.random.PRNGKey(0), 3)
-        q = jax.random.normal(ks[0], (B, H, Dh), jnp.bfloat16)
-        kp = jax.random.normal(ks[1], (KV, pages, ps, Dh), jnp.bfloat16)
-        vp = jax.random.normal(ks[2], (KV, pages, ps, Dh), jnp.bfloat16)
-        rng = np.random.default_rng(0)
-        table = jnp.asarray(
-            rng.permutation(pages)[:B * mp].reshape(B, mp), jnp.int32)
-        lens = jnp.asarray(rng.integers(seq // 2, seq, B), jnp.int32)
-
+        q, kp, vp, table, lens = _paged_inputs(B, H, KV, Dh, ps, pages,
+                                               seq)
         pal = jax.jit(lambda q, kp, vp, t, l: paged_decode_attention(
             q, kp, vp, t, l))
         ref = jax.jit(lambda q, kp, vp, t, l: paged_attention_reference(
             q, kp, vp, t, l))
         tp = bench(pal, q, kp, vp, table, lens, iters=iters)
         tr = bench(ref, q, kp, vp, table, lens, iters=iters)
-        # decode reads the live K/V pages once: the bandwidth that matters
-        kv_bytes = 2 * B * mp * ps * Dh * 2 * (KV / B if KV < B else 1)
         rows.append({
             "shape": {"B": B, "H": H, "KV": KV, "Dh": Dh, "page": ps,
                       "pages": pages, "seq": seq},
@@ -208,6 +213,43 @@ def chunk_vs_gather(configs, iters):
     return rows
 
 
+def paged_v2_sweep(configs, iters):
+    """paged_decode_attention_v2 (multi-page DMA streaming, only live
+    pages read) vs v1 and the gather reference, over pages_per_block —
+    the measurement that decides whether the pallas paged gate flips
+    back on (r5: v1 lost 25x at the big shape, gather became the
+    default)."""
+    from deepspeed_tpu.inference.kernels import (
+        paged_attention_reference, paged_decode_attention,
+        paged_decode_attention_v2)
+
+    rows = []
+    for (B, H, KV, Dh, ps, pages, seq) in configs:
+        q, kp, vp, table, lens = _paged_inputs(B, H, KV, Dh, ps, pages,
+                                               seq)
+        tr = bench(jax.jit(paged_attention_reference),
+                   q, kp, vp, table, lens, iters=iters)
+        tv1 = bench(jax.jit(paged_decode_attention),
+                    q, kp, vp, table, lens, iters=iters)
+        for ppcb in (4, 8, 16):
+            try:
+                f = jax.jit(functools.partial(paged_decode_attention_v2,
+                                              pages_per_block=ppcb))
+                t2 = bench(f, q, kp, vp, table, lens, iters=iters)
+                row = {"v2_ms": round(1e3 * t2, 3),
+                       "v2_vs_gather": round(tr / t2, 2),
+                       "v2_vs_v1": round(tv1 / t2, 2)}
+            except Exception as e:  # Mosaic lowering risk: record, go on
+                row = {"error": str(e)[:160]}
+            rows.append({
+                "shape": {"B": B, "H": H, "KV": KV, "Dh": Dh, "page": ps,
+                          "pages": pages, "seq": seq}, "ppcb": ppcb,
+                "gather_ms": round(1e3 * tr, 3),
+                "v1_ms": round(1e3 * tv1, 3), **row})
+            print("paged_v2", rows[-1], flush=True)
+    return rows
+
+
 def block_sweep(iters):
     """Sweep flash tile sizes at the bench shape; _pick_blocks should
     match the argmin."""
@@ -247,6 +289,9 @@ def block_sweep(iters):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--families", default="",
+                    help="comma-separated subset of sweep families "
+                         "(default: all)")
     ap.add_argument("--json-out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "KERNEL_BENCH.json"))
@@ -282,8 +327,15 @@ def main():
                                                            iters)),
         ("chunk_prefill_vs_gather", lambda: chunk_vs_gather(chunk_cfgs,
                                                             iters)),
+        ("paged_decode_v2", lambda: paged_v2_sweep(paged_cfgs, iters)),
         ("flash_block_sweep", lambda: block_sweep(iters)),
     ]
+    picked = [s for s in args.families.split(",") if s]
+    if picked:
+        unknown = set(picked) - {n for n, _ in sweeps}
+        if unknown:
+            raise SystemExit(f"unknown families {sorted(unknown)}")
+        sweeps = [(n, f) for n, f in sweeps if n in picked]
     for name, fn in sweeps:
         result[name] = fn()
         print(f"--- {name} done", flush=True)

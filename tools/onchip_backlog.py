@@ -115,6 +115,11 @@ ITEMS = {
     # re-run after autotune: bench.py consumes AUTOTUNE_TABLE.json's
     # winner, so this is the tuned headline number
     "bench_tuned": ([PY, "bench.py"], 1800),
+    # r5 kernels already captured when this was added, so the v2 decode
+    # A/B (paged_decode_attention_v2 vs v1 vs gather) runs as its own item
+    "kernels_v2": ([PY, "tools/kernel_bench.py",
+                    "--families", "paged_decode_v2",
+                    "--json-out", "KERNEL_BENCH_V2.json"], 1800),
     "infinity": ([PY, "tools/infinity_evidence.py", "--steps", "3"], 7200),
     # 8b, cpu tier: the largest >HBM-bf16 proof this host can hold
     # (10b needs 137 GB of tier state vs 80 GB disk / 123 GB free RAM)
@@ -123,7 +128,7 @@ ITEMS = {
                  "--json-out", "PARAM_STREAM_BENCH.json"], 7200),
 }
 ORDER = ["probe", "bench", "kernels", "serving", "tuning", "autotune",
-         "bench_tuned", "infinity", "pstream"]
+         "bench_tuned", "infinity", "pstream", "kernels_v2"]
 
 
 def main():

@@ -37,6 +37,9 @@ STAGES = [
     ("tuning", ["tuning", "autotune", "bench_tuned"], 6000),
     ("infinity", ["infinity"], 7500),
     ("pstream", ["pstream"], 7500),
+    # last: a nice-to-have A/B, never ahead of the evidence the verdict
+    # actually asked for
+    ("kernels_v2", ["kernels_v2"], 2400),
 ]
 
 
