@@ -105,6 +105,13 @@ def run_item(name, argv, deadline_s):
 # TPU-child deadline (DSTPU_BENCH_TPU_S, defaulted here) or a
 # slow-compiling TPU attempt kills the whole item, CPU fallback included
 os.environ.setdefault("DSTPU_BENCH_TPU_S", "1500")
+# persistent TPU compile cache shared by every backlog child: tunnel
+# windows are ~5 min (r5), often shorter than one item's compile — a
+# window that dies mid-compile still warms the cache, so the NEXT
+# window resumes at execution instead of recompiling from scratch
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      "/tmp/dstpu_tpu_jit_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 ITEMS = {
     "probe": ([PY, "-c", "import jax; print(jax.devices())"], 120),
     "bench": ([PY, "bench.py"], 1800),
