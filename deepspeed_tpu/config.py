@@ -132,8 +132,14 @@ class SchedulerConfig:
 class ActivationCheckpointingConfig:
     """ref: deepspeed/runtime/activation_checkpointing/config.py."""
 
-    policy: str = "none"   # none | full | save_dots | save_attn
+    # none | full | save_dots | save_dots_no_batch | save_attn |
+    # offload_attn | offload_dots_no_batch (see remat.policy)
+    policy: str = "none"
     partition_activations: bool = False  # accepted; GSPMD shards activations
+    # ref cpu_checkpointing: saved activations live in host RAM between
+    # fwd and bwd — maps to the offload_attn policy unless an explicit
+    # offload_* policy is already chosen
+    cpu_checkpointing: bool = False
 
 
 @dataclasses.dataclass
@@ -238,9 +244,17 @@ class Config:
             )
         if "activation_checkpointing" in d:
             ac = d["activation_checkpointing"]
+            pol = ac.get("policy", "full" if ac.get("enabled") else "none")
+            cpu_ckpt = bool(ac.get("cpu_checkpointing", False))
+            # cpu_checkpointing is a MODIFIER (ref semantics): it moves
+            # saved activations to host only when checkpointing is on —
+            # it never enables checkpointing by itself
+            if cpu_ckpt and pol != "none" and not pol.startswith("offload"):
+                pol = "offload_attn"
             c.activation_checkpointing = ActivationCheckpointingConfig(
-                policy=ac.get("policy", "full" if ac.get("enabled") else "none"),
+                policy=pol,
                 partition_activations=bool(ac.get("partition_activations", False)),
+                cpu_checkpointing=cpu_ckpt,
             )
         if "pipeline" in d:
             known = {f.name for f in dataclasses.fields(PipelineConfig)}

@@ -155,6 +155,18 @@ class Trainer:
         ds = _resolve_auto(ds, args, num_update_steps)
         self.num_update_steps = num_update_steps
 
+        # honor the JSON activation_checkpointing block (ref: the HF
+        # trainer's gradient_checkpointing flows through the ds config):
+        # apply_fn closes over the MUTABLE model cfg — same pattern
+        # injection.inject uses for attn_impl — so setting remat here
+        # reaches the already-built forward
+        from deepspeed_tpu.config import Config as _DsConfig
+        from deepspeed_tpu.remat import resolve_policy
+
+        ac_policy = _DsConfig.from_dict(ds).activation_checkpointing.policy
+        if ac_policy != "none" and hasattr(self.model_cfg, "remat"):
+            self.model_cfg.remat = resolve_policy(ac_policy)
+
         import deepspeed_tpu as dstpu
 
         # causal-LM loss over the policy's apply_fn

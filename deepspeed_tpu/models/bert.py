@@ -124,13 +124,18 @@ def _block(cfg: BertConfig, x, lp, attention_mask):
     qkv = x @ lp["wqkv"] + lp["bqkv"]
     q, k, v = jnp.split(qkv.reshape(B, T, 3, nh, hd), 3, axis=2)
     q, k, v = q[:, :, 0], k[:, :, 0], v[:, :, 0]
+    from jax.ad_checkpoint import checkpoint_name
+
     attn = reference_attention(q, k, v, causal=False,
                                segment_ids=attention_mask)
-    x = _layer_norm(x + attn.reshape(B, T, d) @ lp["wo"] + lp["bo"],
+    attn = checkpoint_name(attn.reshape(B, T, d), "attn_out")
+    x = _layer_norm(x + attn @ lp["wo"] + lp["bo"],
                     lp["attn_norm_scale"], lp["attn_norm_bias"], cfg.norm_eps)
     from deepspeed_tpu.ops.fused_ops import gelu_mlp
 
-    h = gelu_mlp(x, lp["w_in"], lp["b_in"], lp["w_out"], lp["b_out"])
+    h = checkpoint_name(
+        gelu_mlp(x, lp["w_in"], lp["b_in"], lp["w_out"], lp["b_out"]),
+        "mlp_out")
     return _layer_norm(x + h, lp["mlp_norm_scale"], lp["mlp_norm_bias"],
                        cfg.norm_eps)
 

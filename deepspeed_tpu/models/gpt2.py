@@ -110,10 +110,14 @@ def _block(cfg: GPT2Config, x, lp):
     v = v.reshape(B, T, nh, hd)
     from deepspeed_tpu.ops.attention import flash_attention
 
+    from jax.ad_checkpoint import checkpoint_name
+
     attn = flash_attention(q, k, v, causal=True).reshape(B, T, d)
+    attn = checkpoint_name(attn, "attn_out")   # remat.py save/offload tag
     x = x + attn @ lp["proj_w"] + lp["proj_b"]
     h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.norm_eps)
     h = jax.nn.gelu(h @ lp["fc_w"] + lp["fc_b"], approximate=True)
+    h = checkpoint_name(h, "mlp_out")
     return x + h @ lp["out_w"] + lp["out_b"]
 
 

@@ -275,12 +275,16 @@ def _block(cfg: LlamaConfig, x, layer_params, cos, sin, segment_ids):
     v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
+    from jax.ad_checkpoint import checkpoint_name
+
     attn = _attention(q, k, v, cfg, segment_ids).reshape(B, T, nh * hd)
+    attn = checkpoint_name(attn, "attn_out")   # remat.py save/offload tag
     x = x + attn @ lp["wo"]
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     from deepspeed_tpu.ops.fused_ops import swiglu
 
-    x = x + swiglu(h, lp["w1"], lp["w3"]) @ lp["w2"]
+    mlp = checkpoint_name(swiglu(h, lp["w1"], lp["w3"]), "mlp_out")
+    x = x + mlp @ lp["w2"]
     return x
 
 
@@ -303,7 +307,7 @@ def forward_hidden(params, tokens, cfg: LlamaConfig, positions=None,
         from deepspeed_tpu.parallel.pipeline import pipelined_scan
 
         x = pipelined_scan(block, params["blocks"], x, n_micro, ms,
-                           remat=cfg.remat != "none")
+                           remat=cfg.remat)
     else:
         if cfg.remat != "none":
             from deepspeed_tpu.remat import policy as remat_policy

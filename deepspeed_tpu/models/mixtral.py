@@ -160,7 +160,10 @@ def _attn_block(cfg: MixtralConfig, lcfg, x, lp, cos, sin):
     q = _llama.apply_rope((h @ lp["wq"]).reshape(B, T, nh, hd), cos, sin)
     k = _llama.apply_rope((h @ lp["wk"]).reshape(B, T, nkv, hd), cos, sin)
     v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
+    from jax.ad_checkpoint import checkpoint_name
+
     attn = _llama._attention(q, k, v, lcfg).reshape(B, T, nh * hd)
+    attn = checkpoint_name(attn, "attn_out")   # remat.py save/offload tag
     return x + attn @ lp["wo"]
 
 
@@ -189,10 +192,13 @@ def forward(params, tokens, cfg: MixtralConfig, positions=None):
     cos, sin = _llama.rope_tables(lcfg, positions)
 
     def block(carry, lp):
+        from jax.ad_checkpoint import checkpoint_name
+
         x, aux_acc = carry
         x = _attn_block(cfg, lcfg, x, lp, cos, sin)
         h = _llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         y, aux = _moe_ffn(cfg, h, lp, mesh)
+        y = checkpoint_name(y, "mlp_out")
         x = x + y
         aux_acc = {
             "moe_aux_loss": aux_acc["moe_aux_loss"] + aux["moe_aux_loss"],

@@ -58,15 +58,21 @@ def stage_spec(base: Optional[P]) -> P:
 
 def pipelined_scan(block_fn: Callable, stacked_params: Any, x: jnp.ndarray,
                    n_micro: int, mesh: MeshSpec,
-                   remat: bool = False) -> jnp.ndarray:
+                   remat=False) -> jnp.ndarray:
     """Pipelined equivalent of ``lax.scan(block_fn, x, stacked_params)``.
 
     block_fn: ``(act, layer_params) -> (act, None)`` (lax.scan convention).
     stacked_params: pytree with leading layer dim L (divisible by S),
         sharded ``P("pipe", ...)`` (see :func:`stage_spec`).
     x: [B, ...] activations; B divisible by ``n_micro``.
+    remat: False/"none" (no checkpointing), True/"full", or any
+        remat.policy name — named policies (save_dots/save_attn/
+        offload_attn/...) apply to the per-stage body, so e.g.
+        cpu_checkpointing keeps its meaning under pipeline parallelism.
     Returns activations [B, ...] after all L layers.
     """
+    if isinstance(remat, str):
+        remat = False if remat == "none" else remat
     S = mesh.size(PIPE_AXIS)
     if S <= 1:
         y, _ = jax.lax.scan(block_fn, x, stacked_params)
@@ -100,7 +106,13 @@ def pipelined_scan(block_fn: Callable, stacked_params: Any, x: jnp.ndarray,
         out, _ = jax.lax.scan(block_fn, act, local_params)
         return out
 
-    if remat:
+    if isinstance(remat, str) and remat != "full":
+        from deepspeed_tpu.remat import policy as remat_policy
+        from deepspeed_tpu.remat import resolve_policy
+
+        stage_body = jax.checkpoint(
+            stage_body, policy=remat_policy(resolve_policy(remat)))
+    elif remat:
         stage_body = jax.checkpoint(stage_body)
 
     def run(local_params, xs):

@@ -6,11 +6,26 @@ activation storage.  On TPU this is ``jax.checkpoint`` + a rematerialization
 policy: XLA recomputes the block in backward, trading FLOPs for HBM, and
 GSPMD already keeps activations sharded (the reference's
 ``partition_activations``).
+
+The reference's ``cpu_checkpointing`` (offload the saved activations to
+host RAM instead of keeping them on-device) maps to the ``offload_*``
+policies below: XLA moves the named residuals to ``pinned_host`` memory
+after the forward and fetches them back for the backward — no recompute,
+no HBM residency, and the device→host copies ride XLA's async
+memory-space transfers.
+
+Models tag their two big per-block intermediates with
+``jax.ad_checkpoint.checkpoint_name``: ``attn_out`` (the attention
+context, quadratic to recompute) and ``mlp_out`` (the FFN inner
+activation) — the names ``save_attn`` keeps on-device and
+``offload_attn`` spills to host.
 """
 
 from __future__ import annotations
 
 import jax
+
+_NAMES = ("attn_out", "mlp_out")
 
 
 def policy(name: str):
@@ -25,9 +40,46 @@ def policy(name: str):
     if name == "save_dots_no_batch":
         return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
     if name == "save_attn":
-        return jax.checkpoint_policies.save_only_these_names(
-            "attn_out", "mlp_out")
+        return jax.checkpoint_policies.save_only_these_names(*_NAMES)
+    if name == "offload_attn":
+        # ref cpu_checkpointing: the tagged intermediates live in host
+        # RAM between forward and backward instead of HBM
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=list(_NAMES),
+            offload_src="device", offload_dst="pinned_host")
+    if name == "offload_dots_no_batch":
+        # heavier offload: every no-batch-dim matmul output goes to host
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
     raise ValueError(f"unknown remat policy {name!r}")
+
+
+_ON_DEVICE_FALLBACK = {
+    "offload_attn": "save_attn",
+    "offload_dots_no_batch": "save_dots_no_batch",
+}
+
+
+def resolve_policy(name: str) -> str:
+    """Downgrade ``offload_*`` to its on-device twin when the backend
+    cannot host-offload under SPMD (the CPU test mesh: XLA's
+    partitioner RET_CHECKs on the placement annotations — same
+    limitation offload.host_memory_supported gates for optimizer
+    state).  Each twin keeps the SAME tensors; only WHERE they sit
+    between forward and backward differs."""
+    if name in _ON_DEVICE_FALLBACK:
+        from deepspeed_tpu.offload import host_memory_supported
+
+        if not host_memory_supported():
+            from deepspeed_tpu.utils.logging import logger
+
+            fallback = _ON_DEVICE_FALLBACK[name]
+            logger.warning(
+                "activation offload (%s) needs a backend with SPMD "
+                "host-offload support; falling back to %s", name, fallback)
+            return fallback
+    return name
 
 
 def checkpoint_block(fn, name: str = "full"):

@@ -67,3 +67,23 @@ def test_mesh_mismatch():
     c = Config.from_dict({"mesh": {"model": 3, "data": 2}})
     with pytest.raises(ValueError):
         c.mesh.axis_sizes(8)
+
+
+def test_cpu_checkpointing_maps_to_offload_policy():
+    """ref activation_checkpointing.cpu_checkpointing → host-offloaded
+    activations (remat policy offload_attn)."""
+    c = Config.from_dict({"activation_checkpointing": {
+        "enabled": True, "cpu_checkpointing": True}})
+    assert c.activation_checkpointing.policy == "offload_attn"
+    assert c.activation_checkpointing.cpu_checkpointing
+    # an explicit offload policy is left alone
+    c2 = Config.from_dict({"activation_checkpointing": {
+        "policy": "offload_dots_no_batch", "cpu_checkpointing": True}})
+    assert c2.activation_checkpointing.policy == "offload_dots_no_batch"
+    # without the flag, enabled=True still means plain full remat
+    c3 = Config.from_dict({"activation_checkpointing": {"enabled": True}})
+    assert c3.activation_checkpointing.policy == "full"
+    # cpu_checkpointing is a MODIFIER: it never enables checkpointing
+    c4 = Config.from_dict({"activation_checkpointing": {
+        "cpu_checkpointing": True}})
+    assert c4.activation_checkpointing.policy == "none"

@@ -81,13 +81,18 @@ class TestLlama:
         tp = run({"data": 4, "model": 2}, llama.param_specs(cfg), 3)
         np.testing.assert_allclose(tp, base, rtol=5e-3, atol=5e-3)
 
-    def test_remat_matches(self):
+    @pytest.mark.parametrize("pol", ["full", "save_attn", "offload_attn"])
+    def test_remat_matches(self, pol):
+        """Every remat policy — including save_attn (checkpoint_name
+        tags) and offload_attn (the reference's cpu_checkpointing:
+        residuals parked in pinned_host between fwd and bwd) — computes
+        the same grads as no remat."""
         cfg_a = llama.LlamaConfig.tiny()
-        cfg_b = llama.LlamaConfig.tiny(remat="full")
+        cfg_b = llama.LlamaConfig.tiny(remat=pol)
         params = llama.init_params(jax.random.PRNGKey(0), cfg_a)
         toks = _tokens(np.random.default_rng(0), 2, 16, cfg_a.vocab_size)
-        f = lambda c: jax.grad(
-            lambda p: jnp.sum(llama.forward(p, toks, c)[..., :8]))(params)
+        f = lambda c: jax.jit(jax.grad(
+            lambda p: jnp.sum(llama.forward(p, toks, c)[..., :8])))(params)
         ga, gb = f(cfg_a), f(cfg_b)
         for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),

@@ -55,7 +55,8 @@ def main():
                     n_kv_heads=8, ffn_dim=7168, max_seq_len=2048,
                     rope_theta=500000.0)
         seq = 2048
-        space = {"batch": [4, 8], "remat": ["none", "save_dots"],
+        space = {"batch": [4, 8],
+                 "remat": ["none", "save_dots", "save_attn"],
                  "loss_chunk": [0, 8192]}
     if args.quick:
         batches = space["batch"][:2]   # keep TWO: the winner-comparison

@@ -48,7 +48,10 @@ def main():
                     rope_theta=500000.0)
         seq = 2048
         grid = [("save_dots", 4, 0), ("none", 4, 0), ("save_dots", 8, 0),
-                ("none", 8, 0), ("save_dots", 4, 8192)]
+                ("none", 8, 0), ("save_dots", 4, 8192),
+                # save_attn keeps the tagged attention context, so the
+                # backward skips the quadratic recompute
+                ("save_attn", 4, 0), ("save_attn", 8, 0)]
     else:
         base = dict(vocab_size=256, dim=32, n_layers=2, n_heads=4,
                     n_kv_heads=2, max_seq_len=64)
