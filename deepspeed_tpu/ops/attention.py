@@ -45,13 +45,15 @@ def flash_attention(q, k, v, causal: bool = True, segment_ids=None,
     """
     on_tpu = jax.default_backend() == "tpu"
     T, S = q.shape[1], k.shape[1]
-    if on_tpu and not force_reference and segment_ids is None \
+    if on_tpu and not force_reference \
+            and (segment_ids is None or T == S) \
             and T >= 256 and T % 128 == 0 \
             and S >= 256 and S % 128 == 0 and q.shape[-1] in (64, 128):
         try:
             from deepspeed_tpu.ops.attention_pallas import flash_attention_tpu
 
-            return flash_attention_tpu(q, k, v, causal=causal)
+            return flash_attention_tpu(q, k, v, causal=causal,
+                                       segment_ids=segment_ids)
         except ImportError:
             pass
     return _reference(q, k, v, causal=causal, segment_ids=segment_ids)

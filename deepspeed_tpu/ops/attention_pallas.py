@@ -31,9 +31,13 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale: float, causal: bool, block_q: int, block_k: int,
-                num_k_blocks: int):
+def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
+                block_k: int, num_k_blocks: int, has_seg: bool = False):
+    if has_seg:
+        (q_ref, k_ref, v_ref, sq_ref, sk_ref,
+         o_ref, lse_ref, m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -61,12 +65,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if has_seg:
+            s = jnp.where(sq_ref[0][:, None] == sk_ref[0][None, :],
+                          s, NEG_INF)
 
         m_prev = m_scr[:]                   # [BQ, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)              # [BQ, BK] f32
+        if has_seg:
+            # a block whose every entry is cross-segment has m_new ==
+            # NEG_INF and would yield p == exp(0) == 1 row-wide (the
+            # causal path never hits this: the diagonal block always
+            # holds live entries) — mask p explicitly
+            p = jnp.where(s > NEG_INF / 2, p, 0.0)
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_scr[:] = m_new
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -81,8 +94,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = m_scr[:] + jnp.log(l)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k, num_k_blocks):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, num_k_blocks,
+                   has_seg: bool = False):
+    if has_seg:
+        (q_ref, k_ref, v_ref, sq_ref, sk_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -106,6 +125,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if has_seg:
+            s = jnp.where(sq_ref[0][:, None] == sk_ref[0][None, :],
+                          s, NEG_INF)
         p = jnp.exp(s - lse_ref[0])                     # [BQ, BK]
         dov = jax.lax.dot_general(
             do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
@@ -120,10 +142,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, block_q, block_k, num_q_blocks,
-                    n_rep):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, num_q_blocks,
+                    n_rep, has_seg: bool = False):
+    if has_seg:
+        (q_ref, k_ref, v_ref, sq_ref, sk_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
     ki = pl.program_id(1)
     # inner axis sweeps (query-head-in-group, q block): dk/dv accumulate
     # over every query head sharing this kv head (GQA)
@@ -151,6 +177,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if has_seg:
+            s = jnp.where(sq_ref[0][:, None] == sk_ref[0][None, :],
+                          s, NEG_INF)
         p = jnp.exp(s - lse_ref[0])                     # [BQ, BK]
         do = do_ref[0].astype(jnp.float32)
         dv_scr[:] += jax.lax.dot_general(
@@ -188,9 +217,24 @@ def _kv_row(b, heads, kv_heads):
     return (b // heads) * kv_heads + (b % heads) // g
 
 
-def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
-                    heads: int, kv_heads: int, interpret: bool):
-    """q: [B*H, T, D]; k/v: [B*KV, S, D] → (out, lse)."""
+def _seg_specs(heads: int, block_q: int, block_k: int):
+    """BlockSpecs for the [B, T] segment-id operands on the fwd/dq
+    grids, which run over flat q rows (b = batch*H + h).  The dkv grid
+    (flat kv rows, q block riding program_id(2)) builds its specs
+    inline — it needs the kv_heads/nq closure."""
+    return [
+        pl.BlockSpec((1, block_q),
+                     lambda b, i, j, H=heads: (b // H, i)),
+        pl.BlockSpec((1, block_k),
+                     lambda b, i, j, H=heads: (b // H, j)),
+    ]
+
+
+def _flash_fwd_impl(q, k, v, seg, *, causal: bool, block_q: int,
+                    block_k: int, heads: int, kv_heads: int,
+                    interpret: bool):
+    """q: [B*H, T, D]; k/v: [B*KV, S, D]; seg: [B, T] int32 or None
+    → (out, lse)."""
     BH, T, D = q.shape
     S = k.shape[1]
     scale = 1.0 / np.sqrt(D)
@@ -198,18 +242,23 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
     grid = (BH, nq, nk)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=nk)
+        block_k=block_k, num_k_blocks=nk, has_seg=seg is not None)
     kv_spec = pl.BlockSpec(
         (1, block_k, D),
         lambda b, i, j: (_kv_row(b, heads, kv_heads), j, 0))
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [q, k, v]
+    if seg is not None:
+        in_specs += _seg_specs(heads, block_q, block_k)
+        operands += [seg, seg]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            kv_spec,
-            kv_spec,
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -224,12 +273,12 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return out, lse
 
 
-def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k,
-                    heads, kv_heads, interpret):
+def _flash_bwd_impl(q, k, v, seg, out, lse, do, *, causal, block_q,
+                    block_k, heads, kv_heads, interpret):
     BH, T, D = q.shape
     BKV, S = k.shape[0], k.shape[1]
     G = heads // kv_heads
@@ -241,14 +290,21 @@ def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k,
     kv_spec = pl.BlockSpec(
         (1, block_k, D),
         lambda b, i, j: (_kv_row(b, heads, kv_heads), j, 0))
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    dq_operands = [q, k, v]
+    if seg is not None:
+        dq_in_specs += _seg_specs(heads, block_q, block_k)
+        dq_operands += [seg, seg]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_k_blocks=nk),
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                          has_seg=seg is not None),
         grid=(BH, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            kv_spec,
-            kv_spec,
+        in_specs=dq_in_specs + [
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -257,7 +313,7 @@ def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_operands, do, lse, delta)
 
     # dk/dv grid runs over KV heads; the inner axis sweeps (group member,
     # q block) so the scratch accumulates the sum over the G query heads
@@ -266,15 +322,27 @@ def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k,
         return ((b // kv_heads) * heads + (b % kv_heads) * G + i // nq,
                 i % nq, 0)
 
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, j, i: q_row(b, i)),
+        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+    ]
+    dkv_operands = [q, k, v]
+    if seg is not None:
+        # batch = flat kv row // KV; q block index rides program_id(2)
+        dkv_in_specs += [
+            pl.BlockSpec((1, block_q),
+                         lambda b, j, i: (b // kv_heads, i % nq)),
+            pl.BlockSpec((1, block_k),
+                         lambda b, j, i: (b // kv_heads, j)),
+        ]
+        dkv_operands += [seg, seg]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
-                          n_rep=G),
+                          n_rep=G, has_seg=seg is not None),
         grid=(BKV, nk, nq * G),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, j, i: q_row(b, i)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0)),
+        in_specs=dkv_in_specs + [
             pl.BlockSpec((1, block_q, D), lambda b, j, i: q_row(b, i)),
             pl.BlockSpec((1, block_q, 1), lambda b, j, i: q_row(b, i)),
             pl.BlockSpec((1, block_q, 1), lambda b, j, i: q_row(b, i)),
@@ -292,45 +360,56 @@ def _flash_bwd_impl(q, k, v, out, lse, do, *, causal, block_q, block_k,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_operands, do, lse, delta)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhtd(q, k, v, causal: bool, interpret: bool, heads: int,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_bhtd(q, k, v, seg, causal: bool, interpret: bool, heads: int,
                 kv_heads: int):
     block_q, block_k = _pick_blocks(q.shape[1], k.shape[1])
-    out, _ = _flash_fwd_impl(q, k, v, causal=causal, block_q=block_q,
+    out, _ = _flash_fwd_impl(q, k, v, seg, causal=causal, block_q=block_q,
                              block_k=block_k, heads=heads,
                              kv_heads=kv_heads, interpret=interpret)
     return out
 
 
-def _flash_bhtd_fwd(q, k, v, causal, interpret, heads, kv_heads):
+def _flash_bhtd_fwd(q, k, v, seg, causal, interpret, heads, kv_heads):
     block_q, block_k = _pick_blocks(q.shape[1], k.shape[1])
-    out, lse = _flash_fwd_impl(q, k, v, causal=causal, block_q=block_q,
+    out, lse = _flash_fwd_impl(q, k, v, seg, causal=causal, block_q=block_q,
                                block_k=block_k, heads=heads,
                                kv_heads=kv_heads, interpret=interpret)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, seg, out, lse)
 
 
 def _flash_bhtd_bwd(causal, interpret, heads, kv_heads, res, do):
-    q, k, v, out, lse = res
+    q, k, v, seg, out, lse = res
     block_q, block_k = _pick_blocks(q.shape[1], k.shape[1])
-    dq, dk, dv = _flash_bwd_impl(q, k, v, out, lse, do, causal=causal,
+    dq, dk, dv = _flash_bwd_impl(q, k, v, seg, out, lse, do, causal=causal,
                                  block_q=block_q, block_k=block_k,
                                  heads=heads, kv_heads=kv_heads,
                                  interpret=interpret)
-    return dq, dk, dv
+    # segment ids are integral: their cotangent is float0 (None when the
+    # operand was None — the pytree structures must match)
+    dseg = (None if seg is None
+            else np.zeros(seg.shape, jax.dtypes.float0))
+    return dq, dk, dv, dseg
 
 
 _flash_bhtd.defvjp(_flash_bhtd_fwd, _flash_bhtd_bwd)
 
 
-def flash_attention_tpu(q, k, v, causal: bool = True,
+def flash_attention_tpu(q, k, v, causal: bool = True, segment_ids=None,
                         interpret: bool = False):
     """[B,T,H,D] x [B,S,KV,D]^2 → [B,T,H,D]; GQA via logical-head index
-    maps — kv blocks are DMA'd once per group, never repeated in HBM."""
+    maps — kv blocks are DMA'd once per group, never repeated in HBM.
+
+    segment_ids: optional [B, T] int32 — packed-sequence attention
+    masking (positions attend only within their own segment id; ref:
+    the variable-length batching the reference's sparse/dense kernels
+    support).  The non-packed path compiles the EXACT graph it always
+    did: the seg operands and their mask ops exist only when
+    segment_ids is passed."""
     B, T, H, D = q.shape
     S, KV = k.shape[1], k.shape[2]
     if T % 128 or S % 128:
@@ -339,8 +418,16 @@ def flash_attention_tpu(q, k, v, causal: bool = True,
             f" tiling would silently drop trailing keys), got T={T} S={S}")
     if H % KV:
         raise ValueError(f"n_heads {H} not a multiple of kv_heads {KV}")
+    if segment_ids is not None:
+        if T != S:
+            raise ValueError("segment_ids requires T == S (self-attention "
+                             "over one packed layout)")
+        segment_ids = jnp.asarray(segment_ids, jnp.int32)
+        if segment_ids.shape != (B, T):
+            raise ValueError(
+                f"segment_ids shape {segment_ids.shape} != {(B, T)}")
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
     kf = k.transpose(0, 2, 1, 3).reshape(B * KV, S, D)
     vf = v.transpose(0, 2, 1, 3).reshape(B * KV, S, D)
-    out = _flash_bhtd(qf, kf, vf, causal, interpret, H, KV)
+    out = _flash_bhtd(qf, kf, vf, segment_ids, causal, interpret, H, KV)
     return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
