@@ -269,26 +269,51 @@ def paged_decode_attention(q, k_pages, v_pages, table, seq_lens,
 
 
 # --------------------------------------- multi-page-per-step decode kernel
-def _decode_v2_kernel(table_ref, lens_ref, q_ref, k_hbm, v_hbm, o_ref, *,
-                      scale, ps, kv_heads, max_pages, g8, ppcb):
-    """One grid step per (batch, kv_head); K/V pages stay in HBM and are
-    streamed ``ppcb`` pages at a time into a double-buffered VMEM
-    scratch by explicit DMA.  This is the fix for the measured v1
-    failure (KERNEL_BENCH r5: one 16-token page per GRID step = B*KV*mp
-    tiny dispatches, 145 ms where the XLA gather runs 5.8 ms): the page
-    sweep is an in-kernel fori_loop with a dynamic trip count, so dead
-    pages past each row's seq_len are never read at all."""
+def paged_decode_attention_v2(q, k_pages, v_pages, table, seq_lens,
+                              scale: Optional[float] = None,
+                              pages_per_block: int = 8,
+                              interpret: bool = False):
+    """Multi-page-per-step paged decode attention (same contract as
+    :func:`paged_attention_reference` / :func:`paged_decode_attention`).
+
+    q: [B, H, Dh] (one decode step), k/v_pages: [KV, P, ps, Dh],
+    table: [B, mp] int32, seq_lens: [B] int32.  Pages live in HBM
+    (``pl.ANY``) and are DMA-streamed ``pages_per_block`` at a time per
+    (batch, kv_head) grid step with double buffering; only live pages
+    are read, and stale table entries past seq_len are never
+    dereferenced.  This is the fix for the measured v1 failure
+    (KERNEL_BENCH r5: one 16-token page per GRID step = B*KV*mp tiny
+    dispatches, 145 ms where the XLA gather runs 5.8 ms).
+
+    Decode IS the C=1 chunked case (v1 makes the same delegation): the
+    query sits at position ``seq_lens - 1`` and attends
+    ``kpos <= seq_lens - 1``, so ONE kernel serves both paths and any
+    accumulator/DMA fix lands exactly once."""
+    return paged_chunk_attention_v2(
+        q[:, None], k_pages, v_pages, table, seq_lens - 1, scale=scale,
+        pages_per_block=pages_per_block, interpret=interpret)[:, 0]
+
+
+# ----------------------------------- multi-page chunked-prefill kernel (v2)
+def _chunk_v2_kernel(table_ref, start_ref, q_ref, k_hbm, v_hbm, o_ref, *,
+                     scale, ps, kv_heads, max_pages, cg8, group, chunk,
+                     ppcb):
+    """Chunked-prefill twin of :func:`_decode_v2_kernel`: one grid step
+    per (batch, kv_head); K/V pages stream ppcb at a time through a
+    double-buffered VMEM scratch, and the page sweep stops at the last
+    page holding any position ``<= start + C - 1`` (history + chunk),
+    so pages past the frontier are never read.  Rows are the flattened
+    [C*G] chunk queries; row r sits at position start + r // G."""
     bk = pl.program_id(0)
     b = bk // kv_heads
     h = bk % kv_heads
-    lens = lens_ref[b]
-    pages_live = (lens + ps - 1) // ps
-    nch = (pages_live + ppcb - 1) // ppcb          # dynamic trip count
+    start = start_ref[b]
+    live = start + chunk                            # positions 0..live-1
+    pages_live = (live + ps - 1) // ps
+    nch = (pages_live + ppcb - 1) // ppcb
 
     def body(kb, vb, sem):
         def chunk_dmas(c, slot):
-            """The ppcb page copies of chunk c (same descriptors for
-            start and wait — recomputed, not carried)."""
             dmas = []
             for j in range(ppcb):                   # static unroll
                 p = c * ppcb + j
@@ -307,7 +332,7 @@ def _decode_v2_kernel(table_ref, lens_ref, q_ref, k_hbm, v_hbm, o_ref, *,
             for d in chunk_dmas(0, 0):
                 d.start()
 
-        q = q_ref[0].astype(jnp.float32)            # [g8, Dh]
+        q = q_ref[0].astype(jnp.float32)            # [cg8, Dh]
 
         def loop(c, carry):
             m, l, acc = carry
@@ -320,27 +345,36 @@ def _decode_v2_kernel(table_ref, lens_ref, q_ref, k_hbm, v_hbm, o_ref, *,
 
             for d in chunk_dmas(c, slot):
                 d.wait()
-            k = kb[slot].astype(jnp.float32)        # [ppcb*ps, Dh]
+            k = kb[slot].astype(jnp.float32)
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
             kpos = c * (ppcb * ps) + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
-            s = jnp.where(kpos < lens, s, NEG_INF)
+            qpos = start + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0) // group
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
             alpha = jnp.exp(m - m_new)
             pr = jnp.exp(s - m_new)
+            # defensive: no current masking pattern can leave a whole
+            # block masked while m == NEG_INF (block 0 always holds
+            # kpos=0 <= qpos, and empty rows skip the loop via nch=0),
+            # but a future mask (e.g. segments) would turn that corner
+            # into pr == 1 row-wide — keep exp's masked entries at 0
+            pr = jnp.where(s > NEG_INF / 2, pr, 0.0)
             l = l * alpha + jnp.sum(pr, axis=1, keepdims=True)
             acc = acc * alpha + jax.lax.dot_general(
-                pr, vb[slot].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                pr, vb[slot].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
             return m_new, l, acc
 
-        init = (jnp.full((g8, 1), NEG_INF, jnp.float32),
-                jnp.zeros((g8, 1), jnp.float32),
-                jnp.zeros((g8, q_ref.shape[2]), jnp.float32))
+        init = (jnp.full((cg8, 1), NEG_INF, jnp.float32),
+                jnp.zeros((cg8, 1), jnp.float32),
+                jnp.zeros((cg8, q_ref.shape[2]), jnp.float32))
         m, l, acc = jax.lax.fori_loop(0, nch, loop, init)
-        l = jnp.where(l == 0.0, 1.0, l)             # empty sequence → zeros
+        l = jnp.where(l == 0.0, 1.0, l)             # empty rows → zeros
         o_ref[0] = (acc / l).astype(o_ref.dtype)
 
     pl.run_scoped(
@@ -351,52 +385,50 @@ def _decode_v2_kernel(table_ref, lens_ref, q_ref, k_hbm, v_hbm, o_ref, *,
     )
 
 
-def paged_decode_attention_v2(q, k_pages, v_pages, table, seq_lens,
-                              scale: Optional[float] = None,
-                              pages_per_block: int = 8,
-                              interpret: bool = False):
-    """Multi-page-per-step paged decode attention (same contract as
-    :func:`paged_attention_reference` / :func:`paged_decode_attention`).
-
-    q: [B, H, Dh] (one decode step), k/v_pages: [KV, P, ps, Dh],
-    table: [B, mp] int32, seq_lens: [B] int32.  Pages live in HBM
-    (``pl.ANY``) and are DMA-streamed ``pages_per_block`` at a time per
-    (batch, kv_head) grid step with double buffering; only live pages
-    are read.  Stale table entries past seq_len are never dereferenced
-    (clamped to page 0 and masked)."""
-    B, H, Dh = q.shape
+def paged_chunk_attention_v2(q, k_pages, v_pages, table, start,
+                             scale: Optional[float] = None,
+                             pages_per_block: int = 8,
+                             interpret: bool = False):
+    """Multi-page chunked-prefill attention — same contract as
+    :func:`paged_chunk_attention_reference`, built like
+    :func:`paged_decode_attention_v2` (HBM-resident pages, explicit
+    double-buffered DMA, live-pages-only sweep)."""
+    B, C, H, Dh = q.shape
     KV, P, ps, _ = k_pages.shape
     G = H // KV
     mp = table.shape[1]
     scale = scale if scale is not None else Dh ** -0.5
     ppcb = max(1, min(pages_per_block, mp))
-    g8 = -(-G // 8) * 8                             # sublane alignment
-    qg = q.reshape(B, KV, G, Dh).reshape(B * KV, G, Dh)
-    if g8 != G:
+    CG = C * G
+    cg8 = -(-CG // 8) * 8
+    qg = q.reshape(B, C, KV, G, Dh).transpose(0, 2, 1, 3, 4) \
+        .reshape(B * KV, CG, Dh)
+    if cg8 != CG:
         qg = jnp.concatenate(
-            [qg, jnp.zeros((B * KV, g8 - G, Dh), q.dtype)], axis=1)
+            [qg, jnp.zeros((B * KV, cg8 - CG, Dh), q.dtype)], axis=1)
 
     kernel = functools.partial(
-        _decode_v2_kernel, scale=scale, ps=ps, kv_heads=KV,
-        max_pages=mp, g8=g8, ppcb=ppcb)
+        _chunk_v2_kernel, scale=scale, ps=ps, kv_heads=KV, max_pages=mp,
+        cg8=cg8, group=G, chunk=C, ppcb=ppcb)
 
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,   # table, seq_lens
+            num_scalar_prefetch=2,   # table, start
             grid=(B * KV,),
             in_specs=[
-                pl.BlockSpec((1, g8, Dh), lambda bk, tbl, lens: (bk, 0, 0)),
+                pl.BlockSpec((1, cg8, Dh), lambda bk, tbl, st: (bk, 0, 0)),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
             out_specs=pl.BlockSpec(
-                (1, g8, Dh), lambda bk, tbl, lens: (bk, 0, 0)),
+                (1, cg8, Dh), lambda bk, tbl, st: (bk, 0, 0)),
         ),
-        out_shape=jax.ShapeDtypeStruct((B * KV, g8, Dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * KV, cg8, Dh), q.dtype),
         interpret=interpret,
-    )(table, seq_lens, qg, k_pages, v_pages)
-    return out[:, :G].reshape(B, H, Dh)
+    )(table, start, qg, k_pages, v_pages)
+    out = out[:, :CG].reshape(B, KV, C, G, Dh).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, C, H, Dh)
 
 
 # ------------------------------------------- pallas chunked-prefill kernel
@@ -548,8 +580,12 @@ def paged_attention_step(q, k, v, kp, vp, table, start, page_size: int, *,
 
     if continuation and q.shape[1] > 1:
         kp, vp = write_chunk_pages(kp, vp, k, v, table, start, page_size)
-        pa = (paged_chunk_attention if use_pallas
-              else paged_chunk_attention_reference)
+        if use_pallas:
+            pa = (paged_chunk_attention
+                  if os.environ.get("DSTPU_PAGED_V1", "") == "1"
+                  else paged_chunk_attention_v2)
+        else:
+            pa = paged_chunk_attention_reference
         attn = pa(q, kp, vp, table, start)
     elif prefill:
         attn = flash_attention(q, k, v, causal=True,

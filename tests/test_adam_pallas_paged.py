@@ -378,3 +378,68 @@ class TestPagedDecodeV2:
                                          pages_per_block=4, interpret=True)
         np.testing.assert_allclose(np.asarray(pert), np.asarray(base),
                                    atol=1e-6)
+
+
+class TestPagedChunkV2:
+    """Multi-page chunked-prefill kernel (paged_chunk_attention_v2) vs
+    the gather oracle in interpret mode — the split-fuse twin of
+    TestPagedDecodeV2."""
+
+    def _pages(self, rng, KV, P, ps, Dh):
+        k = jnp.asarray(rng.normal(size=(KV, P, ps, Dh)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(KV, P, ps, Dh)), jnp.float32)
+        return k, v
+
+    def test_gqa_ragged_frontiers(self):
+        from deepspeed_tpu.inference.kernels import (
+            paged_chunk_attention_reference, paged_chunk_attention_v2)
+
+        rng = np.random.default_rng(3)
+        B, C, H, KV, P, ps, Dh, mp = 3, 4, 8, 4, 64, 4, 16, 16
+        k, v = self._pages(rng, KV, P, ps, Dh)
+        table = jnp.asarray(rng.integers(0, P, (B, mp)), jnp.int32)
+        start = jnp.asarray([0, 17, 60 - 4], jnp.int32)
+        q = jnp.asarray(rng.normal(size=(B, C, H, Dh)), jnp.float32)
+        ref = paged_chunk_attention_reference(q, k, v, table, start)
+        out = paged_chunk_attention_v2(q, k, v, table, start,
+                                       pages_per_block=3, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_pages_past_frontier_never_read(self):
+        """Perturbing pages holding only positions past start+C-1 must
+        not change the output (the live-pages-only sweep)."""
+        from deepspeed_tpu.inference.kernels import paged_chunk_attention_v2
+
+        rng = np.random.default_rng(4)
+        B, C, H, KV, P, ps, Dh, mp = 1, 4, 2, 2, 16, 4, 8, 8
+        k, v = self._pages(rng, KV, P, ps, Dh)
+        table = jnp.asarray([[0, 1, 2, 3, 4, 5, 6, 7]], jnp.int32)
+        start = jnp.asarray([5], jnp.int32)    # frontier at pos 8 → page 2
+        q = jnp.asarray(rng.normal(size=(B, C, H, Dh)), jnp.float32)
+        base = paged_chunk_attention_v2(q, k, v, table, start,
+                                        pages_per_block=2, interpret=True)
+        k2 = k.at[:, 3:8].add(100.0)   # pages for positions >= 12
+        v2 = v.at[:, 3:8].add(100.0)
+        pert = paged_chunk_attention_v2(q, k2, v2, table, start,
+                                        pages_per_block=2, interpret=True)
+        np.testing.assert_allclose(np.asarray(pert), np.asarray(base),
+                                   atol=1e-6)
+
+    def test_causal_within_chunk(self):
+        """Row i must not see the chunk's rows j > i (per-row frontier,
+        not a block frontier)."""
+        from deepspeed_tpu.inference.kernels import (
+            paged_chunk_attention_reference, paged_chunk_attention_v2)
+
+        rng = np.random.default_rng(5)
+        B, C, H, KV, P, ps, Dh, mp = 1, 8, 4, 2, 8, 4, 8, 4
+        k, v = self._pages(rng, KV, P, ps, Dh)
+        table = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+        start = jnp.asarray([4], jnp.int32)
+        q = jnp.asarray(rng.normal(size=(B, C, H, Dh)), jnp.float32)
+        ref = paged_chunk_attention_reference(q, k, v, table, start)
+        out = paged_chunk_attention_v2(q, k, v, table, start,
+                                       pages_per_block=4, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)

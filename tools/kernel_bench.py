@@ -178,6 +178,21 @@ def paged_vs_gather(configs, iters):
     return rows
 
 
+def _chunk_inputs(B, C, H, KV, Dh, ps, pages, seq):
+    """Shared split-fuse-shape inputs so the v1 and v2 chunk sweeps
+    measure the SAME tables and frontiers."""
+    mp = -(-seq // ps)
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, C, H, Dh), jnp.bfloat16)
+    kp = jax.random.normal(ks[1], (KV, pages, ps, Dh), jnp.bfloat16)
+    vp = jax.random.normal(ks[2], (KV, pages, ps, Dh), jnp.bfloat16)
+    rng = np.random.default_rng(1)
+    table = jnp.asarray(
+        rng.permutation(pages)[:B * mp].reshape(B, mp), jnp.int32)
+    start = jnp.asarray(rng.integers(0, seq - C, B), jnp.int32)
+    return q, kp, vp, table, start
+
+
 def chunk_vs_gather(configs, iters):
     """Chunked-prefill (split-fuse) attention: pallas kernel vs the
     masked-gather reference — decides where the 1<<28 gather-bytes
@@ -188,16 +203,8 @@ def chunk_vs_gather(configs, iters):
 
     rows = []
     for (B, C, H, KV, Dh, ps, pages, seq) in configs:
-        mp = -(-seq // ps)
-        ks = jax.random.split(jax.random.PRNGKey(1), 3)
-        q = jax.random.normal(ks[0], (B, C, H, Dh), jnp.bfloat16)
-        kp = jax.random.normal(ks[1], (KV, pages, ps, Dh), jnp.bfloat16)
-        vp = jax.random.normal(ks[2], (KV, pages, ps, Dh), jnp.bfloat16)
-        rng = np.random.default_rng(1)
-        table = jnp.asarray(
-            rng.permutation(pages)[:B * mp].reshape(B, mp), jnp.int32)
-        start = jnp.asarray(rng.integers(0, seq - C, B), jnp.int32)
-
+        q, kp, vp, table, start = _chunk_inputs(B, C, H, KV, Dh, ps,
+                                                pages, seq)
         pal = jax.jit(lambda q, kp, vp, t, s: paged_chunk_attention(
             q, kp, vp, t, s))
         ref = jax.jit(lambda q, kp, vp, t, s:
@@ -247,6 +254,41 @@ def paged_v2_sweep(configs, iters):
                 "gather_ms": round(1e3 * tr, 3),
                 "v1_ms": round(1e3 * tv1, 3), **row})
             print("paged_v2", rows[-1], flush=True)
+    return rows
+
+
+def chunk_v2_sweep(configs, iters):
+    """paged_chunk_attention_v2 vs v1 vs the gather reference at the
+    split-fuse shapes (same A/B contract as paged_v2_sweep)."""
+    from deepspeed_tpu.inference.kernels import (
+        paged_chunk_attention, paged_chunk_attention_reference,
+        paged_chunk_attention_v2)
+
+    rows = []
+    for (B, C, H, KV, Dh, ps, pages, seq) in configs:
+        q, kp, vp, table, start = _chunk_inputs(B, C, H, KV, Dh, ps,
+                                                pages, seq)
+        tr = bench(jax.jit(paged_chunk_attention_reference),
+                   q, kp, vp, table, start, iters=iters)
+        tv1 = bench(jax.jit(paged_chunk_attention),
+                    q, kp, vp, table, start, iters=iters)
+        for ppcb in (4, 8, 16):
+            try:
+                f = jax.jit(functools.partial(paged_chunk_attention_v2,
+                                              pages_per_block=ppcb))
+                t2 = bench(f, q, kp, vp, table, start, iters=iters)
+                row = {"v2_ms": round(1e3 * t2, 3),
+                       "v2_vs_gather": round(tr / t2, 2),
+                       "v2_vs_v1": round(tv1 / t2, 2)}
+            except Exception as e:
+                row = {"error": str(e)[:160]}
+            rows.append({
+                "shape": {"B": B, "C": C, "H": H, "KV": KV, "Dh": Dh,
+                          "page": ps, "pages": pages, "seq": seq},
+                "ppcb": ppcb,
+                "gather_ms": round(1e3 * tr, 3),
+                "v1_ms": round(1e3 * tv1, 3), **row})
+            print("chunk_v2", rows[-1], flush=True)
     return rows
 
 
@@ -328,6 +370,7 @@ def main():
         ("chunk_prefill_vs_gather", lambda: chunk_vs_gather(chunk_cfgs,
                                                             iters)),
         ("paged_decode_v2", lambda: paged_v2_sweep(paged_cfgs, iters)),
+        ("chunk_prefill_v2", lambda: chunk_v2_sweep(chunk_cfgs, iters)),
         ("flash_block_sweep", lambda: block_sweep(iters)),
     ]
     picked = [s for s in args.families.split(",") if s]

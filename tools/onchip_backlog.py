@@ -125,7 +125,7 @@ ITEMS = {
     # r5 kernels already captured when this was added, so the v2 decode
     # A/B (paged_decode_attention_v2 vs v1 vs gather) runs as its own item
     "kernels_v2": ([PY, "tools/kernel_bench.py",
-                    "--families", "paged_decode_v2",
+                    "--families", "paged_decode_v2,chunk_prefill_v2",
                     "--json-out", "KERNEL_BENCH_V2.json"], 1800),
     "infinity": ([PY, "tools/infinity_evidence.py", "--steps", "3"], 7200),
     # 8b, cpu tier: the largest >HBM-bf16 proof this host can hold
