@@ -23,6 +23,13 @@ def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 1e-3,
               warmup_num_steps: int = 1000, warmup_type: str = "log") -> Schedule:
     """ref: WarmupLR — warm up then hold at max."""
     lo, hi, n = jnp.float32(warmup_min_lr), jnp.float32(warmup_max_lr), warmup_num_steps
+    if n <= 0:
+        # no warmup: hold at max from step 0.  Without this, the log
+        # branch divides by log1p(0) == 0 (lr = NaN from the first
+        # step) and the linear branch pins lr at warmup_min_lr forever
+        # — warmup_steps=0 is the HF TrainingArguments DEFAULT, so this
+        # is a reachable config, not an edge case.
+        return constant(warmup_max_lr)
 
     def f(step):
         s = jnp.minimum(step.astype(jnp.float32), float(n))

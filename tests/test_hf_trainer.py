@@ -95,3 +95,25 @@ class TestHFTrainerBridge:
             Trainer(model_dir=base,
                     args=TrainingArguments(deepspeed=ds, max_steps=2),
                     train_dataset=make_dataset(cfg, n=8))
+
+
+class TestActivationCheckpointingBridge:
+    def test_json_policy_reaches_model_remat(self, devices, tmp_path):
+        """The ds config's activation_checkpointing block must reach the
+        already-built forward (apply_fn closes over the MUTABLE model
+        cfg — same pattern injection uses for attn_impl), resolved for
+        the backend (offload downgrades to save_attn on the CPU mesh)."""
+        base, cfg = make_base_checkpoint(tmp_path)
+        ds = ds_config_with_autos()
+        ds["activation_checkpointing"] = {"enabled": True,
+                                          "cpu_checkpointing": True}
+        args = TrainingArguments(
+            output_dir=str(tmp_path / "out"), deepspeed=ds,
+            per_device_train_batch_size=1, learning_rate=1e-3,
+            max_steps=2)
+        tr = Trainer(model_dir=base, args=args,
+                     train_dataset=make_dataset(cfg))
+        # cpu_checkpointing -> offload_attn, downgraded on this backend
+        assert tr.model_cfg.remat == "save_attn"
+        out = tr.train()
+        assert np.isfinite(out["final_loss"])

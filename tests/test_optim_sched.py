@@ -127,3 +127,20 @@ def test_schedule_registry():
     assert callable(f)
     g = lr_schedules.from_config(None, {}, fallback_lr=5e-4)
     assert abs(float(g(jnp.int32(7))) - 5e-4) < 1e-9
+
+
+def test_warmup_zero_steps_is_immediate_max():
+    """warmup_num_steps=0 (the HF TrainingArguments default) must mean
+    'no warmup', not NaN (log1p(0) division) or a forever-zero lr."""
+    from deepspeed_tpu import lr_schedules
+
+    for wtype in ("log", "linear"):
+        f = lr_schedules.warmup_lr(warmup_min_lr=0.0, warmup_max_lr=3e-4,
+                                   warmup_num_steps=0, warmup_type=wtype)
+        for s in (0, 1, 10):
+            lr = float(f(jnp.int32(s)))
+            assert np.isfinite(lr) and abs(lr - 3e-4) < 1e-9, (wtype, s, lr)
+    # and through WarmupDecayLR, which embeds the same warmup
+    g = lr_schedules.warmup_decay_lr(total_num_steps=10, warmup_max_lr=1e-3,
+                                     warmup_num_steps=0)
+    assert np.isfinite(float(g(jnp.int32(0))))
