@@ -136,4 +136,14 @@ def from_config(name: Optional[str], params: dict,
     key = name.lower()
     if key not in _REGISTRY:
         raise ValueError(f"unknown scheduler {name!r}; known: {sorted(_REGISTRY)}")
+    # step-size params must be positive: a zero here divides to NaN/inf
+    # inside the jitted step, which poisons params silently.  Exempt:
+    # warmup_num_steps (0 means "no warmup", handled in warmup_lr),
+    # decay_step_size (0 means "no decay phase", gated in one_cycle),
+    # and cycle_second_step_size (falsy means "mirror the first ramp").
+    for p in ("cycle_first_step_size",
+              "lr_range_test_step_size", "total_num_steps"):
+        if p in params and params[p] is not None and params[p] <= 0:
+            raise ValueError(f"scheduler {name!r}: {p} must be positive, "
+                             f"got {params[p]}")
     return _REGISTRY[key](**params)

@@ -144,3 +144,20 @@ def test_warmup_zero_steps_is_immediate_max():
     g = lr_schedules.warmup_decay_lr(total_num_steps=10, warmup_max_lr=1e-3,
                                      warmup_num_steps=0)
     assert np.isfinite(float(g(jnp.int32(0))))
+
+
+def test_from_config_rejects_zero_step_sizes():
+    from deepspeed_tpu import lr_schedules
+
+    with pytest.raises(ValueError, match="must be positive"):
+        lr_schedules.from_config("onecycle", {
+            "cycle_min_lr": 1e-5, "cycle_max_lr": 1e-3,
+            "cycle_first_step_size": 0})
+    with pytest.raises(ValueError, match="must be positive"):
+        lr_schedules.from_config("lrrangetest", {
+            "lr_range_test_step_size": 0})
+    # decay_step_size=0 stays legal (one_cycle's "no decay phase")
+    f = lr_schedules.from_config("onecycle", {
+        "cycle_min_lr": 1e-5, "cycle_max_lr": 1e-3,
+        "cycle_first_step_size": 4, "decay_step_size": 0})
+    assert np.isfinite(float(f(jnp.int32(0))))
