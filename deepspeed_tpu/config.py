@@ -363,6 +363,13 @@ class Config:
         else:
             m, a = 1, 1
             t = dp_world
+        if min(t, m, a) < 1:
+            # a zero slips through every divisibility check above and
+            # produces empty-batch training (shapes with a 0 dim) or an
+            # accum of 0 that silently behaves as 1
+            raise ValueError(
+                f"batch config must be positive: train={t} micro={m} "
+                f"accum={a}")
         self.train_batch_size = t
         self.train_micro_batch_size_per_gpu = m
         self.gradient_accumulation_steps = a

@@ -87,3 +87,14 @@ def test_cpu_checkpointing_maps_to_offload_policy():
     c4 = Config.from_dict({"activation_checkpointing": {
         "cpu_checkpointing": True}})
     assert c4.activation_checkpointing.policy == "none"
+
+
+def test_zero_batch_values_rejected():
+    """A zero micro/accum/train batch survives every divisibility check
+    but means empty-batch training — must be a loud config error."""
+    for bad in ({"train_micro_batch_size_per_gpu": 0},
+                {"gradient_accumulation_steps": 0},
+                {"train_batch_size": 0}):
+        c = Config.from_dict(bad)
+        with pytest.raises(ValueError, match="must be positive"):
+            c.resolve_batch_sizes(dp_world=1)
