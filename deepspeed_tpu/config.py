@@ -334,6 +334,15 @@ class Config:
             return
         t, m, a = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
                    self.gradient_accumulation_steps)
+        # validate RAW inputs before the arithmetic: a zero would either
+        # ZeroDivisionError in the divisibility checks below (two values
+        # given) or solve into empty-batch training / accum-0-acting-as-1
+        # (one value given)
+        for name, val in ((TRAIN_BATCH_SIZE, t), (MICRO_BATCH, m),
+                          (GRAD_ACCUM, a)):
+            if val is not None and val < 1:
+                raise ValueError(
+                    f"batch config must be positive: {name}={val}")
         if t is not None and m is not None and a is not None:
             if t != m * a * dp_world:
                 raise ValueError(
@@ -363,13 +372,6 @@ class Config:
         else:
             m, a = 1, 1
             t = dp_world
-        if min(t, m, a) < 1:
-            # a zero slips through every divisibility check above and
-            # produces empty-batch training (shapes with a 0 dim) or an
-            # accum of 0 that silently behaves as 1
-            raise ValueError(
-                f"batch config must be positive: train={t} micro={m} "
-                f"accum={a}")
         self.train_batch_size = t
         self.train_micro_batch_size_per_gpu = m
         self.gradient_accumulation_steps = a

@@ -90,11 +90,15 @@ def test_cpu_checkpointing_maps_to_offload_policy():
 
 
 def test_zero_batch_values_rejected():
-    """A zero micro/accum/train batch survives every divisibility check
-    but means empty-batch training — must be a loud config error."""
+    """A zero micro/accum/train batch means empty-batch training (one
+    value given) or ZeroDivisionError mid-arithmetic (two given) — must
+    be a loud ValueError either way."""
     for bad in ({"train_micro_batch_size_per_gpu": 0},
                 {"gradient_accumulation_steps": 0},
-                {"train_batch_size": 0}):
+                {"train_batch_size": 0},
+                # two-values-given paths divide by the zero
+                {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 0},
+                {"train_batch_size": 8, "gradient_accumulation_steps": 0}):
         c = Config.from_dict(bad)
         with pytest.raises(ValueError, match="must be positive"):
             c.resolve_batch_sizes(dp_world=1)
