@@ -332,6 +332,8 @@ class Config:
             self.gradient_accumulation_steps = run["gradient_accumulation_steps"]
             self._batch_from_elastic = True
             return
+        if dp_world < 1:
+            raise ValueError(f"dp_world must be positive, got {dp_world}")
         t, m, a = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
                    self.gradient_accumulation_steps)
         # validate RAW inputs before the arithmetic: a zero would either

@@ -215,10 +215,6 @@ def _attention(q, k, v, cfg: LlamaConfig, segment_ids=None):
     """q: [B,T,H,Dh], k/v: [B,T,KV,Dh] → [B,T,H,Dh]."""
     impl = cfg.attn_impl
     if impl in ("ring", "ulysses"):
-        if segment_ids is not None:
-            raise NotImplementedError(
-                "packed-sequence segment_ids are not supported on the "
-                "ring/ulysses sequence-parallel attention paths yet")
         from deepspeed_tpu.topology import current_mesh
 
         ms = current_mesh()
@@ -227,11 +223,13 @@ def _attention(q, k, v, cfg: LlamaConfig, segment_ids=None):
                 from deepspeed_tpu.parallel.ring_attention import (
                     ring_attention_sharded)
 
-                return ring_attention_sharded(q, k, v, ms, causal=True)
+                return ring_attention_sharded(q, k, v, ms, causal=True,
+                                              segment_ids=segment_ids)
             from deepspeed_tpu.parallel.sequence_parallel import (
                 ulysses_attention_sharded)
 
-            return ulysses_attention_sharded(q, k, v, ms, causal=True)
+            return ulysses_attention_sharded(q, k, v, ms, causal=True,
+                                             segment_ids=segment_ids)
         impl = "auto"  # no seq axis in scope: plain attention
     if impl == "sparse":
         if segment_ids is not None:

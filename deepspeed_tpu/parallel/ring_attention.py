@@ -21,7 +21,6 @@ ring — backward needs no hand-written schedule.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -43,12 +42,15 @@ def _repeat_kv(k, v, n_heads):
 
 
 def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = True,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, segment_ids=None):
     """Blockwise ring attention.  MUST run inside a shard_map/manual context
     where ``axis_name`` is a manual mesh axis.
 
     q: [B, Tq, H, Dh], k/v: [B, Tk, KV, Dh] — the LOCAL sequence shards.
-    Returns [B, Tq, H, Dh] in q.dtype.
+    segment_ids: optional [B, Tq] int32 LOCAL shard of the packed-layout
+    ids; the key-side ids ride the ring with their K/V block, so
+    cross-segment pairs mask out ring-wide.  Returns [B, Tq, H, Dh] in
+    q.dtype.
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -65,47 +67,67 @@ def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = True,
     # kv blocks rotate "up" the ring: after s hops, rank i holds block i-s.
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_pos = idx * Tq + jnp.arange(Tq)
+    seg_k0 = segment_ids if segment_ids is None else \
+        jnp.asarray(segment_ids, jnp.int32)
 
     def step(carry, s):
-        o, m, l, k_cur, v_cur = carry
+        o, m, l, k_cur, v_cur, seg_cur = carry
         src = (idx - s) % n
         scores = jnp.einsum("bthd,bshd->bhts", qf, k_cur.astype(jnp.float32))
+        mask = None
         if causal:
             k_pos = src * Tk + jnp.arange(Tk)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None]   # [1, Tq, Tk]
+        if seg_cur is not None:
+            same = seg_k0[:, :, None] == seg_cur[:, None, :]  # [B, Tq, Tk]
+            mask = same if mask is None else mask & same
+        if mask is not None:
+            scores = jnp.where(mask[:, None], scores, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.exp(scores - m_safe[..., None])          # masked rows → 0
-        if causal:
-            p = jnp.where(mask[None, None], p, 0.0)
+        if mask is not None:
+            p = jnp.where(mask[:, None], p, 0.0)
         alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
         l = l * alpha + jnp.sum(p, axis=-1)
         o = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
             "bhts,bshd->bthd", p, v_cur.astype(jnp.float32))
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (o, m_new, l, k_nxt, v_nxt), None
+        seg_nxt = (None if seg_cur is None else
+                   jax.lax.ppermute(seg_cur, axis_name, perm))
+        return (o, m_new, l, k_nxt, v_nxt, seg_nxt), None
 
-    (o, m, l, _, _), _ = jax.lax.scan(step, (o, m, l, k, v), jnp.arange(n))
+    (o, m, l, _, _, _), _ = jax.lax.scan(
+        step, (o, m, l, k, v, seg_k0), jnp.arange(n))
     l = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return (o / l).astype(q.dtype)
 
 
 def ring_attention_sharded(q, k, v, mesh: MeshSpec, causal: bool = True,
-                           axis_name: str = SEQ_AXIS):
+                           axis_name: str = SEQ_AXIS, segment_ids=None):
     """GSPMD entrypoint: wraps :func:`ring_attention` in a shard_map that
     manualizes ONLY the ``seq`` axis — batch (data) and head (model)
     shardings stay automatic, so ring attention composes with ZeRO and TP
-    inside one jitted step.
+    inside one jitted step.  ``segment_ids`` ([B, T] int32) shard along
+    the sequence like q and rotate with the K/V blocks.
     """
     if mesh.size(axis_name) <= 1:
         from deepspeed_tpu.ops.attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal,
+                               segment_ids=segment_ids)
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
-        partial(ring_attention, axis_name=axis_name, causal=causal),
-        mesh=mesh.mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        axis_names={axis_name}, check_vma=False)
-    return fn(q, k, v)
+    in_specs, args = (spec, spec, spec), (q, k, v)
+    if segment_ids is not None:
+        in_specs += (P(None, axis_name),)
+        args += (jnp.asarray(segment_ids, jnp.int32),)
+
+    def wrapped(q, k, v, seg=None):
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                              segment_ids=seg)
+
+    fn = jax.shard_map(wrapped, mesh=mesh.mesh, in_specs=in_specs,
+                       out_specs=spec, axis_names={axis_name},
+                       check_vma=False)
+    return fn(*args)

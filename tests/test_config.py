@@ -102,3 +102,8 @@ def test_zero_batch_values_rejected():
         c = Config.from_dict(bad)
         with pytest.raises(ValueError, match="must be positive"):
             c.resolve_batch_sizes(dp_world=1)
+
+
+def test_nonpositive_dp_world_rejected():
+    with pytest.raises(ValueError, match="dp_world"):
+        Config.from_dict({}).resolve_batch_sizes(dp_world=0)
