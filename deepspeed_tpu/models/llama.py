@@ -302,6 +302,13 @@ def forward_hidden(params, tokens, cfg: LlamaConfig, positions=None,
 
     ms = current_mesh()
     if n_micro and ms is not None and ms.size("pipe") > 1:
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "packed segment_ids are not supported with "
+                "pipeline-parallel microbatching: the block closure "
+                "would capture the full-batch ids while pipelined_scan "
+                "splits activations into microbatches — pipeline the "
+                "batch without packing, or drop the pipe axis")
         from deepspeed_tpu.parallel.pipeline import pipelined_scan
 
         x = pipelined_scan(block, params["blocks"], x, n_micro, ms,
@@ -547,7 +554,14 @@ def layered_model_lazy(cfg: LlamaConfig, seed: int = 0,
 
 
 def loss_fn(cfg: LlamaConfig, n_micro: Optional[int] = None):
-    """Causal-LM next-token cross entropy; batch = {tokens, (loss_mask)}.
+    """Causal-LM next-token cross entropy;
+    batch = {tokens, (loss_mask), (segment_ids)}.
+
+    ``segment_ids``: optional [B, T+1] int32 aligned with ``tokens``
+    (NOT the [B, T] input window :func:`forward` takes — loss_fn slices
+    them itself): packed-document attention isolation, with
+    cross-document and padding (id 0) targets masked out of the CE.
+    Not supported together with ``n_micro`` pipeline microbatching.
 
     ``n_micro``: pipeline-parallel microbatch count (see :func:`forward`);
     set it to ``gradient_accumulation_steps`` when ``pipe > 1`` — the
@@ -563,9 +577,18 @@ def loss_fn(cfg: LlamaConfig, n_micro: Optional[int] = None):
         mask = batch.get("loss_mask")
         if mask is not None:
             mask = mask[:, 1:].astype(jnp.float32)
+        seg = batch.get("segment_ids")
+        if seg is not None:
+            # ids align with tokens [B, T+1]; the forward consumes the
+            # input slice, and a document's LAST token must not predict
+            # the next document's first — fold that boundary into the
+            # loss mask (padding, id 0, masks out with it)
+            doc = ((seg[:, :-1] == seg[:, 1:]) & (seg[:, :-1] > 0)
+                   ).astype(jnp.float32)
+            mask = doc if mask is None else mask * doc
+            seg = seg[:, :-1]
         x = forward_hidden(params, tokens[:, :-1], cfg,
-                           segment_ids=batch.get("segment_ids"),
-                           n_micro=n_micro)
+                           segment_ids=seg, n_micro=n_micro)
         # loss_chunk=0 → dense path inside chunked_lm_loss (chunk >= V);
         # >0 → fused head+CE, the [B,T,V] f32 logits never hit HBM
         return chunked_lm_loss(x, lm_head(params, cfg), targets, mask=mask,

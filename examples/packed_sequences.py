@@ -57,26 +57,17 @@ def main():
     print(f"packed {len(docs)} docs into {tokens.shape[0]} rows of "
           f"{tokens.shape[1]} ({float((segments > 0).mean()):.0%} tokens live)")
 
-    def loss_fn(params, batch):
-        seg = batch["segments"][:, :-1]
-        logits = llama.forward(params, batch["tokens"][:, :-1], cfg,
-                               segment_ids=seg)
-        targets = batch["tokens"][:, 1:]
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
-        # a document's last token must not predict the NEXT document
-        mask = ((seg == batch["segments"][:, 1:]) & (seg > 0)
-                ).astype(jnp.float32)
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-
+    # llama.loss_fn understands batch["segment_ids"] natively: it slices
+    # the ids to the input window, isolates attention per document, and
+    # masks cross-document + padding targets out of the CE
     engine, _, _, _ = dstpu.initialize(
-        loss_fn=loss_fn,
+        loss_fn=llama.loss_fn(cfg),
         params=llama.init_params(jax.random.PRNGKey(0), cfg),
         config={"train_micro_batch_size_per_gpu": int(tokens.shape[0]),
                 "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
                 "bf16": {"enabled": True},
                 "zero_optimization": {"stage": 0}})
-    batch = {"tokens": tokens, "segments": segments}
+    batch = {"tokens": tokens, "segment_ids": segments}
     for i in range(args.steps):
         loss = engine.train_batch(batch)
         if i % 2 == 0 or i == args.steps - 1:
