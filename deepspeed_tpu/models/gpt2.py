@@ -174,6 +174,11 @@ def forward_with_cache(params, tokens, cfg: GPT2Config, cache):
 
 def loss_fn(cfg: GPT2Config):
     def f(params, batch):
+        if "segment_ids" in batch:
+            raise NotImplementedError(
+                "packed segment_ids: use the llama family — GPT-2's "
+                "learned absolute positions don't reset per document, "
+                "so silently accepting the key would train wrong")
         tokens = batch["tokens"]
         logits = forward(params, tokens[:, :-1], cfg)
         targets = tokens[:, 1:]

@@ -393,6 +393,10 @@ def loss_fn(cfg: MixtralConfig):
     """Next-token CE + MoE aux losses; returns (loss, aux)."""
 
     def f(params, batch):
+        if "segment_ids" in batch:
+            raise NotImplementedError(
+                "packed segment_ids are not plumbed through the Mixtral "
+                "forward yet — use the llama family for packed training")
         tokens = batch["tokens"]
         logits, aux = forward(params, tokens[:, :-1], cfg)
         targets = tokens[:, 1:]
