@@ -553,6 +553,15 @@ def layered_model_lazy(cfg: LlamaConfig, seed: int = 0,
     return dataclasses.replace(lm, blocks_spec=blocks_spec)
 
 
+def packed_doc_mask(seg):
+    """CE mask for a packed layout's [B, T+1] token-aligned segment ids:
+    a document's last token must not predict the next document's first,
+    and padding (id 0) targets mask out.  Shared by every family's
+    loss_fn so the boundary semantics cannot drift."""
+    return ((seg[:, :-1] == seg[:, 1:]) & (seg[:, :-1] > 0)
+            ).astype(jnp.float32)
+
+
 def loss_fn(cfg: LlamaConfig, n_micro: Optional[int] = None):
     """Causal-LM next-token cross entropy;
     batch = {tokens, (loss_mask), (segment_ids)}.
@@ -580,11 +589,9 @@ def loss_fn(cfg: LlamaConfig, n_micro: Optional[int] = None):
         seg = batch.get("segment_ids")
         if seg is not None:
             # ids align with tokens [B, T+1]; the forward consumes the
-            # input slice, and a document's LAST token must not predict
-            # the next document's first — fold that boundary into the
-            # loss mask (padding, id 0, masks out with it)
-            doc = ((seg[:, :-1] == seg[:, 1:]) & (seg[:, :-1] > 0)
-                   ).astype(jnp.float32)
+            # input slice, and the doc-boundary mask folds into the
+            # loss mask
+            doc = packed_doc_mask(seg)
             mask = doc if mask is None else mask * doc
             seg = seg[:, :-1]
         x = forward_hidden(params, tokens[:, :-1], cfg,

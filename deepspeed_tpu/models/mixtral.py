@@ -150,7 +150,8 @@ def param_specs(cfg: MixtralConfig) -> Dict[str, Any]:
     }
 
 
-def _attn_block(cfg: MixtralConfig, lcfg, x, lp, cos, sin):
+def _attn_block(cfg: MixtralConfig, lcfg, x, lp, cos, sin,
+                segment_ids=None):
     """The attention half of a Mixtral block (pre-norm attn + residual),
     shared by the training forward, the eval forward, and the layered
     streaming block so the four paths cannot drift."""
@@ -162,7 +163,8 @@ def _attn_block(cfg: MixtralConfig, lcfg, x, lp, cos, sin):
     v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
     from jax.ad_checkpoint import checkpoint_name
 
-    attn = _llama._attention(q, k, v, lcfg).reshape(B, T, nh * hd)
+    attn = _llama._attention(q, k, v, lcfg,
+                             segment_ids).reshape(B, T, nh * hd)
     attn = checkpoint_name(attn, "attn_out")   # remat.py save/offload tag
     return x + attn @ lp["wo"]
 
@@ -179,8 +181,11 @@ def _moe_ffn(cfg: MixtralConfig, x, lp, mesh):
     return layer(lp["gate"], eparams, x)
 
 
-def forward(params, tokens, cfg: MixtralConfig, positions=None):
-    """tokens: [B, T] → (logits [B, T, V] f32, aux_losses dict)."""
+def forward(params, tokens, cfg: MixtralConfig, positions=None,
+            segment_ids=None):
+    """tokens: [B, T] → (logits [B, T, V] f32, aux_losses dict).
+    segment_ids: optional [B, T] int32 packed-document isolation (same
+    contract as llama.forward)."""
     from deepspeed_tpu.topology import current_mesh
 
     lcfg = cfg.llama_view()
@@ -195,7 +200,7 @@ def forward(params, tokens, cfg: MixtralConfig, positions=None):
         from jax.ad_checkpoint import checkpoint_name
 
         x, aux_acc = carry
-        x = _attn_block(cfg, lcfg, x, lp, cos, sin)
+        x = _attn_block(cfg, lcfg, x, lp, cos, sin, segment_ids)
         h = _llama.rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         y, aux = _moe_ffn(cfg, h, lp, mesh)
         y = checkpoint_name(y, "mlp_out")
@@ -393,16 +398,27 @@ def loss_fn(cfg: MixtralConfig):
     """Next-token CE + MoE aux losses; returns (loss, aux)."""
 
     def f(params, batch):
-        if "segment_ids" in batch:
-            raise NotImplementedError(
-                "packed segment_ids are not plumbed through the Mixtral "
-                "forward yet — use the llama family for packed training")
         tokens = batch["tokens"]
-        logits, aux = forward(params, tokens[:, :-1], cfg)
+        seg = batch.get("segment_ids")     # [B, T+1], llama contract
+        mask = batch.get("loss_mask")
+        if mask is not None:
+            mask = mask[:, 1:].astype(jnp.float32)
+        if seg is not None:
+            seg = jnp.asarray(seg, jnp.int32)
+            doc = _llama.packed_doc_mask(seg)
+            mask = doc if mask is None else mask * doc
+        # NOTE: padding tokens (seg id 0) still feed the MoE router —
+        # they contribute to the aux losses and consume expert capacity
+        # (reference parity: the ref's gate has no padding awareness
+        # either); heavy-tail-padded batches should trim T instead
+        logits, aux = forward(params, tokens[:, :-1], cfg,
+                              segment_ids=None if seg is None
+                              else seg[:, :-1])
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        lm = jnp.mean(nll)
+        lm = (jnp.mean(nll) if mask is None
+              else jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0))
         total = lm + aux["moe_aux_loss"] + aux["moe_z_loss"]
         return total, {"lm_loss": lm, **aux}
 
