@@ -22,40 +22,21 @@ import deepspeed_tpu as dstpu
 from deepspeed_tpu.models import llama
 
 
-def pack(docs, T, pad_id=0):
-    """Greedy first-fit packing → (tokens [B, T], segments [B, T]).
-    Segment id 0 marks padding; documents get ids 1, 2, ... per row."""
-    rows, segs = [], []
-    for doc in docs:
-        placed = False
-        for r in range(len(rows)):
-            if len(rows[r]) + len(doc) <= T:
-                segs[r] += [max(segs[r]) + 1] * len(doc)
-                rows[r] += doc
-                placed = True
-                break
-        if not placed:
-            rows.append(list(doc[:T]))
-            segs.append([1] * len(rows[-1]))
-    for r in range(len(rows)):
-        fill = T - len(rows[r])
-        rows[r] += [pad_id] * fill
-        segs[r] += [0] * fill
-    return (jnp.asarray(rows, jnp.int32), jnp.asarray(segs, jnp.int32))
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
     args = ap.parse_args()
 
+    from deepspeed_tpu.data.packing import pack_documents, packing_efficiency
+
     cfg = llama.LlamaConfig.tiny()
     rng = np.random.default_rng(0)
     docs = [rng.integers(1, cfg.vocab_size, rng.integers(5, 20)).tolist()
             for _ in range(12)]
-    tokens, segments = pack(docs, T=33)
+    tokens, segments = pack_documents(docs, seq_len=33)
+    tokens, segments = jnp.asarray(tokens), jnp.asarray(segments)
     print(f"packed {len(docs)} docs into {tokens.shape[0]} rows of "
-          f"{tokens.shape[1]} ({float((segments > 0).mean()):.0%} tokens live)")
+          f"{tokens.shape[1]} ({packing_efficiency(segments):.0%} tokens live)")
 
     # llama.loss_fn understands batch["segment_ids"] natively: it slices
     # the ids to the input window, isolates attention per document, and
