@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Packing-efficiency evidence (chip-independent): live-token fraction
+of greedy first-fit packing (data/packing.py) vs one-document-per-row
+padded batching, over realistic document-length distributions.  The
+live fraction bounds compute utilization directly — attention and FLOPs
+are spent on every slot, so 2x live fraction ≈ 2x useful tokens/s at
+equal hardware throughput.
+
+    python tools/packing_evidence.py            # writes PACKING_BENCH.json
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.data.packing import pack_documents, packing_efficiency
+
+
+def padded_efficiency(lengths, T):
+    """One document per row, truncated to T: live fraction."""
+    lengths = np.minimum(lengths, T)
+    return float(lengths.sum() / (len(lengths) * T))
+
+
+def main():
+    rng = np.random.default_rng(0)
+    rows = []
+    for name, lengths in (
+            # lognormal ~ web-corpus doc lengths (median ~180 tokens)
+            ("web_lognormal", np.minimum(rng.lognormal(
+                5.2, 1.1, 20000).astype(int) + 1, 16384)),
+            # chat turns: short, tight spread
+            ("chat_short", rng.integers(16, 384, 20000)),
+            # books: long docs, most exceed T
+            ("books_long", rng.integers(1500, 12000, 2000))):
+        for T in (512, 2048, 8192):
+            docs = [[1] * int(n) for n in lengths]
+            toks, segs = pack_documents(docs, seq_len=T)
+            packed = packing_efficiency(segs)
+            padded = padded_efficiency(lengths, T)
+            rows.append({
+                "distribution": name, "seq_len": T,
+                "padded_live_frac": round(padded, 4),
+                "packed_live_frac": round(packed, 4),
+                "useful_token_speedup": round(packed / max(padded, 1e-9), 2),
+                "rows_padded": len(lengths), "rows_packed": int(toks.shape[0]),
+            })
+            print(rows[-1], flush=True)
+    out = {"metric": "packing_live_token_fraction", "rows": rows,
+           "note": "live fraction bounds useful-FLOPs fraction; "
+                   "speedup = packed/padded at equal hardware throughput"}
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PACKING_BENCH.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print("→", path)
+
+
+if __name__ == "__main__":
+    main()
