@@ -232,16 +232,12 @@ def _attention(q, k, v, cfg: LlamaConfig, segment_ids=None):
                                              segment_ids=segment_ids)
         impl = "auto"  # no seq axis in scope: plain attention
     if impl == "sparse":
-        if segment_ids is not None:
-            raise NotImplementedError(
-                "packed-sequence segment_ids are not supported on the "
-                "blocksparse path yet")
         sa = _sparse_self_attention(cfg)   # cached per-config wrapper
         rep = cfg.n_heads // cfg.n_kv_heads
         kh = jnp.repeat(k, rep, axis=2) if rep > 1 else k
         vh = jnp.repeat(v, rep, axis=2) if rep > 1 else v
         out = sa(q.transpose(0, 2, 1, 3), kh.transpose(0, 2, 1, 3),
-                 vh.transpose(0, 2, 1, 3))
+                 vh.transpose(0, 2, 1, 3), segment_ids=segment_ids)
         return out.transpose(0, 2, 1, 3)
     if impl in ("auto", "flash"):
         try:

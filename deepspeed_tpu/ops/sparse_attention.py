@@ -304,7 +304,8 @@ def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      layout: np.ndarray, block: int,
                      causal: bool = False,
                      scale: Optional[float] = None,
-                     attn_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                     attn_mask: Optional[jnp.ndarray] = None,
+                     segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Block-sparse attention over a static layout.
 
     q/k/v: [B, H, S, D]; layout: numpy bool [H, S//block, S//block].
@@ -335,12 +336,19 @@ def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     kb = k.reshape(B, H, nb, block, D)
     vb = v.reshape(B, H, nb, block, D)
 
-    # Gather active key/value blocks per (head, query-row):
-    # kg[b,h,i,a] = kb[b,h,idx[h,i,a]] → [B,H,nb,A,block,D]
-    def gather_h(kb_h, idx_h):                     # [B,nb,bl,D], [nb,A]
-        return kb_h[:, idx_h]                      # [B,nb,A,bl,D]
-    kg = jax.vmap(gather_h, in_axes=(1, 0), out_axes=1)(kb, idx)
-    vg = jax.vmap(gather_h, in_axes=(1, 0), out_axes=1)(vb, idx)
+    # Gather active key-side rows per (head, query-row) — ONE helper for
+    # K/V blocks, padding masks, and segment ids, so the plan semantics
+    # cannot drift between them:
+    def gather_rows(x, per_head):
+        """x: [B, nb, ...] (shared) or [B, H, nb, ...]; idx: [H, nb, A]
+        → [B, H, nb, A, ...]."""
+        f = lambda x_h, idx_h: x_h[:, idx_h]
+        if per_head:
+            return jax.vmap(f, in_axes=(1, 0), out_axes=1)(x, idx)
+        return jax.vmap(f, in_axes=(None, 0), out_axes=1)(x, idx)
+
+    kg = gather_rows(kb, per_head=True)            # [B,H,nb,A,bl,D]
+    vg = gather_rows(vb, per_head=True)
 
     # scores [B,H,nb,block, A,block]
     s = jnp.einsum("bhiqd,bhiakd->bhiqak", qb, kg,
@@ -356,11 +364,17 @@ def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         s = s + jnp.where(cmask, 0.0, NEG_INF)[None]
     if attn_mask is not None:
         # attn_mask [B, S] key padding mask (1 = keep), ref's key_padding_mask
-        mb = attn_mask.reshape(B, 1, nb, block)              # [B,1,nb,bl]
-        mg = jax.vmap(lambda m_h, idx_h: m_h[:, idx_h],
-                      in_axes=(None, 0), out_axes=1)(
-                          mb[:, 0], idx)                      # [B,H,nb,A,bl]
+        mg = gather_rows(attn_mask.reshape(B, nb, block),
+                         per_head=False)                      # [B,H,nb,A,bl]
         s = s + jnp.where(mg[:, :, :, None], 0.0, NEG_INF)
+    if segment_ids is not None:
+        # packed layout: [B, S] int32 ids; key-side ids gather by the
+        # same plan as the K blocks, query side reshapes in place
+        segb = segment_ids.reshape(B, nb, block)             # [B,nb,bl]
+        sg = gather_rows(segb, per_head=False)               # [B,H,nb,A,bl]
+        same = (segb[:, None, :, :, None, None]
+                == sg[:, :, :, None])                         # [B,H,nb,bl,A,bl]
+        s = s + jnp.where(same, 0.0, NEG_INF)
     sf = s.reshape(B, H, nb, block, A * block)
     m = jnp.max(sf, axis=-1, keepdims=True)
     p = jnp.exp(sf - m)
@@ -388,11 +402,12 @@ class SparseSelfAttention:
             self._layouts[seq_len] = self.config.make_layout(seq_len)
         return self._layouts[seq_len]
 
-    def __call__(self, q, k, v, attn_mask=None):
+    def __call__(self, q, k, v, attn_mask=None, segment_ids=None):
         S = q.shape[2]
         return sparse_attention(q, k, v, self.layout(S),
                                 self.config.block, causal=self.causal,
-                                attn_mask=attn_mask)
+                                attn_mask=attn_mask,
+                                segment_ids=segment_ids)
 
     def density(self, seq_len: int) -> float:
         lay = self.layout(seq_len)

@@ -287,3 +287,34 @@ class TestLlamaSparseAttention:
             sparsity_config_from_dict({"mode": "nope"}, 4)
         with pytest.raises(ValueError, match="does not accept"):
             sparsity_config_from_dict({"mode": "fixed", "bogus": 1}, 4)
+
+
+def test_sparse_segment_ids_match_masked_dense():
+    """Packed layout on the blocksparse path: block mask AND same-segment
+    must equal the dense oracle with the combined token mask."""
+    B, H, S, D = 2, 2, 64, 8
+    q, k, v = _qkv(B, H, S, D)
+    seg = jnp.asarray(np.concatenate(
+        [np.full((B, 24), 1, np.int32), np.full((B, 40), 2, np.int32)], 1))
+    cfg = DenseSparsityConfig(num_heads=H, block=16)
+    out = sparse_attention(q, k, v, cfg.make_layout(S), 16,
+                           segment_ids=seg)
+    same = (seg[:, :, None] == seg[:, None, :])[:, None]    # [B,1,S,S]
+    ref = _ref_attention(q, k, v, same)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_sparse_segment_ids_with_causal_and_blocks():
+    B, H, S, D = 1, 2, 64, 8
+    q, k, v = _qkv(B, H, S, D)
+    seg = jnp.asarray(np.concatenate(
+        [np.full((B, 32), 5, np.int32), np.full((B, 32), 9, np.int32)], 1))
+    cfg = LocalSlidingWindowSparsityConfig(
+        num_heads=H, block=16, num_sliding_window_blocks=3)
+    lay = cfg.make_layout(S)
+    out = sparse_attention(q, k, v, lay, 16, causal=True, segment_ids=seg)
+    blockmask = jnp.asarray(np.kron(lay, np.ones((16, 16), bool)))[None]
+    causal = jnp.tril(jnp.ones((S, S), bool))[None, None]
+    same = (seg[:, :, None] == seg[:, None, :])[:, None]
+    ref = _ref_attention(q, k, v, blockmask & causal & same)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
