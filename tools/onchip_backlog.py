@@ -124,8 +124,8 @@ ITEMS = {
     "bench_tuned": ([PY, "bench.py"], 1800),
     # r5 kernels already captured when this was added, so the v2 decode
     # A/B (paged_decode_attention_v2 vs v1 vs gather) runs as its own item
-    "kernels_v2": ([PY, "tools/kernel_bench.py",
-                    "--families", "paged_decode_v2,chunk_prefill_v2",
+    "kernels_v2": ([PY, "tools/kernel_bench.py", "--families",
+                    "paged_decode_v2,chunk_prefill_v2,flash_packed",
                     "--json-out", "KERNEL_BENCH_V2.json"], 1800),
     "infinity": ([PY, "tools/infinity_evidence.py", "--steps", "3"], 7200),
     # 8b, cpu tier: the largest >HBM-bf16 proof this host can hold
