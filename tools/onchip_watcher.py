@@ -105,6 +105,16 @@ def pidfile_guard() -> bool:
 
 MAX_STAGE_ATTEMPTS = 3
 
+# hard stand-down time (epoch secs, DSTPU_WATCHER_DEADLINE): the driver
+# runs its own bench.py at round end — a watcher stage holding the chip
+# at that moment would collide (double HBM allocation → the DRIVER's
+# headline capture OOMs to CPU).  0 = no deadline.
+DEADLINE = float(os.environ.get("DSTPU_WATCHER_DEADLINE", "0"))
+
+
+def _past_deadline() -> bool:
+    return DEADLINE > 0 and time.time() >= DEADLINE
+
 
 def main():
     if pidfile_guard():
@@ -118,6 +128,11 @@ def main():
     n = 0
     attempts = {name: 0 for name, _, _ in STAGES}
     while True:
+        if _past_deadline():
+            put_status(state="deadline_exit", stage_attempts=attempts)
+            print("deadline reached — standing down for the driver's "
+                  "end-of-round bench", flush=True)
+            return
         up = probe()
         n += 1
         put_status(state="probing", attempt=n, chip_up=up,
@@ -147,6 +162,15 @@ def main():
                 print("tunnel dropped — back to probing", flush=True)
                 dropped = True
                 break
+            if DEADLINE > 0:
+                # never let a stage run past the stand-down time
+                remaining = DEADLINE - time.time()
+                if remaining < 120:
+                    put_status(state="deadline_exit", done=done,
+                               stage_attempts=attempts)
+                    print("deadline imminent — standing down", flush=True)
+                    return
+                deadline = min(deadline, int(remaining))
             attempts[name] += 1
             put_status(state="running", stage=name, done=done,
                        stage_attempts=attempts)
