@@ -21,11 +21,21 @@ import jax  # noqa: E402
 # been initialized yet.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
-# persistent compile cache: the suite is compile-bound on this image's
-# SINGLE cpu core (~2.5 s avg/test, almost all jit), and most test jaxprs
-# are identical across reruns — a warm cache roughly halves the lane
-jax.config.update("jax_compilation_cache_dir", "/tmp/dstpu_test_jit_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# NO persistent compile cache: XLA's CPU AOT cache loader can serve an
+# artifact whose recorded machine features mismatch the host
+# (cpu_aot_loader "+prefer-no-scatter ... not supported" warnings) and
+# that escalated to a hard `Fatal Python error: Aborted` mid-suite —
+# a ~2x warm-rerun speedup is not worth a nondeterministic crash.
+# Opt back in locally with DSTPU_TEST_JIT_CACHE=/some/dir.
+_cache = os.environ.get("DSTPU_TEST_JIT_CACHE")
+if _cache:
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+else:
+    # explicit None: jax_compilation_cache_dir is env-backed, and an
+    # inherited JAX_COMPILATION_CACHE_DIR (e.g. from the on-chip
+    # tools' environment) would silently re-enable the cache
+    jax.config.update("jax_compilation_cache_dir", None)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
