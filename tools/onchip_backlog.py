@@ -109,8 +109,9 @@ os.environ.setdefault("DSTPU_BENCH_TPU_S", "1500")
 # windows are ~5 min (r5), often shorter than one item's compile — a
 # window that dies mid-compile still warms the cache, so the NEXT
 # window resumes at execution instead of recompiling from scratch
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      "/tmp/dstpu_tpu_jit_cache")
+# SAME dir bench.py's TPU child uses, so compiles accumulated in watcher
+# windows also warm the driver's end-of-round bench run
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dstpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 ITEMS = {
     "probe": ([PY, "-c", "import jax; print(jax.devices())"], 120),
