@@ -55,6 +55,71 @@ class ZeroConfig:
 
 
 @dataclasses.dataclass
+class ZeroInferenceConfig:
+    """ZeRO-Inference serving block (ref: deepspeed ZeRO-Inference,
+    arXiv:2206.01861, built on ZeRO-Infinity's parameter offload,
+    arXiv:2104.07857): serve models whose weight image exceeds HBM by
+    hosting transformer-layer weights on a host-RAM or NVMe tier and
+    streaming them through a small double-buffered HBM working set while
+    stem + head stay resident.
+
+    ``hbm_budget_bytes``: the planner pins as many layers HBM-resident
+    as fit under this budget (stem + head + KV cache + the prefetch
+    working set are charged first) and streams the rest; ``None``
+    streams every layer — the serve-anything default, matching the
+    reference's "no pinning" posture.  ``dtype``: streamed-weight dtype
+    override (``None`` inherits the builder's ``weight_dtype``; int8
+    composes — the tier then holds int8 codes + group scales and the
+    per-layer dequant is traced into each block program).
+    """
+
+    enabled: bool = False
+    hbm_budget_bytes: Optional[int] = None
+    prefetch_depth: int = 1
+    tier: str = "host"                   # host | nvme
+    nvme_path: str = "/tmp/dstpu_nvme_swap"
+    dtype: Optional[str] = None          # None (inherit) | bfloat16 | int8
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ZeroInferenceConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        z = cls(**{k: v for k, v in d.items() if k in known})
+        if z.tier not in ("host", "nvme"):
+            raise ValueError(
+                f"zero_inference.tier must be 'host' or 'nvme', got "
+                f"{z.tier!r}")
+        if z.hbm_budget_bytes is not None and z.hbm_budget_bytes <= 0:
+            raise ValueError(
+                f"zero_inference.hbm_budget_bytes must be positive or "
+                f"null (null = stream every layer), got "
+                f"{z.hbm_budget_bytes}")
+        if z.prefetch_depth < 1:
+            raise ValueError(
+                f"zero_inference.prefetch_depth must be >= 1, got "
+                f"{z.prefetch_depth}")
+        if z.dtype not in (None, "bfloat16", "int8"):
+            raise ValueError(
+                f"zero_inference.dtype must be bfloat16 or int8, got "
+                f"{z.dtype!r}")
+        return z
+
+    @classmethod
+    def coerce(cls, obj) -> "ZeroInferenceConfig":
+        """Accept a dict, a ZeroInferenceConfig, or None (disabled)."""
+        if obj is None:
+            return cls(enabled=False)
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("enabled", True)   # passing a block opts in
+            return cls.from_dict(d)
+        raise TypeError(
+            f"zero_inference must be a dict or ZeroInferenceConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class PrecisionConfig:
     """ref: deepspeed/runtime/fp16/loss_scaler.py + config fp16/bf16 blocks."""
 
@@ -196,6 +261,8 @@ class Config:
     progressive_layer_drop: Optional[Dict[str, Any]] = None
     eigenvalue: Optional[Dict[str, Any]] = None
     sparse_attention: Optional[Dict[str, Any]] = None
+    zero_inference: ZeroInferenceConfig = dataclasses.field(
+        default_factory=ZeroInferenceConfig)
     raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     # ---------------------------------------------------------------- parse
@@ -290,6 +357,14 @@ class Config:
             c.eigenvalue = dict(d["eigenvalue"])
         if d.get("sparse_attention"):
             c.sparse_attention = dict(d["sparse_attention"])
+        if "zero_inference" in d:
+            # coerce, not from_dict: WRITING the block is the opt-in
+            # (same contract as serving_engine(zero_inference={...})) —
+            # a user configuring tier/budget but omitting "enabled"
+            # must never be silently served fully resident; an explicit
+            # "enabled": false still disables
+            c.zero_inference = ZeroInferenceConfig.coerce(
+                d["zero_inference"])
         return c
 
     @classmethod

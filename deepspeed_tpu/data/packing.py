@@ -62,10 +62,11 @@ class PackedDataLoader:
     the llama/Mixtral ``loss_fn`` contract).
 
     Greedy packing runs over a window of ``batch_rows * fill_factor``
-    documents at a time; leftover rows of a window are emitted before
-    the next window starts, and a final short window is padded up to
-    ``batch_rows`` with empty (all-padding) rows so every batch has the
-    same static shape.
+    documents at a time; rows left over when a window can't fill a whole
+    batch CARRY OVER into the pending pool and mix with the next
+    window's rows (no row is emitted early), and the final short batch
+    is padded up to ``batch_rows`` with empty (all-padding) rows so
+    every batch has the same static shape.
     """
 
     def __init__(self, documents: Sequence[Sequence[int]],

@@ -1,3 +1,4 @@
 """Inference subsystem (ref: deepspeed/inference/)."""
 
-from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
+from deepspeed_tpu.inference.engine import (InferenceEngine,
+                                            init_inference, init_serving)

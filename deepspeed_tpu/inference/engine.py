@@ -103,3 +103,29 @@ def init_inference(model: Any = None, *, apply_fn: Optional[Callable] = None,
     return InferenceEngine(apply_fn, params, mesh=mesh,
                            param_specs=param_specs, dtype=dtype,
                            quant_group_size=quant_group_size)
+
+
+def init_serving(params, model_config, *, config: Any = None,
+                 mesh: Optional[MeshSpec] = None, **kw):
+    """Serving counterpart of :func:`init_inference` (ref: the reference
+    serves through ``init_inference`` + DeepSpeed-MII's serve loop):
+    build the continuous-batching engine for a model-family config,
+    honoring a DeepSpeed-style JSON config.
+
+    A ``zero_inference`` block in ``config`` routes to the weight-
+    streamed ZeRO-Inference engine
+    (:mod:`deepspeed_tpu.inference.zero_inference`): layer weights live
+    on a host/NVMe tier and stream double-buffered through a bounded
+    HBM working set, so the served weight image may exceed HBM.  Its
+    ``dtype`` field (e.g. ``int8``) overrides ``weight_dtype``.
+    Remaining ``kw`` (``max_batch``, ``page_size``, ``num_pages``,
+    ``decode_chunk``, ``prefill_chunk``, ``weight_dtype``, …) pass
+    through to the family builder.
+    """
+    from deepspeed_tpu.inference.serving import serving_engine
+
+    if isinstance(config, dict):
+        config = Config.from_dict(config)
+    if config is not None and config.zero_inference.enabled:
+        kw.setdefault("zero_inference", config.zero_inference)
+    return serving_engine(params, model_config, mesh=mesh, **kw)

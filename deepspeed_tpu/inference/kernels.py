@@ -298,7 +298,9 @@ def paged_decode_attention_v2(q, k_pages, v_pages, table, seq_lens,
 def _chunk_v2_kernel(table_ref, start_ref, q_ref, k_hbm, v_hbm, o_ref, *,
                      scale, ps, kv_heads, max_pages, cg8, group, chunk,
                      ppcb):
-    """Chunked-prefill twin of :func:`_decode_v2_kernel`: one grid step
+    """The multi-page v2 kernel (decode shares it:
+    :func:`paged_decode_attention_v2` delegates here as the C=1 chunked
+    case — there is no separate decode kernel): one grid step
     per (batch, kv_head); K/V pages stream ppcb at a time through a
     double-buffered VMEM scratch, and the page sweep stops at the last
     page holding any position ``<= start + C - 1`` (history + chunk),

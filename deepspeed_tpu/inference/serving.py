@@ -112,6 +112,7 @@ class ServingEngine:
         # error, not a resharding).
         active = mesh is not None and any(
             mesh.size(ax) > 1 for ax in ("model", "expert"))
+        self._mesh = mesh
         if active:
             from jax.sharding import PartitionSpec as P
 
@@ -158,25 +159,56 @@ class ServingEngine:
             return (jax.device_put(x, self._repl)
                     if self._repl is not None else x)
 
+        self._put = put_repl
+        self.cache = self._alloc_cache(n_layers, n_kv, num_pages,
+                                       page_size, head_dim, cache_dtype)
+        self._build_programs(prefill_fn, decode_fn, chunk_prefill_fn)
+        self._table_host = np.full((max_batch, self.max_pages_per_seq),
+                                   self.trash_page, np.int32)
+        # dirty flags: device table/seq_lens re-upload only when the slot
+        # composition changed since the last decode
+        self._table_dirty = True
+        self._lens_dirty = True
+        self.slots: List[Optional[_Slot]] = [None] * max_batch
+        self.queue: "collections.deque[Request]" = collections.deque()
+        self._seq_counter = 0
+        self._rng = jax.random.PRNGKey(seed)
+        self.finished: Dict[Any, List[int]] = {}
+        self._newly_finished: List[Any] = []
+        self.stats = {"admitted": 0, "preempted": 0, "decode_steps": 0,
+                      "decode_syncs": 0, "prefill_chunks": 0}
+
+    # -------------------------------------------------- subclass hooks
+    # (the ZeRO-Inference engine swaps both: per-layer cache tuples so
+    # streamed block programs update one layer's pages in place, and
+    # host-driven streamed executors in place of the whole-model jits)
+    def _alloc_cache(self, n_layers, n_kv, num_pages, page_size,
+                     head_dim, cache_dtype) -> PagedKVCache:
         def put_kv(x):
             return (jax.device_put(x, self._kv_sharding)
                     if self._kv_sharding is not None else x)
 
-        self._put = put_repl
-        self.cache = PagedKVCache(
+        return PagedKVCache(
             k=put_kv(jnp.zeros(
                 (n_layers, n_kv, num_pages, page_size, head_dim),
                 cache_dtype)),
             v=put_kv(jnp.zeros(
                 (n_layers, n_kv, num_pages, page_size, head_dim),
                 cache_dtype)),
-            table=put_repl(jnp.full((max_batch, self.max_pages_per_seq),
-                                    self.trash_page, jnp.int32)),
-            seq_lens=put_repl(jnp.zeros((max_batch,), jnp.int32)),
+            table=self._put(jnp.full(
+                (self.max_batch, self.max_pages_per_seq),
+                self.trash_page, jnp.int32)),
+            seq_lens=self._put(jnp.zeros((self.max_batch,), jnp.int32)),
             page_size=page_size)
 
+    def _build_programs(self, prefill_fn, decode_fn,
+                        chunk_prefill_fn) -> None:
+        """Install ``self._prefill`` / ``self._chunk_prefill`` /
+        ``self._decode_chunk_fn`` — any callables honoring the jitted
+        contracts; the base engine compiles whole-model programs."""
         self._prefill = jax.jit(prefill_fn, donate_argnums=(2,))
-        self._chunk_prefill = (jax.jit(chunk_prefill_fn, donate_argnums=(2,))
+        self._chunk_prefill = (jax.jit(chunk_prefill_fn,
+                                       donate_argnums=(2,))
                                if chunk_prefill_fn is not None else None)
 
         # K decode steps in ONE on-device scan: each step's sampled token
@@ -198,20 +230,6 @@ class ServingEngine:
             return jnp.swapaxes(toks, 0, 1), cache          # [B, K]
 
         self._decode_chunk_fn = jax.jit(chunk_fn, donate_argnums=(2,))
-        self._table_host = np.full((max_batch, self.max_pages_per_seq),
-                                   self.trash_page, np.int32)
-        # dirty flags: device table/seq_lens re-upload only when the slot
-        # composition changed since the last decode
-        self._table_dirty = True
-        self._lens_dirty = True
-        self.slots: List[Optional[_Slot]] = [None] * max_batch
-        self.queue: "collections.deque[Request]" = collections.deque()
-        self._seq_counter = 0
-        self._rng = jax.random.PRNGKey(seed)
-        self.finished: Dict[Any, List[int]] = {}
-        self._newly_finished: List[Any] = []
-        self.stats = {"admitted": 0, "preempted": 0, "decode_steps": 0,
-                      "decode_syncs": 0, "prefill_chunks": 0}
 
     # ------------------------------------------------------------- requests
     def submit(self, req_id, tokens, max_new_tokens: int = 32,
@@ -517,9 +535,27 @@ def _shard_params_for_serving(params, specs_tree, mesh):
                            mesh)
 
 
+def _route_zero_inference(zero_inference, family: str, params, cfg,
+                          weight_dtype, quant_group_size, mesh, kw):
+    """Shared builder branch: a live ``zero_inference`` block routes to
+    the weight-streamed engine (inference/zero_inference.py); returns
+    None when the resident path should proceed."""
+    from deepspeed_tpu.config import ZeroInferenceConfig
+
+    zi = ZeroInferenceConfig.coerce(zero_inference)
+    if not zi.enabled:
+        return None
+    from deepspeed_tpu.inference.zero_inference import (
+        zero_inference_serving_engine)
+
+    return zero_inference_serving_engine(
+        params, cfg, zi, family=family, weight_dtype=weight_dtype,
+        quant_group_size=quant_group_size, mesh=mesh, **kw)
+
+
 def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
                          quant_group_size: int = 128, mesh=None,
-                         **kw) -> ServingEngine:
+                         zero_inference=None, **kw) -> ServingEngine:
     """ServingEngine over models/llama.py's paged forward.
 
     ``weight_dtype="int8"``: weight-only quantized serving (ref:
@@ -531,8 +567,20 @@ def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
     shards its head axis, and both jits run under GSPMD with the psum
     after wo/w2 inserted by XLA.  The mesh is published ambient so the
     forward picks its TP-compatible attention paths.
+
+    ``zero_inference``: a :class:`~deepspeed_tpu.config.
+    ZeroInferenceConfig` (or its dict form) routes to the weight-
+    streamed ZeRO-Inference engine — layer weights live on a host/NVMe
+    tier and stream through a double-buffered HBM working set, so the
+    served model's weight image may exceed HBM.
     """
     from deepspeed_tpu.models import llama
+
+    zi_engine = _route_zero_inference(
+        zero_inference, "llama", params, cfg, weight_dtype,
+        quant_group_size, mesh, kw)
+    if zi_engine is not None:
+        return zi_engine
 
     # tp baked in at BUILD time: the compiled paths must not re-read the
     # mutable ambient mesh on a later retrace (a cleared/replaced global
@@ -568,12 +616,20 @@ def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
 
 def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
                            quant_group_size: int = 128, mesh=None,
-                           **kw) -> ServingEngine:
+                           zero_inference=None, **kw) -> ServingEngine:
     """ServingEngine over models/mixtral.py's paged MoE forward (ref:
     DeepSpeed-MoE inference serving, deepspeed/inference/engine.py) —
     iteration-level scheduling, paged KV, split-fuse and decode chunking
-    all apply to the MoE model unchanged."""
+    all apply to the MoE model unchanged.  ``zero_inference`` streams
+    the expert stacks (the dominant MoE weight bytes) from a host/NVMe
+    tier, like the llama builder."""
     from deepspeed_tpu.models import mixtral
+
+    zi_engine = _route_zero_inference(
+        zero_inference, "mixtral", params, cfg, weight_dtype,
+        quant_group_size, mesh, kw)
+    if zi_engine is not None:
+        return zi_engine
 
     # sharded MoE serving (ref: DeepSpeed-MoE inference — expert
     # parallelism, optionally composed with Megatron TP): the stacked
@@ -692,6 +748,18 @@ def serving_engine(params, cfg, **kw):
         return mixtral_serving_engine(params, cfg, **kw)
     if isinstance(cfg, LlamaConfig):
         return llama_serving_engine(params, cfg, **kw)
+    zi = kw.pop("zero_inference", None)
+    if zi is not None:
+        from deepspeed_tpu.config import ZeroInferenceConfig
+
+        if ZeroInferenceConfig.coerce(zi).enabled:
+            # weight streaming needs the per-layer paged factoring,
+            # which the layered decoder families provide (llama +
+            # mixtral); fail loudly, never silently serve resident
+            raise NotImplementedError(
+                f"zero_inference streaming is not wired for "
+                f"{type(cfg).__name__} — supported: LlamaConfig, "
+                "MixtralConfig")
     if isinstance(cfg, GPT2Config):
         return gpt2_serving_engine(params, cfg, **kw)
     if isinstance(cfg, BertConfig):

@@ -1,0 +1,475 @@
+"""ZeRO-Inference for TPU serving: serve models LARGER than HBM by
+streaming layer weights host→HBM under the decode sweep.
+
+Reference: DeepSpeed ZeRO-Inference (arXiv:2206.01861, built on
+ZeRO-Infinity's parameter offload, arXiv:2104.07857 +
+deepspeed/runtime/swap_tensor/partitioned_param_swapper.py): model
+weights live on a host-RAM or NVMe tier; per-layer weights are fetched
+into device memory just ahead of their layer's compute and released
+after, so GPU/TPU residency is O(layers-in-flight), not O(model), and
+throughput is bound by link bandwidth × batch, not by HBM capacity.
+
+TPU design.  The serving stack already factors per request phase into
+static-shape programs (:class:`~deepspeed_tpu.inference.serving.
+ServingEngine`); this module re-factors the MODEL the same way the
+training :class:`~deepspeed_tpu.param_stream.ParamStreamEngine` does —
+per-LAYER jits instead of one whole-model jit:
+
+    stem:   (stem, tokens, start) -> (x, cos, sin)       [resident]
+    block:  (lp, x, cos, sin, kp, vp, table, start)
+            -> (x, kp, vp)                               [one layer]
+    head:   (head, x) -> logits                          [resident]
+
+The HOST drives the layer sweep.  Streamed layers ride the shared
+:class:`~deepspeed_tpu.param_stream.TierLayerReader` pipeline: while
+layer ``l``'s block program computes, layer ``l+1``'s tier read (NVMe
+aio on alternating slots, or host buffers) and its async H2D upload are
+already in flight — the same double-buffered phase overlap the training
+engine uses, re-targeted at decode.  The KV cache is stored as
+PER-LAYER page arrays (a tuple, not a stacked [L, ...] block) so each
+block program donates and updates exactly one layer's pages in place —
+no cross-layer cache copies on the hot path.
+
+An HBM-budget planner (:func:`plan_residency`) charges stem + head +
+the KV cache + the ``(prefetch_depth + 1)``-layer streaming working set
+against ``hbm_budget_bytes`` and pins as many leading layers resident
+as still fit; the rest stream.  ``hbm_budget_bytes: null`` streams
+every layer (the serve-anything default).  Composes with:
+
+- the paged-KV decode kernels: block programs call the same
+  :func:`~deepspeed_tpu.inference.kernels.paged_attention_step` the
+  whole-model forward uses — token-identical output;
+- int8 weight-only quantization: the tier holds int8 codes + group
+  scales and each block program traces its own dequant;
+- tensor/expert parallelism: streamed uploads land pre-sharded via the
+  model's own PartitionSpecs (per-layer, layer axis dropped), the KV
+  head axis shards over ``model``;
+- the continuous-batching scheduler: admission, paging, split-fuse and
+  chunked decode run unchanged — only the three compiled entry points
+  are swapped for host-driven streamed executors.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.config import ZeroInferenceConfig
+from deepspeed_tpu.infinity import _NvmeTier, _RamTier
+from deepspeed_tpu.inference.kernels import PagedKVCache
+from deepspeed_tpu.inference.serving import ServingEngine, _sample_rows
+from deepspeed_tpu.param_stream import TierLayerReader
+from deepspeed_tpu.utils.logging import logger
+
+
+def _unused_program(*_a, **_k):  # pragma: no cover - must never run
+    raise AssertionError(
+        "ZeroInferenceServingEngine replaces the whole-model programs "
+        "with host-driven streamed executors")
+
+
+def plan_residency(*, n_layers: int, layer_bytes: int,
+                   stem_head_bytes: int, cache_bytes: int,
+                   budget: Optional[int],
+                   prefetch_depth: int) -> Dict[str, Any]:
+    """HBM-budget planner: how many leading layers stay resident.
+
+    Fixed charges come first — stem + head weights, the paged KV cache,
+    and (whenever anything streams) the ``(prefetch_depth + 1)``-layer
+    double-buffer working set.  Whatever budget remains pins layers
+    resident.  ``budget=None`` streams everything; a budget that cannot
+    even hold the fixed charges is a config error, not a silent OOM.
+    """
+    total_resident = stem_head_bytes + cache_bytes + n_layers * layer_bytes
+    working = (prefetch_depth + 1) * layer_bytes
+    if budget is None:
+        n_res = 0
+    elif budget >= total_resident:
+        n_res = n_layers
+    else:
+        floor = stem_head_bytes + cache_bytes + working
+        if floor > budget:
+            raise ValueError(
+                f"zero_inference.hbm_budget_bytes={budget} cannot hold "
+                f"the streaming floor: stem+head {stem_head_bytes} B + "
+                f"KV cache {cache_bytes} B + {prefetch_depth + 1}-layer "
+                f"working set {working} B = {floor} B")
+        n_res = min(n_layers - 1, (budget - floor) // max(layer_bytes, 1))
+    ws = stem_head_bytes + cache_bytes + n_res * layer_bytes + (
+        0 if n_res == n_layers else working)
+    return {
+        "n_layers": n_layers,
+        "n_resident": int(n_res),
+        "n_streamed": int(n_layers - n_res),
+        "layer_bytes": int(layer_bytes),
+        "stem_head_bytes": int(stem_head_bytes),
+        "cache_bytes": int(cache_bytes),
+        "weight_image_bytes": int(stem_head_bytes
+                                  + n_layers * layer_bytes),
+        "hbm_budget_bytes": budget,
+        "prefetch_depth": int(prefetch_depth),
+        "hbm_working_set_bytes": int(ws),
+    }
+
+
+class ZeroInferenceServingEngine(ServingEngine):
+    """Weight-streamed continuous-batching serving engine.
+
+    Drop-in for :class:`ServingEngine` — same ``submit``/``step``/
+    ``run`` surface, same scheduler — with the three compiled entry
+    points replaced by host drivers that sweep per-layer programs and
+    stream non-resident layer weights from ``self.tier``.  ``plan``
+    carries the residency decision;
+    :meth:`hbm_weight_working_set_bytes` is the streaming contract
+    (compare: the full weight image for the resident engine).
+    """
+
+    def __init__(self, *, stem, blocks, head, fns, zi: ZeroInferenceConfig,
+                 n_layers: int, n_kv: int, head_dim: int, mesh=None,
+                 stem_specs=None, head_specs=None, layer_specs=None,
+                 **kw):
+        self._zi = zi
+        self._stem_fn, self._block_fn, self._head_fn = fns
+        self._layer_specs = layer_specs
+        self._L = n_layers
+
+        # ---- per-layer leaf records from the stacked blocks tree.
+        # Leaves stay host-side VIEWS of the caller's arrays where
+        # possible: inference never mutates weights, so the tier can
+        # alias them (unlike the training engine's mutating tier).
+        leaves, self._btree = jax.tree_util.tree_flatten(blocks)
+        leaves = [np.asarray(a) for a in leaves]
+        for a in leaves:
+            if a.shape[0] != n_layers:
+                raise ValueError(
+                    f"stacked block leaf {a.shape} does not carry the "
+                    f"layer axis (n_layers={n_layers}) in dim 0")
+        self._bshapes = [a.shape[1:] for a in leaves]
+        self._bdtypes = [a.dtype for a in leaves]
+        layer_bytes = sum(a.nbytes // n_layers for a in leaves)
+
+        # ---- residency plan.  Cache geometry mirrors ServingEngine's
+        # signature defaults (kw is forwarded verbatim to super()).
+        num_pages = kw.get("num_pages", 128)
+        page_size = kw.get("page_size", 16)
+        cache_dtype = kw.get("cache_dtype", jnp.bfloat16)
+        cache_bytes = (2 * n_layers * n_kv * num_pages * page_size
+                       * head_dim * jnp.dtype(cache_dtype).itemsize)
+        # dedupe shared leaves by identity: tied-embedding models alias
+        # ONE table between stem and head — charging it twice would
+        # overstate the fixed charge by the largest resident tensor
+        seen_ids = set()
+        stem_head_bytes = 0
+        for x in jax.tree.leaves((stem, head)):
+            if id(x) not in seen_ids:
+                seen_ids.add(id(x))
+                stem_head_bytes += x.nbytes
+        self.plan = plan_residency(
+            n_layers=n_layers, layer_bytes=layer_bytes,
+            stem_head_bytes=stem_head_bytes, cache_bytes=cache_bytes,
+            budget=zi.hbm_budget_bytes, prefetch_depth=zi.prefetch_depth)
+        n_res = self.plan["n_resident"]
+        self._streamed_ids = list(range(n_res, n_layers))
+
+        # ---- tier ingest for the streamed suffix
+        if zi.tier == "nvme" and self._streamed_ids:
+            self.tier = _NvmeTier(
+                os.path.join(zi.nvme_path, "zero_inference"))
+        else:
+            self.tier = _RamTier()
+        for l in self._streamed_ids:
+            for i, a in enumerate(leaves):
+                self.tier.put(f"zi_p_{l}_{i}", np.ascontiguousarray(a[l]))
+        if isinstance(self.tier, _NvmeTier):
+            self.tier.fence_all()
+
+        # the scheduler never touches params in streamed mode — stem and
+        # head live on device here, blocks on the tier
+        super().__init__(None, _unused_program, _unused_program,
+                         n_layers=n_layers, n_kv=n_kv, head_dim=head_dim,
+                         mesh=mesh, chunk_prefill_fn=_unused_program,
+                         **kw)
+
+        self.stats.update({"layer_h2d_uploads": 0, "layer_sweeps": 0,
+                           "prefetch_wait_s": 0.0})
+        self._resident = {
+            l: self._upload_layer([a[l] for a in leaves], l)
+            for l in range(n_res)}
+        # capture only the COUNT: a lambda closing over `leaves` would
+        # pin the full host weight image for the engine's lifetime —
+        # defeating the NVMe tier, whose whole point is that the host
+        # drops the image once the per-layer files are fenced
+        n_leaves = len(leaves)
+        self._reader = TierLayerReader(
+            self.tier,
+            names_fn=lambda l: [f"zi_p_{l}_{i}"
+                                for i in range(n_leaves)],
+            shapes=self._bshapes, dtypes=self._bdtypes,
+            to_device=self._upload_layer, depth=zi.prefetch_depth)
+        self._stem_dev = self._place(stem, stem_specs)
+        if "embed" in head and head["embed"] is stem["embed"]:
+            # tied embeddings: hand head the ALREADY-PLACED table so the
+            # device holds one copy (device_put of a placed array with
+            # the same sharding is a no-op, not a second upload)
+            head = dict(head, embed=self._stem_dev["embed"])
+        self._head_dev = self._place(head, head_specs)
+        logger.info(
+            "zero-inference: %d/%d layers resident (%.1f MB/layer), "
+            "tier=%s depth=%d, HBM weight working set %.1f MB of a "
+            "%.1f MB image",
+            n_res, n_layers, layer_bytes / 1e6, zi.tier,
+            self._reader.depth,
+            self.plan["hbm_working_set_bytes"] / 1e6,
+            self.plan["weight_image_bytes"] / 1e6)
+
+    # ------------------------------------------------------- placement
+    def _place(self, tree, specs):
+        if specs is not None:
+            from deepspeed_tpu.inference.quantized import shard_quantized
+
+            return shard_quantized(tree, specs, self._mesh)
+        return jax.device_put(tree)
+
+    def _upload_layer(self, bufs: List[np.ndarray], _l: int):
+        """Fenced host buffers → device tree for ONE layer (the async
+        H2D the reader keeps in flight behind the sweep); TP/EP uploads
+        land pre-sharded under the model's own per-layer specs."""
+        tree = jax.tree_util.tree_unflatten(self._btree, list(bufs))
+        self.stats["layer_h2d_uploads"] += 1
+        return self._place(tree, self._layer_specs)
+
+    # ---------------------------------------------------- program hooks
+    def _alloc_cache(self, n_layers, n_kv, num_pages, page_size,
+                     head_dim, cache_dtype) -> PagedKVCache:
+        # PER-LAYER page arrays: each block program donates and returns
+        # one layer's [KV, P, ps, Dh] pages — a stacked cache would turn
+        # every layer's update into a whole-cache copy under streaming
+        from jax.sharding import PartitionSpec as P
+
+        kv_sh = None
+        if self._mesh is not None and self._mesh.size("model") > 1:
+            kv_sh = self._mesh.sharding(P("model", None, None, None))
+
+        def kv():
+            z = jnp.zeros((n_kv, num_pages, page_size, head_dim),
+                          cache_dtype)
+            return jax.device_put(z, kv_sh) if kv_sh is not None else z
+
+        return PagedKVCache(
+            k=tuple(kv() for _ in range(n_layers)),
+            v=tuple(kv() for _ in range(n_layers)),
+            table=self._put(jnp.full(
+                (self.max_batch, self.max_pages_per_seq),
+                self.trash_page, jnp.int32)),
+            seq_lens=self._put(jnp.zeros((self.max_batch,), jnp.int32)),
+            page_size=page_size)
+
+    def _build_programs(self, prefill_fn, decode_fn,
+                        chunk_prefill_fn) -> None:
+        self._stem_jit = jax.jit(self._stem_fn)
+        self._head_jit = jax.jit(self._head_fn)
+        self._bjits: Dict[Any, Any] = {}
+        self._prefill = self._streamed_prefill
+        self._chunk_prefill = self._streamed_chunk_prefill
+        self._decode_chunk_fn = self._streamed_decode_chunk
+
+    def _block_jit(self, phase: str):
+        """Per-phase block program.  Only the pages donate (they update
+        in place); the layer weights do NOT — no block output matches a
+        weight leaf's shape, so weight donation could never be honored
+        (it only warns), and a streamed layer's buffer frees the moment
+        the sweep drops its last reference anyway."""
+        if phase not in self._bjits:
+            f = functools.partial(self._block_fn,
+                                  continuation=phase == "chunk",
+                                  prefill=phase == "prefill")
+            self._bjits[phase] = jax.jit(f, donate_argnums=(4, 5))
+        return self._bjits[phase]
+
+    # ------------------------------------------------------ layer sweep
+    def _layer_sweep(self):
+        """Yield ``(l, layer_params)`` over all layers in order;
+        streamed layers come off the double-buffered reader pipeline
+        with the next layer's read + upload already in flight."""
+        self.stats["layer_sweeps"] += 1
+        gen = (self._reader.sweep(self._streamed_ids,
+                                  on_wait=self._note_wait)
+               if self._streamed_ids else iter(()))
+        # PRIME the pipeline before the resident prefix computes:
+        # generators are lazy, and without this the first streamed
+        # layer's tier read + upload would only start at layer
+        # n_resident — one fully exposed fetch per sweep
+        pending = next(gen, None)
+        for l in range(self._L):
+            if l in self._resident:
+                yield l, self._resident[l]
+            else:
+                cur, pending = pending, next(gen, None)
+                yield cur
+
+    def _note_wait(self, dt: float) -> None:
+        self.stats["prefetch_wait_s"] += dt
+
+    # ------------------------------------------------ streamed executors
+    def _run_blocks(self, phase, x, cos, sin, k_list, v_list, table,
+                    start):
+        bj = self._block_jit(phase)
+        for l, lp in self._layer_sweep():
+            x, k_list[l], v_list[l] = bj(
+                lp, x, cos, sin, k_list[l], v_list[l], table, start)
+        return x
+
+    def _forward_view(self, phase, toks, view):
+        k_list, v_list = list(view.k), list(view.v)
+        start = view.seq_lens
+        x, cos, sin = self._stem_jit(self._stem_dev, toks, start)
+        x = self._run_blocks(phase, x, cos, sin, k_list, v_list,
+                             view.table, start)
+        logits = self._head_jit(self._head_dev, x)
+        return logits, view._replace(k=tuple(k_list), v=tuple(v_list))
+
+    def _streamed_prefill(self, _params, toks, view):
+        # a bucket-1 single-token "prefill" takes the decode path, like
+        # forward_paged's prelude (prefill = T > 1) — same kernels, same
+        # tokens as the resident engine
+        phase = "prefill" if toks.shape[1] > 1 else "decode"
+        return self._forward_view(phase, toks, view)
+
+    def _streamed_chunk_prefill(self, _params, toks, view):
+        return self._forward_view("chunk", toks, view)
+
+    def _streamed_decode_chunk(self, _params, toks, cache, keys, temps):
+        """K decode steps, host-driven: each step sweeps the layer
+        stack (streamed weights double-buffered ahead), samples on
+        device, and feeds the token to the next step — tokens never
+        visit the host inside the chunk, so the one-sync-per-K-tokens
+        contract of the compiled path is preserved."""
+        K = self.decode_chunk
+        k_list, v_list = list(cache.k), list(cache.v)
+        lens = cache.seq_lens
+        tok = toks
+        cols = []
+        for j in range(K):
+            start = lens + j if j else lens
+            x, cos, sin = self._stem_jit(self._stem_dev, tok, start)
+            x = self._run_blocks("decode", x, cos, sin, k_list, v_list,
+                                 cache.table, start)
+            logits = self._head_jit(self._head_dev, x)
+            nxt = _sample_rows(logits[:, -1], keys[j], temps)
+            cols.append(nxt)
+            tok = nxt[:, None]
+        cache = cache._replace(k=tuple(k_list), v=tuple(v_list),
+                               seq_lens=lens + K)
+        return jnp.stack(cols, axis=1), cache
+
+    # ------------------------------------------------------- inspection
+    def hbm_weight_working_set_bytes(self) -> int:
+        """Peak weight bytes resident in HBM under the plan: stem +
+        head + pinned layers + the streaming double buffer — the
+        ZeRO-Inference contract (the full image never lands)."""
+        return self.plan["hbm_working_set_bytes"]
+
+
+# --------------------------------------------------------------- builders
+_FAMILY_SKIPS = {
+    # same exact-leaf sets as the resident serving builders — the
+    # quantization grid must match or streamed/resident outputs diverge
+    "llama": ("attn_norm", "mlp_norm", "final_norm"),
+    "mixtral": ("gate", "attn_norm", "mlp_norm", "final_norm"),
+}
+
+
+def zero_inference_serving_engine(params, cfg, zi, *, family: str,
+                                  weight_dtype: str = "bfloat16",
+                                  quant_group_size: int = 128,
+                                  mesh=None, **kw
+                                  ) -> ZeroInferenceServingEngine:
+    """Build the weight-streamed serving engine for a layered decoder
+    family (ref: deepspeed-inference's init_inference with ZeRO-
+    Inference offload enabled).  ``zi.dtype`` overrides
+    ``weight_dtype``; int8 quantizes on the SAME per-leaf grid as the
+    resident builders, so streamed int8 serving is token-identical to
+    resident int8 serving."""
+    zi = ZeroInferenceConfig.coerce(zi)
+    if family not in _FAMILY_SKIPS:
+        raise NotImplementedError(
+            f"zero-inference streaming supports llama/mixtral, got "
+            f"{family!r}")
+    tp = mesh is not None and mesh.size("model") > 1
+    sharded = mesh is not None and any(
+        mesh.size(ax) > 1 for ax in ("model", "expert"))
+    if family == "mixtral":
+        from deepspeed_tpu.models import mixtral as fam
+
+        if sharded and cfg.num_experts % mesh.size("expert"):
+            raise ValueError(
+                f"num_experts {cfg.num_experts} not divisible by "
+                f"expert-axis size {mesh.size('expert')}")
+        fns = fam.paged_layered_fns(cfg, tp=sharded)
+    else:
+        from deepspeed_tpu.models import llama as fam
+
+        fns = fam.paged_layered_fns(cfg, tp=tp)
+
+    stem = {"embed": params["embed"]}
+    head = {"final_norm": params["final_norm"]}
+    if getattr(cfg, "tie_embeddings", False):
+        head["embed"] = params["embed"]
+    else:
+        head["lm_head"] = params["lm_head"]
+    blocks = params["blocks"]
+
+    wd = zi.dtype or weight_dtype
+    if wd != "bfloat16":
+        if wd != "int8":
+            raise NotImplementedError(
+                f"weight-only quantized inference supports 'int8' only, "
+                f"got {wd!r}")
+        from deepspeed_tpu.inference.quantized import quantize_params
+
+        skips = _FAMILY_SKIPS[family]
+        q = lambda t: quantize_params(t, group_size=quant_group_size,
+                                      skip_paths=skips)
+        stem, blocks = q(stem), q(blocks)
+        # tied embeddings: quantize the shared table ONCE and alias the
+        # object — the engine dedupes shared leaves by identity, both
+        # for the planner's byte accounting and the device placement
+        head = q({k: v for k, v in head.items() if k != "embed"})
+        if getattr(cfg, "tie_embeddings", False):
+            head["embed"] = stem["embed"]
+
+    stem_specs = head_specs = layer_specs = None
+    if sharded:
+        from jax.sharding import PartitionSpec as P
+
+        specs = fam.param_specs(cfg)
+
+        def drop_layer_dim(spec):
+            if spec is None:
+                return None
+            if len(spec) and spec[0] is not None:
+                raise ValueError(
+                    f"stacked block spec {spec} shards the layer axis — "
+                    "the streaming engine owns that axis (host schedule)")
+            return P(*tuple(spec)[1:])
+
+        layer_specs = jax.tree.map(
+            drop_layer_dim, specs["blocks"],
+            is_leaf=lambda s: s is None or isinstance(s, P))
+        stem_specs = {"embed": specs["embed"]}
+        head_specs = {"final_norm": specs["final_norm"]}
+        if getattr(cfg, "tie_embeddings", False):
+            head_specs["embed"] = specs["embed"]
+        else:
+            head_specs["lm_head"] = specs["lm_head"]
+
+    return ZeroInferenceServingEngine(
+        stem=stem, blocks=blocks, head=head, fns=fns, zi=zi,
+        n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, mesh=mesh, stem_specs=stem_specs,
+        head_specs=head_specs, layer_specs=layer_specs, **kw)

@@ -142,6 +142,13 @@ class _NvmeTier(_Tier):
         pool.pread(self._fd(pool, name, False), buf, 0)
         return buf
 
+    def reads_pending(self) -> int:
+        """In-flight read count on the CURRENT slot (non-blocking): 0
+        means the next fence_reads() is free — the prefetch fully hid
+        behind compute.  Consumed by the ZeRO-Inference streamer's
+        hit/stall accounting."""
+        return self.rpools[self.rslot].pending()
+
     def fence_reads(self):
         errs = self.rpools[self.rslot].wait()
         if errs:

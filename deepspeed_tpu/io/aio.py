@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
@@ -29,24 +28,11 @@ _build_lock = threading.Lock()
 
 
 def _ensure_lib() -> Optional[ctypes.CDLL]:
+    from deepspeed_tpu.utils.ctypes_build import load_or_build
+
     with _build_lock:
-        if not os.path.exists(_LIB) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
-            try:
-                # temp + atomic rename: concurrent builders racing the
-                # same -o target can CDLL a half-written .so
-                tmp = f"{_LIB}.{os.getpid()}.tmp"
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC,
-                     "-lpthread"],
-                    check=True, capture_output=True)
-                os.replace(tmp, _LIB)
-            except (subprocess.CalledProcessError, FileNotFoundError):
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
+        lib = load_or_build(_LIB, _SRC)
+        if lib is None:
             return None
     lib.dstpu_aio_create.restype = ctypes.c_void_p
     lib.dstpu_aio_create.argtypes = [ctypes.c_int]
@@ -133,6 +119,17 @@ class AioHandle:
         else:
             data = os.pread(fd, buf.nbytes, offset)
             view[:len(data)] = data
+
+    def pending(self) -> int:
+        """Submitted-but-unfinished op count, without blocking (backed by
+        the C++ pool's queue counter).  Streaming schedulers use it to
+        tell a prefetch HIT (ops already landed; the fence is free) from
+        a stall they are about to eat — ``TierLayerReader``'s
+        ``hits``/``stalls`` counters come from here via
+        ``_NvmeTier.reads_pending``."""
+        if self.native:
+            return int(self._lib.dstpu_aio_pending(self._pool))
+        return sum(1 for f in self._futures if not f.done())
 
     def wait(self) -> int:
         """Block until all submitted ops complete; returns #errors."""

@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import Optional, Tuple
 
@@ -35,23 +34,10 @@ def _ensure_lib() -> Optional[ctypes.CDLL]:
         if _lib_tried:
             return _lib_cache
         _lib_tried = True
-        if not os.path.exists(_LIB) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
-            try:
-                # temp + atomic rename: concurrent builders racing the
-                # same -o target can CDLL a half-written .so
-                tmp = f"{_LIB}.{os.getpid()}.tmp"
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC,
-                     "-lpthread"],
-                    check=True, capture_output=True)
-                os.replace(tmp, _LIB)
-            except (subprocess.CalledProcessError, FileNotFoundError):
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
+        from deepspeed_tpu.utils.ctypes_build import load_or_build
+
+        lib = load_or_build(_LIB, _SRC)
+        if lib is None:
             return None
         lib.dstpu_pool_create.restype = ctypes.c_void_p
         lib.dstpu_pool_destroy.argtypes = [ctypes.c_void_p]

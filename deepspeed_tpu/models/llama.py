@@ -451,6 +451,77 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
     return logits, cache
 
 
+def paged_layered_fns(cfg: LlamaConfig, tp: bool = False, ffn=None,
+                      interpret: Optional[bool] = None):
+    """Per-layer factoring of :func:`forward_paged` for weight-streamed
+    (ZeRO-Inference) serving — the serving twin of :func:`layered_model`:
+    stem (embedding + rope tables) and head (final norm + LM head) stay
+    HBM-resident, each transformer layer is its OWN jittable program so
+    the streaming engine can upload layer l+1's weights while layer l
+    computes.  Returns ``(stem_fn, block_fn, head_fn)``:
+
+        stem_fn(stem, tokens, start)            -> (x, cos, sin)
+        block_fn(lp, x, cos, sin, kp, vp, table, start,
+                 *, continuation, prefill)      -> (x, kp, vp)
+        head_fn(head, x)                        -> logits [B, T, V] f32
+
+    ``kp``/``vp`` are ONE layer's pages [KV, P, ps, Dh].  Every param
+    tree may carry int8 :class:`~deepspeed_tpu.inference.quantized.
+    QuantizedTensor` leaves — the dequant is traced into each per-layer
+    program, exactly as the whole-model quantized forward fuses it.  The
+    math (kernel choices included) matches :func:`forward_paged` op for
+    op, so streamed serving is token-identical to the resident engine.
+    ``ffn``: per-block FFN override, the same hook ``forward_paged``
+    gives MoE families."""
+    from deepspeed_tpu.inference.kernels import (paged_attention_step,
+                                                 pallas_paged_gate)
+    from deepspeed_tpu.inference.quantized import dequantize_params
+    from deepspeed_tpu.ops.fused_ops import swiglu
+
+    def stem_fn(sp, tokens, start):
+        sp = dequantize_params(sp)
+        x = sp["embed"][tokens]
+        T = tokens.shape[1]
+        positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
+        cos, sin = rope_tables(cfg, positions)
+        return x, cos, sin
+
+    def block_fn(lp, x, cos, sin, kp, vp, table, start, *,
+                 continuation: bool, prefill: bool):
+        lp = dequantize_params(lp)
+        B, T = x.shape[0], x.shape[1]
+        hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+        ps = kp.shape[2]
+        itp = (jax.default_backend() != "tpu") if interpret is None \
+            else interpret
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, T, nh, hd)
+        k = (h @ lp["wk"]).reshape(B, T, nkv, hd)
+        v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        use_pallas = pallas_paged_gate(
+            B, nkv, hd, ps, table.shape[1], kp.dtype.itemsize, itp, tp)
+        attn, kp, vp = paged_attention_step(
+            q, k, v, kp, vp, table, start, ps,
+            continuation=continuation, prefill=prefill,
+            use_pallas=use_pallas, flash_force_reference=tp)
+        x = x + attn.reshape(B, T, nh * hd) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        x = x + (swiglu(h, lp["w1"], lp["w3"]) @ lp["w2"]
+                 if ffn is None else ffn(lp, h))
+        return x, kp, vp
+
+    def head_fn(hp, x):
+        hp = dequantize_params(hp)
+        x = rms_norm(x, hp["final_norm"], cfg.norm_eps)
+        head = hp["embed"].T if cfg.tie_embeddings else hp["lm_head"]
+        return jnp.einsum("btd,dv->btv", x, head,
+                          preferred_element_type=jnp.float32)
+
+    return stem_fn, block_fn, head_fn
+
+
 def layered_model(cfg: LlamaConfig, params):
     """Factor a llama param tree for the layer-streaming engine (ref:
     ZeRO-Infinity parameter offload, partitioned_param_swapper.py): stem

@@ -394,6 +394,19 @@ def forward_paged(params, tokens, cfg: MixtralConfig, cache,
         ffn=lambda lp, h: _moe_ffn_dense(cfg, h, lp))
 
 
+def paged_layered_fns(cfg: MixtralConfig, tp: bool = False,
+                      interpret=None):
+    """Per-layer factoring of :func:`forward_paged` for weight-streamed
+    (ZeRO-Inference) MoE serving — llama's paged-attention backbone with
+    the capacity-free dense top-k expert combine as the FFN, one program
+    per layer so the expert stacks (the dominant MoE weight bytes)
+    stream through a 2-layer HBM working set.  Router math stays f32
+    inside each block program (the gate is never quantized)."""
+    return _llama.paged_layered_fns(
+        cfg.llama_view(), tp=tp, interpret=interpret,
+        ffn=lambda lp, h: _moe_ffn_dense(cfg, h, lp))
+
+
 def loss_fn(cfg: MixtralConfig):
     """Next-token CE + MoE aux losses; returns (loss, aux)."""
 

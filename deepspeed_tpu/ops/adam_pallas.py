@@ -17,6 +17,7 @@ fusion breakup for very large leaves.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, NamedTuple
 
 import jax
@@ -28,6 +29,36 @@ from deepspeed_tpu.ops.optim import Optimizer, ScalarOrSchedule, _lr_at
 
 _LANES = 128
 _DEFAULT_ROWS = 512  # 512*128 f32 = 256 KiB per operand block in VMEM
+
+# Measured crossover (KERNEL_BENCH.json adam_pallas_vs_xla, v5e): XLA's
+# fused elementwise chain WINS below ~64M params — 0.49x at 4M (pallas
+# 7.8 ms vs XLA 3.8 ms; grid/dispatch overhead dominates), parity 0.96x
+# at 64M — and the single-pass VMEM-residency argument only pays above.
+_PALLAS_MIN_PARAMS = 1 << 26
+
+
+def pallas_adam_gate(n_params: int) -> bool:
+    """One measured policy for when the pallas fused Adam beats the XLA
+    elementwise chain — the same data-driven pattern as
+    :func:`~deepspeed_tpu.inference.kernels.pallas_paged_gate`: below
+    the crossover the kernel is demoted to plain XLA (identical math),
+    above it the pallas path holds.  ``DSTPU_FORCE_ADAM_PALLAS=1``
+    forces the kernel at every size (read at trace time)."""
+    if os.environ.get("DSTPU_FORCE_ADAM_PALLAS", "") == "1":
+        return True
+    return n_params >= _PALLAS_MIN_PARAMS
+
+
+def _adam_update_xla(g, m, v, p, c1, c2, lr_, *, b1, b2, eps, wd):
+    """XLA twin of :func:`_adam_kernel` (same math, same dtypes) — the
+    demoted small-tensor path; fuses into one elementwise chain."""
+    g = g.astype(jnp.float32)
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * g * g
+    upd = (m * c1) / (jnp.sqrt(v * c2) + eps)
+    if wd:
+        upd = upd + wd * p.astype(jnp.float32)
+    return -lr_ * upd, m, v
 
 
 def _adam_kernel(g_ref, m_ref, v_ref, p_ref, c1_ref, c2_ref, lr_ref,
@@ -65,6 +96,17 @@ def adam_update_flat(g, m, v, p, step, lr, *, b1=0.9, b2=0.999, eps=1e-8,
     """
     shape = g.shape
     n = int(np.prod(shape)) if shape else 1
+    t_ = step.astype(jnp.float32) + 1.0
+    if not interpret and not pallas_adam_gate(n):
+        # below the measured crossover: identical math through XLA's
+        # fused chain (interpret=True still exercises the kernel — it
+        # is an explicit request, e.g. the numerics tests)
+        u, mo, vo = _adam_update_xla(
+            g, m.astype(jnp.float32), v.astype(jnp.float32), p,
+            1.0 / (1.0 - jnp.float32(b1) ** t_),
+            1.0 / (1.0 - jnp.float32(b2) ** t_),
+            jnp.asarray(lr, jnp.float32), b1=b1, b2=b2, eps=eps, wd=wd)
+        return u, mo, vo
     rows = -(-n // _LANES)
     br = min(block_rows, max(8, rows))
     rows_pad = -(-rows // br) * br
@@ -72,9 +114,8 @@ def adam_update_flat(g, m, v, p, step, lr, *, b1=0.9, b2=0.999, eps=1e-8,
     mf = _pad_rows(m.reshape(-1).astype(jnp.float32), rows_pad)
     vf = _pad_rows(v.reshape(-1).astype(jnp.float32), rows_pad)
     pf = _pad_rows(p.reshape(-1), rows_pad)
-    t = step.astype(jnp.float32) + 1.0
-    c1 = 1.0 / (1.0 - jnp.float32(b1) ** t)
-    c2 = 1.0 / (1.0 - jnp.float32(b2) ** t)
+    c1 = 1.0 / (1.0 - jnp.float32(b1) ** t_)
+    c2 = 1.0 / (1.0 - jnp.float32(b2) ** t_)
     lr_ = jnp.asarray(lr, jnp.float32)
 
     grid = (rows_pad // br,)

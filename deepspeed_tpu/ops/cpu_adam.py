@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import Optional
 
@@ -38,30 +37,16 @@ def _ensure_lib() -> Optional[ctypes.CDLL]:
         if _lib_tried:
             return _lib_cache
         _lib_tried = True
-        if not os.path.exists(_LIB) or (
-                os.path.exists(_SRC)
-                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
-            try:
-                # -ffp-contract=off: no FMA contraction, keeping the
-                # native update within 1 ulp of the numpy fallback and
-                # the jax device path (same operation ORDER; the
-                # reciprocal bias correction and numpy's f64 python
-                # scalars still differ in the last bit — equivalence
-                # tests use tolerances, not bitwise checks).
-                # Build to a temp path + atomic rename: two processes
-                # racing the same -o target can CDLL a half-written file
-                # and latch the slow fallback for their whole lifetime.
-                tmp = f"{_LIB}.{os.getpid()}.tmp"
-                subprocess.run(
-                    ["g++", "-O3", "-ffp-contract=off", "-shared", "-fPIC",
-                     "-o", tmp, _SRC, "-lpthread"],
-                    check=True, capture_output=True)
-                os.replace(tmp, _LIB)
-            except (subprocess.CalledProcessError, FileNotFoundError):
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
+        from deepspeed_tpu.utils.ctypes_build import load_or_build
+
+        # -ffp-contract=off: no FMA contraction, keeping the native
+        # update within 1 ulp of the numpy fallback and the jax device
+        # path (same operation ORDER; the reciprocal bias correction
+        # and numpy's f64 python scalars still differ in the last bit —
+        # equivalence tests use tolerances, not bitwise checks).
+        lib = load_or_build(_LIB, _SRC,
+                            extra_flags=("-ffp-contract=off",))
+        if lib is None:
             return None
         f = ctypes.POINTER(ctypes.c_float)
         u16 = ctypes.POINTER(ctypes.c_uint16)

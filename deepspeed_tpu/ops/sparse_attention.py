@@ -382,6 +382,12 @@ def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     p = (p / jnp.maximum(denom, 1e-30)).astype(q.dtype)
     p = p.reshape(B, H, nb, block, A, block)
     out = jnp.einsum("bhiqak,bhiakd->bhiqd", p, vg)
+    # fully-masked query rows — every gathered key cross-segment /
+    # padding-masked under a diagonal-free layout — never leave m at its
+    # NEG_INF init; exp(s - m) == 1 there would average garbage V rows.
+    # Zero them instead, mirroring attention_pallas's l==0 → out=0
+    # finalize (the m threshold also absorbs stacked NEG_INF biases).
+    out = jnp.where(m.reshape(B, H, nb, block, 1) > NEG_INF / 2, out, 0.0)
     return out.reshape(B, H, S, D)
 
 

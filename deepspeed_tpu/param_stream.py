@@ -69,6 +69,7 @@ stays full per process (it is what streams to the local devices).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import math
 import os
@@ -85,6 +86,90 @@ from deepspeed_tpu.infinity import _NvmeTier, _RamTier, _Tier
 from deepspeed_tpu.ops.optim import default_lr
 from deepspeed_tpu.topology import MeshSpec
 from deepspeed_tpu.utils.logging import logger
+
+
+class TierLayerReader:
+    """Double-buffered tier→device per-layer read pipeline.
+
+    The streaming core shared by the training :class:`ParamStreamEngine`
+    and the ZeRO-Inference serving streamer
+    (:mod:`deepspeed_tpu.inference.zero_inference`): while the caller
+    computes on layer ``order[i]``, layer ``order[i+1]``'s tier reads
+    (NVMe: aio submits on the alternating read slots; RAM: host buffers)
+    and its async H2D upload are already in flight, so the link hides
+    behind compute and the device-side working set stays
+    O(``depth`` + 1) layers instead of O(model).
+
+    ``names_fn(l)`` → the tier keys of layer ``l``'s leaves; ``shapes``/
+    ``dtypes`` align with those keys; ``to_device(bufs, l)`` turns the
+    fenced host buffers into the device tree handed to the caller (the
+    device_put — and any TP resharding — lives there).  NVMe tiers pin
+    ``depth`` to 1: the alternating aio read slots hold exactly one
+    layer's reads in flight (the double buffer).  The RAM tier accepts
+    deeper prefetch — device_puts are async, so up to ``depth`` layer
+    uploads ride the link ahead of the one being consumed.
+    """
+
+    def __init__(self, tier: _Tier, names_fn: Callable[[int], List[str]],
+                 shapes, dtypes, to_device, depth: int = 1):
+        self.tier = tier
+        self._nvme = isinstance(tier, _NvmeTier)
+        self.names_fn = names_fn
+        self.shapes = list(shapes)
+        self.dtypes = list(dtypes)
+        self.to_device = to_device
+        self.depth = 1 if self._nvme else max(1, int(depth))
+        # NVMe prefetch effectiveness: a HIT means the layer's reads had
+        # already landed when the sweep reached it (fence was free)
+        self.hits = 0
+        self.stalls = 0
+
+    def _submit(self, l: int):
+        return [self.tier.get_submit(n, s, d)
+                for n, s, d in zip(self.names_fn(l), self.shapes,
+                                   self.dtypes)]
+
+    def sweep(self, order, on_wait=None):
+        """Yield ``(l, device_tree)`` over ``order`` with the next
+        layer's reads/upload in flight; ``on_wait(seconds)`` reports
+        time blocked on a fence (the exposed — non-hidden — IO cost)."""
+        order = list(order)
+        if not order:
+            return
+        if self._nvme:
+            pending = self._submit(order[0])
+            for i, l in enumerate(order):
+                if self.tier.reads_pending() == 0:
+                    self.hits += 1
+                else:
+                    self.stalls += 1
+                t0 = time.perf_counter()
+                self.tier.fence_reads()
+                if on_wait is not None:
+                    on_wait(time.perf_counter() - t0)
+                self.tier.next_read_slot()
+                bufs = pending
+                if i + 1 < len(order):
+                    pending = self._submit(order[i + 1])
+                yield l, self.to_device(bufs, l)
+            return
+        ready: collections.deque = collections.deque()
+        idx = 0
+
+        def pump():
+            # ready never exceeds `depth`: with the layer in use that
+            # caps the device working set at depth + 1 layer trees
+            nonlocal idx
+            while idx < len(order) and len(ready) < self.depth:
+                nxt = order[idx]
+                idx += 1
+                ready.append((nxt, self.to_device(self._submit(nxt), nxt)))
+
+        pump()
+        while ready:
+            l, tree = ready.popleft()
+            pump()            # next uploads dispatch before l's compute
+            yield l, tree
 
 
 @dataclasses.dataclass
@@ -345,6 +430,7 @@ class ParamStreamEngine:
 
         self.batch_sharding = self.mesh.sharding(self.mesh.batch_spec())
         self._jits_built = False
+        self._preader = self._make_reader()
 
         self.global_steps = 0
         self._opt_steps = 0
@@ -423,11 +509,22 @@ class ParamStreamEngine:
         self._jits_built = True
 
     # ------------------------------------------------------------ streaming
+    def _layer_keys(self, l: int) -> List[str]:
+        """The tier key scheme for layer ``l``'s bf16 compute leaves —
+        single source for the reader pipeline AND the direct read path
+        below, so the two can never drift."""
+        return [f"p_{l}_{nm}" for nm in self._bnames]
+
+    def _make_reader(self) -> TierLayerReader:
+        return TierLayerReader(
+            self.tier, names_fn=self._layer_keys,
+            shapes=[(sz,) for sz in self._bsizes],
+            dtypes=[self._cdt_np] * len(self._bnames),
+            to_device=lambda bufs, _l: self._bufs_to_device(bufs))
+
     def _submit_layer_read(self, l: int):
-        bufs = [self.tier.get_submit(f"p_{l}_{nm}",
-                                     (sz,), self._cdt_np)
-                for nm, sz in zip(self._bnames, self._bsizes)]
-        return bufs
+        return [self.tier.get_submit(n, (sz,), self._cdt_np)
+                for n, sz in zip(self._layer_keys(l), self._bsizes)]
 
     def _bufs_to_device(self, bufs):
         flat = [jax.device_put(
@@ -534,21 +631,16 @@ class ParamStreamEngine:
         for im, mb in enumerate(micros):
             final_mb = im == accum - 1
             mb = jax.device_put(mb, self.batch_sharding)
-            # ---------------- forward: stream layers up
+            # ---------------- forward: stream layers up (shared
+            # double-buffer pipeline — layer l+1's tier read + upload in
+            # flight behind layer l's block program)
             t1 = time.perf_counter()
             x = self._stem_jit(self.stem_c, mb)
             aux_acc = jnp.float32(0.0)
             xs: List[Any] = []
-            pending = self._submit_layer_read(0)
-            for l in range(self.L):
-                if nvme:
-                    tr = time.perf_counter()
-                    self.tier.fence_reads()
-                    ph["param_read_wait"] += time.perf_counter() - tr
-                    self.tier.next_read_slot()
-                lp = self._bufs_to_device(pending)
-                if l + 1 < self.L:
-                    pending = self._submit_layer_read(l + 1)
+            read_wait = lambda dt: self._ph_add(ph, "param_read_wait", dt)
+            for l, lp in self._preader.sweep(range(self.L),
+                                             on_wait=read_wait):
                 xs.append(x)
                 if self.layered.block_has_aux:
                     x, aux_acc = self._block_jit(lp, x, aux_acc)
@@ -578,20 +670,10 @@ class ParamStreamEngine:
 
             # ---------------- backward: stream layers down
             t1 = time.perf_counter()
-            pending = self._submit_layer_read(self.L - 1)
             can_update = final_mb and not loss_bad and self.overlap_step
             dfuts: List[Any] = []
-            for l in range(self.L - 1, -1, -1):
-                if nvme:
-                    tr = time.perf_counter()
-                    self.tier.fence_reads()
-                    # locked: the update worker adds to the same key
-                    self._ph_add(ph, "param_read_wait",
-                                 time.perf_counter() - tr)
-                    self.tier.next_read_slot()
-                lp = self._bufs_to_device(pending)
-                if l - 1 >= 0:
-                    pending = self._submit_layer_read(l - 1)
+            for l, lp in self._preader.sweep(range(self.L - 1, -1, -1),
+                                             on_wait=read_wait):
                 dlp, dx = self._block_vjp_jit(lp, xs[l], dx)
                 xs[l] = None
                 # bound in-flight drains (device grad buffers alive until

@@ -1,0 +1,56 @@
+"""Shared build-and-dlopen helper for the csrc ctypes bindings
+(io/aio.py, io/native.py, ops/cpu_adam.py — one loader, not three
+drifting copies).
+
+Contract: build the shared library from source when it is missing or
+stale, then dlopen it.  Two hardenings every caller needs identically:
+
+- temp path + atomic rename: concurrent builders racing the same ``-o``
+  target can CDLL a half-written .so and latch their slow fallback for
+  the whole process lifetime;
+- rebuild-once on dlopen failure: a committed .so built by another
+  toolchain (e.g. a GLIBCXX version mismatch) raises OSError from CDLL
+  but rebuilds from source in seconds — retry once before demoting the
+  caller to its pure-Python fallback.
+
+Callers keep their own locks/caches and symbol setup; this is just the
+build + load core.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+
+def load_or_build(lib_path: str, src_path: str,
+                  extra_flags: Sequence[str] = ()
+                  ) -> Optional[ctypes.CDLL]:
+    """Return the dlopened library, building/rebuilding as needed;
+    None when no toolchain (or no loadable artifact) is available."""
+    def build():
+        tmp = f"{lib_path}.{os.getpid()}.tmp"
+        subprocess.run(
+            ["g++", "-O3", *extra_flags, "-shared", "-fPIC", "-o", tmp,
+             src_path, "-lpthread"],
+            check=True, capture_output=True)
+        os.replace(tmp, lib_path)
+
+    if not os.path.exists(lib_path) or (
+            os.path.exists(src_path)
+            and os.path.getmtime(src_path) > os.path.getmtime(lib_path)):
+        try:
+            build()
+        except (subprocess.CalledProcessError, FileNotFoundError):
+            return None
+    try:
+        return ctypes.CDLL(lib_path)
+    except OSError:
+        try:
+            build()
+            return ctypes.CDLL(lib_path)
+        except (subprocess.CalledProcessError, FileNotFoundError,
+                OSError):
+            return None

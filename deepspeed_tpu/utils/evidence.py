@@ -1,0 +1,21 @@
+"""Atomic JSON evidence writes, shared by every bench/evidence producer
+(bench_serving.py, tools/kernel_bench.py, examples/*_offload.py).
+
+The whole point of incremental evidence flushing is surviving a killed
+tunnel window — so the flush itself must never be the thing a SIGKILL
+truncates.  Temp file + ``os.replace``: a kill mid-write leaves a stray
+``.tmp`` and the PREVIOUS complete evidence intact; readers never see a
+half-written JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def atomic_write_json(obj, path: str, indent: int = 1) -> None:
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=indent)
+    os.replace(tmp, path)

@@ -6,6 +6,7 @@ module_inject TP / sharded_moe expert-parallel inference).
     python examples/serving_models.py --model mixtral --expert 4
     python examples/serving_models.py --model llama --tp 2
     python examples/serving_models.py --model gpt2
+    python examples/serving_models.py --zero-inference # >HBM streaming
 """
 import argparse
 import os
@@ -25,6 +26,10 @@ def main():
                     help="expert-parallel width (mixtral only)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (8-device virtual mesh)")
+    ap.add_argument("--zero-inference", action="store_true",
+                    help="ZeRO-Inference weight streaming: layer weights "
+                         "live on the host tier and stream under the "
+                         "decode sweep (llama/mixtral)")
     args = ap.parse_args()
 
     if args.tp > 1 and args.expert > 1:
@@ -66,8 +71,13 @@ def main():
         mesh = MeshSpec.build({"expert": args.expert},
                               devices=jax.devices()[:args.expert])
 
+    zi = ({"enabled": True, "tier": "host"}
+          if args.zero_inference else None)
     eng = serving_engine(params, cfg, mesh=mesh, max_batch=3, page_size=8,
-                         num_pages=64, max_seq=128, decode_chunk=4)
+                         num_pages=64, max_seq=128, decode_chunk=4,
+                         zero_inference=zi)
+    if args.zero_inference:
+        print(f"zero-inference plan: {eng.plan}")
     rng = np.random.default_rng(0)
     for i in range(6):
         eng.submit(f"req{i}",

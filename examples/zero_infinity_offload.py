@@ -170,8 +170,11 @@ def main():
                 k: round(v, 3)
                 for k, v in engine.phase_report().items()},
         }
-        with open(args.json_out, "w") as f:
-            json.dump(evidence, f, indent=1)
+        from deepspeed_tpu.utils.evidence import atomic_write_json
+
+        # atomic: the per-step flush exists to survive a killed window,
+        # so the flush itself must not be killable into truncation
+        atomic_write_json(evidence, args.json_out)
 
     losses, times = [], []
     for step in range(args.steps):

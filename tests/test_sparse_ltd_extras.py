@@ -318,3 +318,30 @@ def test_sparse_segment_ids_with_causal_and_blocks():
     same = (seg[:, :, None] == seg[:, None, :])[:, None]
     ref = _ref_attention(q, k, v, blockmask & causal & same)
     np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+def test_sparse_fully_masked_rows_zero_not_garbage():
+    """Regression (r5 advisor): under a DIAGONAL-FREE layout whose
+    gathered key blocks are all cross-segment, entire query rows are
+    fully masked — their running max never leaves NEG_INF, and the
+    unguarded softmax would emit a uniform average over masked V rows.
+    They must emit exact zeros (attention_pallas's l==0 → out=0
+    contract) while live rows keep their masked-dense values."""
+    B, H, S, D, blk = 1, 2, 64, 8, 16
+    q, k, v = _qkv(B, H, S, D)
+    nb = S // blk
+    lay = np.zeros((H, nb, nb), bool)
+    for i in range(nb):
+        lay[:, i, (i - 1) % nb] = True      # strictly off-diagonal
+    # one segment per block → every attended key is cross-segment
+    seg = jnp.asarray(np.repeat(np.arange(1, nb + 1, dtype=np.int32),
+                                blk)[None])
+    out = sparse_attention(q, k, v, lay, blk, segment_ids=seg)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+    # guard must not touch LIVE rows: same diagonal-free layout without
+    # segments still matches the masked-dense oracle exactly
+    out2 = sparse_attention(q, k, v, lay, blk)
+    blockmask = jnp.asarray(np.kron(lay, np.ones((blk, blk), bool)))[None]
+    ref2 = _ref_attention(q, k, v, blockmask)
+    np.testing.assert_allclose(out2, ref2, atol=2e-5)

@@ -18,7 +18,6 @@ serializes on-device, so (sum of N dispatches)/N is honest kernel time.
 
 import argparse
 import functools
-import json
 import os
 import sys
 import time
@@ -107,6 +106,11 @@ def flash_vs_ref(shapes, iters):
 
 
 def adam_vs_xla(sizes, iters):
+    # the A/B must measure the REAL kernel at every size: below the
+    # measured crossover adam_update_flat now demotes itself to XLA
+    # (ops/adam_pallas.pallas_adam_gate), which would make the sweep
+    # silently compare XLA against XLA
+    os.environ["DSTPU_FORCE_ADAM_PALLAS"] = "1"
     rows = []
     for n in sizes:
         k = jax.random.PRNGKey(0)
@@ -426,14 +430,14 @@ def main():
         if unknown:
             raise SystemExit(f"unknown families {sorted(unknown)}")
         sweeps = [(n, f) for n, f in sweeps if n in picked]
+    from deepspeed_tpu.utils.evidence import atomic_write_json
+
     for name, fn in sweeps:
         result[name] = fn()
         print(f"--- {name} done", flush=True)
-        with open(args.json_out, "w") as f:
-            json.dump(result, f, indent=1)
+        atomic_write_json(result, args.json_out)
     result.pop("partial")
-    with open(args.json_out, "w") as f:
-        json.dump(result, f, indent=1)
+    atomic_write_json(result, args.json_out)
     print("→", args.json_out)
 
 

@@ -186,7 +186,14 @@ def main():
                       "--json-out", "SERVING_INT8.json"]),
                     ("serving_moe",
                      ["--model", "mixtral",
-                      "--json-out", "SERVING_MOE.json"])):
+                      "--json-out", "SERVING_MOE.json"]),
+                    # ZeRO-Inference A/B: resident vs host-streamed
+                    # rows in one file (bench_serving runs both when
+                    # --zero-inference is set) — the >HBM serving
+                    # bandwidth story on the real chip
+                    ("serving_zero_inference",
+                     ["--zero-inference",
+                      "--json-out", "SERVING_ZERO_INFERENCE.json"])):
                 if not fresh(sub):
                     continue
                 log[sub] = run_item(

@@ -105,6 +105,72 @@ def pidfile_guard() -> bool:
 
 MAX_STAGE_ATTEMPTS = 3
 
+# Idle-time slow-lane coverage (round-5 verdict weak #6): while the
+# chip is DOWN the watcher has nothing to do but sleep — spend that
+# time running `pytest --runslow` (tools/run_slow_lane.sh) on a
+# cadence so the ~67 slow-marked tests have a standing pass/fail stamp
+# (SLOW_LANE.json).  0 disables.  The run is a DETACHED background
+# process: the 3-minute probe cadence keeps ticking underneath it, and
+# the moment the chip comes up the run is killed — slow tests must
+# never eat a tunnel window or contend with an on-chip stage.
+SLOW_LANE_CADENCE_S = float(
+    os.environ.get("DSTPU_SLOW_LANE_CADENCE_S", str(6 * 3600)))
+_slow_lane_proc = None
+
+
+def maybe_run_slow_lane():
+    global _slow_lane_proc
+    if SLOW_LANE_CADENCE_S <= 0:
+        return
+    if _slow_lane_proc is not None and _slow_lane_proc.poll() is None:
+        return                        # already running in the background
+    deadline = float(os.environ.get("SLOW_LANE_DEADLINE_S", "2700"))
+    if DEADLINE > 0:
+        # same stand-down contract as the stages: the driver's
+        # end-of-round bench must never contend with a CPU-saturating
+        # pytest run — clamp to the remaining window, skip when tight
+        remaining = DEADLINE - time.time()
+        if remaining < 300:
+            return
+        deadline = min(deadline, remaining - 120)
+    stamp = os.path.join(REPO, "SLOW_LANE.json")
+    try:
+        if time.time() - os.path.getmtime(stamp) < SLOW_LANE_CADENCE_S:
+            return
+    except OSError:
+        pass   # no stamp yet — run
+    print("chip down — starting the slow test lane (background)",
+          flush=True)
+    _slow_lane_proc = subprocess.Popen(
+        ["bash", os.path.join("tools", "run_slow_lane.sh")],
+        cwd=REPO, start_new_session=True,
+        env={**os.environ, "SLOW_LANE_DEADLINE_S": str(int(deadline))})
+
+
+def stop_slow_lane():
+    """Chip is up (or stand-down): the idle work yields — no stamp is
+    written for a killed run, so the cadence retries it on the next
+    idle stretch.  TERM first with a grace period: a blind SIGKILL can
+    land mid git-commit in run_slow_lane.sh and strand .git/index.lock,
+    blocking every later evidence/snapshot commit in the repo."""
+    global _slow_lane_proc
+    p = _slow_lane_proc
+    if p is not None and p.poll() is None:
+        try:
+            os.killpg(p.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            p.wait()
+        print("slow lane stopped — yielding the host", flush=True)
+    _slow_lane_proc = None
+
 # hard stand-down time (epoch secs, DSTPU_WATCHER_DEADLINE): the driver
 # runs its own bench.py at round end — a watcher stage holding the chip
 # at that moment would collide (double HBM allocation → the DRIVER's
@@ -120,6 +186,9 @@ def main():
     if pidfile_guard():
         print("watcher already running")
         return
+    # a detached slow-lane child must not outlive the watcher (its own
+    # internal `timeout` still bounds it if the watcher is SIGKILLed)
+    atexit.register(stop_slow_lane)
 
     # outer loop: survive tunnel drops — go back to probing and resume
     # at the first missing stage instead of exiting (round-5: the
@@ -139,10 +208,15 @@ def main():
                    stage_attempts=attempts)
         print(f"probe {n}: chip_up={up}", flush=True)
         if not up:
+            # idle chip = free compute: keep the slow lane covered
+            # (background — probes keep ticking at the 3-min cadence)
+            maybe_run_slow_lane()
             # 3 min, not 10: the round-5 tunnel window lasted ~20 min
             # total — a 10-min probe cadence can eat half of one
             time.sleep(180)
             continue
+        # the window is open: idle work yields the host NOW
+        stop_slow_lane()
 
         done, dropped = [], False
         for name, items, deadline in STAGES:

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Slow-lane coverage runner (round-5 verdict weak #6): the default test
+# selection skips ~67 slow-marked equivalence tests to keep tier-1 fast,
+# which means nothing was actually running them anywhere.  This script
+# runs `pytest --runslow` on the 8-device CPU mesh and stamps the
+# outcome into SLOW_LANE.json (then best-effort commits the stamp), so
+# the heavy lane has a standing pass/fail record with a timestamp.
+#
+#   bash tools/run_slow_lane.sh
+#
+# Invoked by tools/onchip_watcher.py while the chip is down (idle time
+# costs nothing) on a DSTPU_SLOW_LANE_CADENCE_S cadence; also fine to
+# run by hand.  SLOW_LANE_DEADLINE_S caps the run (default 2700 s).
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$REPO"
+OUT="${SLOW_LANE_OUT:-$REPO/SLOW_LANE.json}"
+DEADLINE="${SLOW_LANE_DEADLINE_S:-2700}"
+T0=$(date +%s)
+LOG=$(mktemp /tmp/dstpu_slow_lane.XXXXXX.log)
+
+timeout -k 30 "$DEADLINE" env JAX_PLATFORMS=cpu python -m pytest tests/ \
+  -q --runslow --continue-on-collection-errors -p no:cacheprovider \
+  2>&1 | tee "$LOG"
+RC=${PIPESTATUS[0]}
+SUMMARY=$(grep -aE '[0-9]+ (passed|failed|error|skipped)' "$LOG" | tail -1)
+
+python - "$OUT" "$RC" "$T0" "$SUMMARY" <<'EOF'
+import sys, time
+sys.path.insert(0, ".")
+from deepspeed_tpu.utils.evidence import atomic_write_json
+out, rc, t0, summary = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), \
+    sys.argv[4]
+# atomic: the watcher TERM/KILLs this run when the chip comes up, and a
+# truncated stamp with a fresh mtime would suppress the retry cadence
+atomic_write_json({"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "rc": rc,
+                   "ok": rc == 0,
+                   "duration_s": int(time.time()) - t0,
+                   "summary": summary.strip(),
+                   "cmd": "pytest tests/ -q --runslow"}, out)
+EOF
+
+# best-effort stamp commit (just this file); the round snapshot would
+# pick it up anyway — this keeps the pass/fail visible per cadence run.
+# add first: `commit -o` errors on a path git has never tracked, which
+# is exactly the first cadence run
+if [ "$RC" -eq 0 ]; then MSG="slow lane: pass"; else MSG="slow lane: fail rc=$RC"; fi
+git -C "$REPO" add -- SLOW_LANE.json >/dev/null 2>&1 || true
+git -C "$REPO" commit -o SLOW_LANE.json -m "$MSG" >/dev/null 2>&1 || true
+rm -f "$LOG"
+exit 0
