@@ -303,6 +303,12 @@ def _child_run(force_cpu: bool):
                    "bench_config": bench_config,
                    "backend": jax.default_backend()},
     }
+    # telemetry provenance rides every emitted row (BENCH_* files then
+    # carry step-time distributions + comm counters, not just headlines)
+    snap_fn = getattr(engine, "telemetry_snapshot", None)
+    if snap_fn is not None:
+        result["detail"]["telemetry"] = snap_fn()
+
     # the headline is safe NOW: emit it before the extra stages, so an
     # OOM/crash in a ZeRO-2/3 row can never cost the whole capture (the
     # parent parses the LAST valid JSON line — round-5 postmortem: the

@@ -128,6 +128,11 @@ def measure_config(name, args, params, mod, cfg, phase, zero_inference=None):
                      if s is not None)
     tps = generated / dt if dt > 0 else 0.0
     phase(f"[{name}] done: {generated} tokens in {dt:.1f}s")
+    # one registry snapshot per row: TTFT/inter-token distributions,
+    # queue/occupancy/KV gauges, stall and bandwidth provenance all ride
+    # in detail.telemetry (the old stats keys stay as flat conveniences)
+    snap = engine.registry.snapshot()
+    cnt = snap["counters"]
     row = {
         "config": name,
         "value": round(tps, 1),
@@ -147,21 +152,24 @@ def measure_config(name, args, params, mod, cfg, phase, zero_inference=None):
             "build_s": round(t_compile - t_build, 1),
             "compile_s": round(compile_s, 1),
             "truncated": truncated,
-            "decode_steps": engine.stats["decode_steps"],
-            "prefill_chunks": engine.stats["prefill_chunks"],
+            "decode_steps": int(cnt.get("serving_decode_steps", 0)),
+            "prefill_chunks": int(cnt.get("serving_prefill_chunks", 0)),
             "prefill_chunk": args.prefill_chunk,
             "weight_dtype": args.weight_dtype,
-            "preempted": engine.stats["preempted"],
+            "preempted": int(cnt.get("serving_preempted_requests", 0)),
             "ms_per_decode_step": round(
-                1000 * dt / max(engine.stats["decode_steps"], 1), 2),
+                1000 * dt / max(int(cnt.get("serving_decode_steps", 0)),
+                                1), 2),
+            "telemetry": snap,
         },
     }
     if zero_inference is not None:
+        zi_wait = snap["histograms"].get("zi_prefetch_wait_seconds", {})
         row["detail"]["zero_inference"] = {
             **{k: v for k, v in engine.plan.items()},
             "tier": engine._zi.tier,
-            "layer_h2d_uploads": engine.stats["layer_h2d_uploads"],
-            "prefetch_wait_s": round(engine.stats["prefetch_wait_s"], 3),
+            "layer_h2d_uploads": int(cnt.get("zi_layer_h2d_uploads", 0)),
+            "prefetch_wait_s": round(zi_wait.get("sum", 0.0), 3),
         }
     del engine
     return row

@@ -27,6 +27,7 @@ from deepspeed_tpu import comm
 from deepspeed_tpu import ops
 from deepspeed_tpu import zero
 from deepspeed_tpu import lr_schedules
+from deepspeed_tpu import telemetry
 
 
 def init_inference(*args, **kwargs):

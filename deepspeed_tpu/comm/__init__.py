@@ -27,6 +27,44 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 _initialized = False
 
+# --------------------------------------------------------------------------
+# Comms logging (ref: deepspeed/comm comms_logger).  The default path now
+# RECORDS: every SPMD wrapper below logs (op, per-shard bytes) at trace
+# time via record_event, and the host-level whole-array ops log wall-
+# timed records.  Caveat, documented on record_event too: a traced
+# collective is logged once per COMPILATION of its enclosing jit, not
+# once per step — jit caching means these counts answer "which ops, how
+# many call sites, what shard volume", while the per-execution truth
+# lives in the compiled-HLO digest (deepspeed_tpu/comm/digest.py).
+# Surface into a MetricsRegistry with
+# ``registry.fan_in_comms(comm.comms_logger())``.
+# --------------------------------------------------------------------------
+from deepspeed_tpu.utils.trace import CommsLogger as _CommsLogger
+
+_comms_logger = _CommsLogger(enabled=True)
+
+
+def comms_logger():
+    """The backend's process-wide CommsLogger."""
+    return _comms_logger
+
+
+def configure_comms_logger(enabled: bool) -> None:
+    """Toggle collective recording (ref: comms_logger config knob)."""
+    _comms_logger.enabled = bool(enabled)
+
+
+def _nbytes(x) -> int:
+    """Per-shard payload bytes of an array or tracer (shape/dtype are
+    static under tracing, so this is exact and trace-safe)."""
+    try:
+        size = 1
+        for d in x.shape:
+            size *= int(d)
+        return size * x.dtype.itemsize
+    except Exception:      # scalars / exotic leaves: count the op only
+        return 0
+
 
 class ReduceOp(enum.Enum):  # ref: deepspeed/comm/comm.py ReduceOp
     SUM = "sum"
@@ -107,7 +145,8 @@ def barrier() -> None:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
+        with _comms_logger.record("barrier", 0):
+            multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
 
 
 # --------------------------------------------------------------------------
@@ -115,6 +154,7 @@ def barrier() -> None:
 # --------------------------------------------------------------------------
 def all_reduce(x, axis_name: str, op: ReduceOp = ReduceOp.SUM):
     """ref: comm.all_reduce → lax.psum/pmax/pmin/pmean on a mesh axis."""
+    _comms_logger.record_event("all_reduce", _nbytes(x))
     if op in (ReduceOp.SUM,):
         return jax.lax.psum(x, axis_name)
     if op is ReduceOp.AVG:
@@ -137,12 +177,14 @@ def all_reduce(x, axis_name: str, op: ReduceOp = ReduceOp.SUM):
 
 def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
     """ref: comm.all_gather — concatenate shards along ``axis``."""
+    _comms_logger.record_event("all_gather", _nbytes(x))
     return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis_name: str, axis: int = 0,
                    op: ReduceOp = ReduceOp.SUM):
     """ref: comm.reduce_scatter_base — sum then keep this rank's shard."""
+    _comms_logger.record_event("reduce_scatter", _nbytes(x))
     if op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError("reduce_scatter supports SUM/AVG")
     out = jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
@@ -153,17 +195,20 @@ def reduce_scatter(x, axis_name: str, axis: int = 0,
 
 def broadcast(x, axis_name: str, src: int = 0):
     """ref: comm.broadcast — everyone takes rank ``src``'s value."""
+    _comms_logger.record_event("broadcast", _nbytes(x))
     return jax.lax.all_gather(x, axis_name, axis=0, tiled=False)[src]
 
 
 def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
     """ref: comm.all_to_all_single — the MoE/Ulysses workhorse."""
+    _comms_logger.record_event("all_to_all", _nbytes(x))
     return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
                               concat_axis=concat_axis, tiled=True)
 
 
 def ppermute(x, axis_name: str, perm: Sequence):
     """Point-to-point ring shift (ref: NCCL send/recv pairs in pipe engine)."""
+    _comms_logger.record_event("ppermute", _nbytes(x))
     return jax.lax.ppermute(x, axis_name, perm=perm)
 
 
@@ -192,4 +237,8 @@ def mesh_all_reduce(x: jax.Array, mesh: Mesh, op: ReduceOp = ReduceOp.SUM) -> ja
         return v
 
     spec = P(axes)
-    return jax.jit(shard_map(f, mesh=mesh, in_specs=spec, out_specs=P()))(x)
+    # host-level op: this record is WALL-TIMED (dispatch side) with the
+    # full array's bytes, unlike the trace-time SPMD records above
+    with _comms_logger.record("mesh_all_reduce", _nbytes(x)):
+        return jax.jit(shard_map(f, mesh=mesh, in_specs=spec,
+                                 out_specs=P()))(x)

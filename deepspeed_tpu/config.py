@@ -120,6 +120,63 @@ class ZeroInferenceConfig:
 
 
 @dataclasses.dataclass
+class TelemetryConfig:
+    """Runtime telemetry block (no single reference analogue — it
+    unifies the reference's monitor/comms-logger/flops-profiler
+    surfaces behind one :class:`~deepspeed_tpu.telemetry.
+    MetricsRegistry`).
+
+    ``enabled`` default-on keeps the registry live (counters/gauges/
+    histograms recorded, readable via ``registry.snapshot()``) with NO
+    exporter running — exporting only happens when a sink key is set.
+    ``enabled: false`` swaps every metric for a shared no-op singleton:
+    no lock, no ``perf_counter``, no ``TraceAnnotation`` on any hot
+    path (the serving decode loop's disabled overhead is bounded in
+    SERVING_OVERHEAD.json).
+    """
+
+    enabled: bool = True
+    interval_s: float = 10.0             # min seconds between sink ticks
+    prometheus_path: Optional[str] = None  # text exposition file (atomic)
+    http_port: Optional[int] = None      # stdlib /metrics endpoint; 0=ephemeral
+    monitor_bridge: bool = True          # fan into MonitorMaster when one is on
+    step_sync: bool = False              # True: device-synced step timing + MFU
+    #   (brackets each train step with the ThroughputTimer's
+    #   block_until_ready — accurate device wall at ~2 tiny syncs/step;
+    #   False keeps the training hot path sync-free and records host
+    #   dispatch wall only)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TelemetryConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        t = cls(**{k: v for k, v in d.items() if k in known})
+        if t.interval_s < 0:
+            raise ValueError(
+                f"telemetry.interval_s must be >= 0, got {t.interval_s}")
+        if t.http_port is not None and not 0 <= int(t.http_port) < 65536:
+            raise ValueError(
+                f"telemetry.http_port must be 0..65535, got {t.http_port}")
+        return t
+
+    @classmethod
+    def coerce(cls, obj) -> "TelemetryConfig":
+        """Accept None (defaults), a bool (enable/disable), a dict, or
+        a TelemetryConfig — the same loose contract the serving
+        builders use for ``zero_inference``."""
+        if obj is None:
+            return cls()
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, bool):
+            return cls(enabled=obj)
+        if isinstance(obj, dict):
+            return cls.from_dict(obj)
+        raise TypeError(
+            f"telemetry must be a bool, dict or TelemetryConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class PrecisionConfig:
     """ref: deepspeed/runtime/fp16/loss_scaler.py + config fp16/bf16 blocks."""
 
@@ -263,6 +320,8 @@ class Config:
     sparse_attention: Optional[Dict[str, Any]] = None
     zero_inference: ZeroInferenceConfig = dataclasses.field(
         default_factory=ZeroInferenceConfig)
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig)
     raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     # ---------------------------------------------------------------- parse
@@ -365,6 +424,8 @@ class Config:
             # "enabled": false still disables
             c.zero_inference = ZeroInferenceConfig.coerce(
                 d["zero_inference"])
+        if "telemetry" in d:
+            c.telemetry = TelemetryConfig.coerce(d["telemetry"])
         return c
 
     @classmethod

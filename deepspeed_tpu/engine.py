@@ -21,6 +21,7 @@ alongside the native ``loss = engine.train_batch(batch)``.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
@@ -328,6 +329,45 @@ class TrainingEngine:
 
         self.monitor = MonitorMaster(config.raw)
         self.tput_timer = ThroughputTimer(batch_size=config.train_batch_size)
+        # unified telemetry (the `telemetry` config block): step-timing
+        # histogram + run gauges on a MetricsRegistry, with the optional
+        # exporter bridging into the monitor backends / a Prometheus
+        # file on a wall-clock cadence.  Default posture keeps the hot
+        # path sync-free: gauges that require a device sync (loss, grad
+        # norm, MFU) refresh only on the steps_per_print cadence when a
+        # sink will read them, or on demand via telemetry_snapshot().
+        from deepspeed_tpu.telemetry import (MetricsRegistry,
+                                             TelemetryExporter)
+
+        tel = config.telemetry
+        self.registry = MetricsRegistry(enabled=tel.enabled)
+        self._c_train_steps = self.registry.counter(
+            "train_steps", "optimizer steps taken")
+        self._h_step = self.registry.histogram(
+            "train_step_seconds",
+            "per-step wall time (host dispatch wall unless "
+            "telemetry.step_sync — then device-synced via the "
+            "ThroughputTimer)",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+        self._g_loss = self.registry.gauge("train_loss")
+        self._g_lr = self.registry.gauge("train_lr")
+        self._g_grad_norm = self.registry.gauge("train_grad_norm")
+        self._g_sps = self.registry.gauge(
+            "train_samples_per_sec", "ThroughputTimer samples/sec")
+        self._g_mfu = self.registry.gauge(
+            "train_mfu", "model FLOPs utilization vs chip peak "
+            "(0 until flops_per_sample is configured)")
+        self._tel_sync = tel.enabled and tel.step_sync
+        self._tel_exporter = None
+        if tel.enabled and (tel.prometheus_path or tel.http_port
+                            is not None or (tel.monitor_bridge
+                                            and self.monitor.enabled)):
+            self._tel_exporter = TelemetryExporter(
+                self.registry,
+                monitor=self.monitor if tel.monitor_bridge else None,
+                prometheus_path=tel.prometheus_path,
+                interval_s=tel.interval_s, http_port=tel.http_port)
         # overflow count, accumulated as a device scalar so the hot loop
         # never syncs; materialized on read via the skipped_steps property.
         self._skipped_acc = jnp.zeros([], jnp.int32)
@@ -726,6 +766,36 @@ class TrainingEngine:
                  "Train/samples_per_sec": self.tput_timer.samples_per_sec},
                 self.global_steps)
             self.monitor.flush()
+        if self.registry.enabled:
+            self._c_train_steps.inc()
+            reads = self.monitor.enabled or self._tel_exporter is not None
+            if reads and (self.global_steps
+                          % max(self.config.steps_per_print, 1) == 0):
+                # gauge refresh syncs (float() on device scalars) — only
+                # on the cadence a sink actually reads
+                self._refresh_gauges(metrics)
+            if self._tel_exporter is not None:
+                self._tel_exporter.maybe_export(self.global_steps)
+
+    def _refresh_gauges(self, metrics) -> None:
+        self._g_loss.set(float(metrics["loss"]))
+        if "lr" in metrics:
+            self._g_lr.set(float(metrics["lr"]))
+        if metrics.get("grad_norm") is not None:
+            self._g_grad_norm.set(float(metrics["grad_norm"]))
+        self._g_sps.set(self.tput_timer.samples_per_sec)
+        self._g_mfu.set(self.tput_timer.mfu)
+        from deepspeed_tpu import comm as _comm
+
+        self.registry.fan_in_comms(_comm.comms_logger())
+
+    def telemetry_snapshot(self) -> dict:
+        """On-demand registry snapshot with the synced gauges refreshed
+        from the last step's metrics (this is the one deliberate sync
+        point for callers that run without any monitor backend)."""
+        if self.registry.enabled and self._last_metrics:
+            self._refresh_gauges(self._last_metrics)
+        return self.registry.snapshot()
 
     def _align_batch(self, batch):
         """Place every batch leaf for the step: arrays with a batch dim
@@ -781,12 +851,17 @@ class TrainingEngine:
         (ref: PipelineEngine.train_batch — one call per global step.)
         """
         batch = self._apply_curriculum(batch)
-        timed = self.monitor.enabled
+        timed = self.monitor.enabled or self._tel_sync
         if timed:
             self.tput_timer.start()
+        t0 = time.perf_counter()
         self.state, metrics = self._step_fn(self.state, self._align_batch(batch))
         if timed:
             self.tput_timer.stop()
+            self._h_step.observe(time.perf_counter() - t0)
+        elif self.registry.enabled:
+            # host dispatch wall only — no forced sync on the hot path
+            self._h_step.observe(time.perf_counter() - t0)
         self._post_step(metrics)
         return metrics["loss"]
 

@@ -24,6 +24,27 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _encoder_telemetry(telemetry):
+    """Same loose telemetry contract as the decoder ServingEngine:
+    None/bool/dict/TelemetryConfig build a registry (plus an exporter
+    when sink keys are set); an existing MetricsRegistry is shared and
+    the caller owns its sinks.  Returns ``(registry, exporter)``."""
+    from deepspeed_tpu.config import TelemetryConfig
+    from deepspeed_tpu.telemetry import MetricsRegistry, TelemetryExporter
+
+    if isinstance(telemetry, MetricsRegistry):
+        return telemetry, None
+    tcfg = TelemetryConfig.coerce(telemetry)
+    reg = MetricsRegistry(enabled=tcfg.enabled)
+    exp = None
+    if reg.enabled and (tcfg.prometheus_path
+                        or tcfg.http_port is not None):
+        exp = TelemetryExporter(reg, prometheus_path=tcfg.prometheus_path,
+                                interval_s=tcfg.interval_s,
+                                http_port=tcfg.http_port)
+    return reg, exp
+
+
 class EncoderServingEngine:
     """Batched scoring over a pure ``apply_fn(params, tokens, mask)``.
 
@@ -37,7 +58,8 @@ class EncoderServingEngine:
                  max_batch: int = 8, per_token: bool = False,
                  mesh=None, specs_tree=None,
                  weight_dtype: str = "bfloat16",
-                 quant_group_size: int = 128, quant_skip_paths=()):
+                 quant_group_size: int = 128, quant_skip_paths=(),
+                 telemetry=None):
         if weight_dtype != "bfloat16":
             from deepspeed_tpu.inference.quantized import (
                 quantize_for_inference)
@@ -66,6 +88,13 @@ class EncoderServingEngine:
         self._fn = jax.jit(apply_fn)
         self.queue: "collections.deque" = collections.deque()
         self.stats = {"lots": 0, "rows_padded": 0, "requests": 0}
+        self.registry, self._tel_exporter = _encoder_telemetry(telemetry)
+        self._c_lots = self.registry.counter(
+            "encoder_lots", "static-shape lots scored")
+        self._c_requests = self.registry.counter(
+            "encoder_requests", "requests submitted")
+        self._c_rows_padded = self.registry.counter(
+            "encoder_rows_padded", "padding rows shipped in lots")
 
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
@@ -82,6 +111,7 @@ class EncoderServingEngine:
         self._bucket(len(tokens))  # validate now, not at lot time
         self.queue.append((req_id, tokens))
         self.stats["requests"] += 1
+        self._c_requests.inc()
 
     def run(self) -> Dict[Any, np.ndarray]:
         """Drain the queue; returns {req_id: output row}.
@@ -112,9 +142,13 @@ class EncoderServingEngine:
                                       jnp.asarray(mask)))
             self.stats["lots"] += 1
             self.stats["rows_padded"] += B - len(lot)
+            self._c_lots.inc()
+            self._c_rows_padded.inc(B - len(lot))
             for r, (rid, toks) in enumerate(lot):
                 row = res[r]
                 out[rid] = row[:len(toks)] if self.per_token else row
+        if self._tel_exporter is not None:
+            self._tel_exporter.maybe_export()
         return out
 
 
@@ -166,7 +200,8 @@ class CNNServingEngine:
     the only scheduling is lot formation up to ``max_batch``."""
 
     def __init__(self, params, *, cfg=None, max_batch: int = 8,
-                 image_shape: Tuple[int, int, int] = (32, 32, 3)):
+                 image_shape: Tuple[int, int, int] = (32, 32, 3),
+                 telemetry=None):
         from deepspeed_tpu.models import cnn
 
         self.cfg = cfg
@@ -176,6 +211,11 @@ class CNNServingEngine:
         self._fn = jax.jit(cnn.forward)
         self.queue: "collections.deque" = collections.deque()
         self.stats = {"lots": 0, "requests": 0}
+        self.registry, self._tel_exporter = _encoder_telemetry(telemetry)
+        self._c_lots = self.registry.counter(
+            "encoder_lots", "static-shape lots scored")
+        self._c_requests = self.registry.counter(
+            "encoder_requests", "requests submitted")
 
     def submit(self, req_id, image) -> None:
         image = np.asarray(image, np.float32)
@@ -185,6 +225,7 @@ class CNNServingEngine:
                 f"{self.image_shape}")
         self.queue.append((req_id, image))
         self.stats["requests"] += 1
+        self._c_requests.inc()
 
     def run(self) -> Dict[Any, np.ndarray]:
         out: Dict[Any, np.ndarray] = {}
@@ -197,6 +238,9 @@ class CNNServingEngine:
                 batch[r] = img
             logits = np.asarray(self._fn(self.params, jnp.asarray(batch)))
             self.stats["lots"] += 1
+            self._c_lots.inc()
             for r, (rid, _) in enumerate(lot):
                 out[rid] = logits[r]
+        if self._tel_exporter is not None:
+            self._tel_exporter.maybe_export()
         return out

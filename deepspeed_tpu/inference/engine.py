@@ -128,4 +128,8 @@ def init_serving(params, model_config, *, config: Any = None,
         config = Config.from_dict(config)
     if config is not None and config.zero_inference.enabled:
         kw.setdefault("zero_inference", config.zero_inference)
+    if config is not None:
+        # `telemetry` config block → the engine's MetricsRegistry (an
+        # explicit telemetry= kw still wins)
+        kw.setdefault("telemetry", config.telemetry)
     return serving_engine(params, model_config, mesh=mesh, **kw)

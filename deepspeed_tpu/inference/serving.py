@@ -40,13 +40,17 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.config import TelemetryConfig
 from deepspeed_tpu.inference.kernels import PagedKVCache, PageAllocator
+from deepspeed_tpu.telemetry import (LATENCY_BUCKETS_S, MetricsRegistry,
+                                     Span, TelemetryExporter)
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -69,6 +73,10 @@ class Request:
     tokens: List[int]                  # prompt
     max_new_tokens: int = 32
     temperature: float = 0.0           # 0 → greedy
+    # TTFT clock: submit-time perf_counter, cleared once the first token
+    # is observed (preempted requeues carry the cleared state so a
+    # recompute never double-counts).  None also means "telemetry off".
+    t_submit: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -79,6 +87,7 @@ class _Slot:
     rng: jax.Array
     seq_id: int = -1                   # PageAllocator owner key
     prefill_done: int = -1             # chunked prefill progress; -1 = done
+    last_tok_t: float = 0.0            # inter-token latency clock
 
     @property
     def prefilling(self) -> bool:
@@ -101,7 +110,7 @@ class ServingEngine:
                  prefill_bucket: int = 32, eos_token_id: Optional[int] = None,
                  cache_dtype=jnp.bfloat16, seed: int = 0,
                  decode_chunk: int = 1, prefill_chunk: int = 0,
-                 chunk_prefill_fn=None, mesh=None):
+                 chunk_prefill_fn=None, mesh=None, telemetry=None):
         # Sharded serving (ref: deepspeed/module_inject/replace_module.py
         # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
         # inference): with a mesh, params arrive pre-sharded from the
@@ -175,8 +184,78 @@ class ServingEngine:
         self._rng = jax.random.PRNGKey(seed)
         self.finished: Dict[Any, List[int]] = {}
         self._newly_finished: List[Any] = []
-        self.stats = {"admitted": 0, "preempted": 0, "decode_steps": 0,
-                      "decode_syncs": 0, "prefill_chunks": 0}
+
+        # ---- telemetry: one registry for every hot-path metric (the
+        # old ad-hoc `stats` dict survives as a read-only shim below).
+        # `telemetry` accepts None/bool/dict/TelemetryConfig — or an
+        # existing MetricsRegistry to share one across engines.
+        if isinstance(telemetry, MetricsRegistry):
+            self.registry = telemetry
+            tcfg = None                    # caller owns the sinks
+        else:
+            tcfg = TelemetryConfig.coerce(telemetry)
+            self.registry = MetricsRegistry(enabled=tcfg.enabled)
+        # _tel_on guards every perf_counter read in the decode loop: the
+        # disabled path must cost nothing beyond this bool (no clock, no
+        # lock, no TraceAnnotation)
+        self._tel_on = self.registry.enabled
+        r = self.registry
+        self._c_admitted = r.counter(
+            "serving_admitted_requests", "requests admitted to a slot")
+        self._c_preempted = r.counter(
+            "serving_preempted_requests",
+            "vLLM-style recompute preemptions under page pressure")
+        self._c_decode_steps = r.counter(
+            "serving_decode_steps", "batched decode steps (tokens/slot)")
+        self._c_decode_syncs = r.counter(
+            "serving_decode_syncs", "device->host token syncs")
+        self._c_prefill_chunks = r.counter(
+            "serving_prefill_chunks", "split-fuse prompt chunks absorbed")
+        self._g_queue = r.gauge(
+            "serving_queue_depth", "requests waiting for a slot")
+        self._g_occupancy = r.gauge(
+            "serving_batch_occupancy",
+            "fraction of decode slots active this step")
+        self._g_kv_util = r.gauge(
+            "serving_kv_page_utilization",
+            "fraction of the usable KV page pool allocated")
+        self._h_ttft = r.histogram(
+            "serving_ttft_seconds",
+            "submit -> first generated token", LATENCY_BUCKETS_S)
+        self._h_itl = r.histogram(
+            "serving_inter_token_seconds",
+            "gap between consecutive tokens of one request as a client "
+            "sees them (chunked decode delivers bursts of K: K-1 "
+            "near-zero gaps + one sync-interval gap per chunk)",
+            LATENCY_BUCKETS_S)
+        # span pieces hoisted out of step(): one histogram resolve and
+        # one label format at build time, zero registry locks per step
+        self._h_step_span = r.histogram(
+            "serving_step_seconds",
+            "scheduler iteration wall time (admit -> decode sync)")
+        self._span_label = f"{r.namespace}/serving_step"
+        # telemetry sinks for serving loops: the exporter ticks from
+        # step() (a monotonic compare until interval_s elapses)
+        self._tel_exporter = None
+        if tcfg is not None and self._tel_on and (
+                tcfg.prometheus_path or tcfg.http_port is not None):
+            self._tel_exporter = TelemetryExporter(
+                self.registry, prometheus_path=tcfg.prometheus_path,
+                interval_s=tcfg.interval_s, http_port=tcfg.http_port)
+
+    @property
+    def stats(self) -> Dict[str, Any]:
+        """Deprecation shim over the registry — prefer
+        ``engine.registry.snapshot()``.  With telemetry disabled the
+        counters are no-ops, so this returns zeros (disabling telemetry
+        is the explicit opt-out of scheduler accounting)."""
+        return {
+            "admitted": int(self._c_admitted.value),
+            "preempted": int(self._c_preempted.value),
+            "decode_steps": int(self._c_decode_steps.value),
+            "decode_syncs": int(self._c_decode_syncs.value),
+            "prefill_chunks": int(self._c_prefill_chunks.value),
+        }
 
     # -------------------------------------------------- subclass hooks
     # (the ZeRO-Inference engine swaps both: per-layer cache tuples so
@@ -248,8 +327,10 @@ class ServingEngine:
                 f"request {req_id}: needs {lifetime_pages} pages at full "
                 f"length but the pool has {usable} — it could never "
                 "complete even alone")
-        self.queue.append(Request(req_id, tokens, max_new_tokens,
-                                  temperature))
+        self.queue.append(Request(
+            req_id, tokens, max_new_tokens, temperature,
+            t_submit=time.perf_counter() if self._tel_on else None))
+        self._g_queue.set(len(self.queue))
 
     @property
     def has_work(self) -> bool:
@@ -321,7 +402,7 @@ class ServingEngine:
             # slot is not decode-ready until prefill_done reaches T
             self.slots[b] = _Slot(req=req, seq_len=0, generated=[],
                                   rng=rng, seq_id=seq_id, prefill_done=0)
-            self.stats["admitted"] += 1
+            self._c_admitted.inc()
             return True
 
         toks = np.full((1, Tpad), 0, np.int32)
@@ -340,7 +421,7 @@ class ServingEngine:
         slot = _Slot(req=req, seq_len=T, generated=[], rng=rng,
                      seq_id=seq_id)
         self.slots[b] = slot
-        self.stats["admitted"] += 1
+        self._c_admitted.inc()
         # first generated token comes from the REAL last prompt position
         self._append_token(b, self._sample(logits[0, T - 1], slot))
         return True
@@ -375,7 +456,7 @@ class ServingEngine:
         self.cache = self.cache._replace(k=view.k, v=view.v)
         s.prefill_done = done + take
         s.seq_len = s.prefill_done
-        self.stats["prefill_chunks"] += 1
+        self._c_prefill_chunks.inc()
         if s.prefill_done >= T:
             s.prefill_done = -1
             # decode-ready: the device table/lens row must flip from
@@ -404,8 +485,9 @@ class ServingEngine:
         # contains everything produced before preemption
         self.queue.appendleft(Request(
             req.req_id, req.tokens + s.generated,
-            req.max_new_tokens - len(s.generated), req.temperature))
-        self.stats["preempted"] += 1
+            req.max_new_tokens - len(s.generated), req.temperature,
+            t_submit=req.t_submit))
+        self._c_preempted.inc()
 
     def _sample(self, logits_row, slot: _Slot) -> int:
         from deepspeed_tpu.inference.generation import sample_logits
@@ -418,6 +500,14 @@ class ServingEngine:
     def _append_token(self, b: int, tok: int) -> None:
         s = self.slots[b]
         s.generated.append(tok)
+        if self._tel_on:
+            now = time.perf_counter()
+            if s.req.t_submit is not None:
+                self._h_ttft.observe(now - s.req.t_submit)
+                s.req.t_submit = None      # once per request lifetime
+            elif s.last_tok_t:
+                self._h_itl.observe(now - s.last_tok_t)
+            s.last_tok_t = now
         done = (self.eos is not None and tok == self.eos) or \
             len(s.generated) >= s.req.max_new_tokens
         if done:
@@ -463,6 +553,19 @@ class ServingEngine:
         """One scheduling iteration: admit → batched decode.  Returns
         request ids that finished during this step."""
         self._newly_finished = []
+        if self._tel_on:
+            # span: wall time into serving_step_seconds + a
+            # TraceAnnotation so captured device timelines show the
+            # scheduler iteration
+            with Span(self._h_step_span, self._span_label):
+                self._step_inner()
+            if self._tel_exporter is not None:
+                self._tel_exporter.maybe_export()
+        else:
+            self._step_inner()
+        return list(self._newly_finished)
+
+    def _step_inner(self) -> None:
         while self._admit_one():
             pass
         # split-fuse: absorb ONE chunk per pending-prefill slot, then run
@@ -477,6 +580,12 @@ class ServingEngine:
         if active:
             self._grow_pages(ahead=K)
             active = ready()
+        if self._tel_on:
+            self._g_queue.set(len(self.queue))
+            self._g_occupancy.set(len(active) / self.max_batch)
+            usable = self.trash_page       # pool minus the reserved page
+            self._g_kv_util.set(
+                (usable - len(self.allocator.free)) / max(usable, 1))
         if active:
             self._upload_dirty()
             toks = np.zeros((self.max_batch, 1), np.int32)
@@ -496,15 +605,14 @@ class ServingEngine:
             # next dirty upload)
             for b, s in active:
                 s.seq_len += K
-            self.stats["decode_steps"] += K
-            self.stats["decode_syncs"] += 1
+            self._c_decode_steps.inc(K)
+            self._c_decode_syncs.inc()
             host_toks = np.asarray(out)         # the ONE host sync
             for b, s in active:
                 for j in range(K):
                     self._append_token(b, int(host_toks[b, j]))
                     if self.slots[b] is None:   # finished mid-chunk:
                         break                   # rest is discard
-        return list(self._newly_finished)
 
     def run(self, max_steps: int = 10_000) -> Dict[Any, List[int]]:
         """Drive until every submitted request completes."""

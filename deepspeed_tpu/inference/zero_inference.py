@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -195,8 +196,27 @@ class ZeroInferenceServingEngine(ServingEngine):
                          mesh=mesh, chunk_prefill_fn=_unused_program,
                          **kw)
 
-        self.stats.update({"layer_h2d_uploads": 0, "layer_sweeps": 0,
-                           "prefetch_wait_s": 0.0})
+        # streaming telemetry on the engine's registry (created by the
+        # base ctor): upload/sweep counters, bytes moved, the exposed
+        # (non-hidden) prefetch wait distribution, and an achieved-
+        # bandwidth gauge — the observability ZeRO-Inference needs to
+        # answer "is the NVMe->host->HBM latency actually hidden?"
+        self._layer_bytes = int(layer_bytes)
+        r = self.registry
+        self._c_h2d = r.counter(
+            "zi_layer_h2d_uploads", "per-layer host->HBM weight uploads")
+        self._c_sweeps = r.counter(
+            "zi_layer_sweeps", "full layer-stack sweeps driven")
+        self._c_bytes = r.counter(
+            "zi_bytes_uploaded", "weight bytes shipped host->HBM")
+        self._h_wait = r.histogram(
+            "zi_prefetch_wait_seconds",
+            "time the sweep blocked on a tier fence (exposed IO cost; "
+            "0-heavy distribution means prefetch fully hides the link)")
+        self._g_bw = r.gauge(
+            "zi_h2d_bandwidth_bytes_per_s",
+            "streamed bytes / sweep wall time (lower bound: the sweep "
+            "window includes the compute the stream hides behind)")
         self._resident = {
             l: self._upload_layer([a[l] for a in leaves], l)
             for l in range(n_res)}
@@ -210,7 +230,8 @@ class ZeroInferenceServingEngine(ServingEngine):
             names_fn=lambda l: [f"zi_p_{l}_{i}"
                                 for i in range(n_leaves)],
             shapes=self._bshapes, dtypes=self._bdtypes,
-            to_device=self._upload_layer, depth=zi.prefetch_depth)
+            to_device=self._upload_layer, depth=zi.prefetch_depth,
+            registry=self.registry, prefix="zi_stream")
         self._stem_dev = self._place(stem, stem_specs)
         if "embed" in head and head["embed"] is stem["embed"]:
             # tied embeddings: hand head the ALREADY-PLACED table so the
@@ -240,7 +261,8 @@ class ZeroInferenceServingEngine(ServingEngine):
         H2D the reader keeps in flight behind the sweep); TP/EP uploads
         land pre-sharded under the model's own per-layer specs."""
         tree = jax.tree_util.tree_unflatten(self._btree, list(bufs))
-        self.stats["layer_h2d_uploads"] += 1
+        self._c_h2d.inc()
+        self._c_bytes.inc(self._layer_bytes)
         return self._place(tree, self._layer_specs)
 
     # ---------------------------------------------------- program hooks
@@ -296,7 +318,7 @@ class ZeroInferenceServingEngine(ServingEngine):
         """Yield ``(l, layer_params)`` over all layers in order;
         streamed layers come off the double-buffered reader pipeline
         with the next layer's read + upload already in flight."""
-        self.stats["layer_sweeps"] += 1
+        self._c_sweeps.inc()
         gen = (self._reader.sweep(self._streamed_ids,
                                   on_wait=self._note_wait)
                if self._streamed_ids else iter(()))
@@ -313,15 +335,33 @@ class ZeroInferenceServingEngine(ServingEngine):
                 yield cur
 
     def _note_wait(self, dt: float) -> None:
-        self.stats["prefetch_wait_s"] += dt
+        self._h_wait.observe(dt)
+
+    @property
+    def stats(self) -> Dict[str, Any]:
+        """Base shim + the streaming keys (prefer
+        ``engine.registry.snapshot()``)."""
+        s = ServingEngine.stats.fget(self)
+        s.update({
+            "layer_h2d_uploads": int(self._c_h2d.value),
+            "layer_sweeps": int(self._c_sweeps.value),
+            "prefetch_wait_s": float(self._h_wait.sum),
+        })
+        return s
 
     # ------------------------------------------------ streamed executors
     def _run_blocks(self, phase, x, cos, sin, k_list, v_list, table,
                     start):
         bj = self._block_jit(phase)
+        t0 = time.perf_counter() if self._tel_on else 0.0
         for l, lp in self._layer_sweep():
             x, k_list[l], v_list[l] = bj(
                 lp, x, cos, sin, k_list[l], v_list[l], table, start)
+        if self._tel_on and self._streamed_ids:
+            dt = time.perf_counter() - t0
+            if dt > 0:
+                self._g_bw.set(
+                    len(self._streamed_ids) * self._layer_bytes / dt)
         return x
 
     def _forward_view(self, phase, toks, view):

@@ -64,6 +64,26 @@ class AioHandle:
             self._pool = None
             self._exec = ThreadPoolExecutor(max_workers=n_threads)
             self._futures = []
+        # process-wide telemetry (handles resolve to shared no-ops when
+        # DSTPU_TELEMETRY=0): submit/byte counters + a pending-depth
+        # gauge, the aio-pool occupancy view the streaming schedulers'
+        # hit/stall counters summarize per layer
+        from deepspeed_tpu.telemetry import default_registry
+
+        reg = default_registry()
+        self._tel_on = reg.enabled     # guards the pending() samples too
+        self._c_reads = reg.counter(
+            "aio_reads_submitted", "async pread submissions")
+        self._c_writes = reg.counter(
+            "aio_writes_submitted", "async pwrite submissions")
+        self._c_rbytes = reg.counter(
+            "aio_read_bytes", "bytes submitted for read")
+        self._c_wbytes = reg.counter(
+            "aio_write_bytes", "bytes submitted for write")
+        self._g_pending = reg.gauge(
+            "aio_pending_depth",
+            "submitted-but-unfinished ops on the most recently active "
+            "handle (sampled at submit and after wait)")
 
     @property
     def native(self) -> bool:
@@ -100,6 +120,10 @@ class AioHandle:
         else:
             self._futures.append(self._exec.submit(
                 self._py_rw, fd, buf, offset, False))
+        if self._tel_on:
+            self._c_reads.inc()
+            self._c_rbytes.inc(buf.nbytes)
+            self._g_pending.set(self.pending())
 
     def pwrite(self, fd: int, buf: np.ndarray, offset: int = 0) -> None:
         assert buf.flags["C_CONTIGUOUS"]
@@ -110,6 +134,10 @@ class AioHandle:
         else:
             self._futures.append(self._exec.submit(
                 self._py_rw, fd, buf, offset, True))
+        if self._tel_on:
+            self._c_writes.inc()
+            self._c_wbytes.inc(buf.nbytes)
+            self._g_pending.set(self.pending())
 
     @staticmethod
     def _py_rw(fd: int, buf: np.ndarray, offset: int, write: bool):
@@ -134,7 +162,9 @@ class AioHandle:
     def wait(self) -> int:
         """Block until all submitted ops complete; returns #errors."""
         if self.native:
-            return int(self._lib.dstpu_aio_wait(self._pool))
+            errs = int(self._lib.dstpu_aio_wait(self._pool))
+            self._g_pending.set(0)
+            return errs
         errs = 0
         for f in self._futures:
             try:
@@ -142,6 +172,7 @@ class AioHandle:
             except Exception:
                 errs += 1
         self._futures = []
+        self._g_pending.set(0)
         return errs
 
     def __del__(self):

@@ -84,6 +84,7 @@ from deepspeed_tpu import lr_schedules, precision
 from deepspeed_tpu.config import Config
 from deepspeed_tpu.infinity import _NvmeTier, _RamTier, _Tier
 from deepspeed_tpu.ops.optim import default_lr
+from deepspeed_tpu.telemetry import MetricsRegistry
 from deepspeed_tpu.topology import MeshSpec
 from deepspeed_tpu.utils.logging import logger
 
@@ -111,7 +112,10 @@ class TierLayerReader:
     """
 
     def __init__(self, tier: _Tier, names_fn: Callable[[int], List[str]],
-                 shapes, dtypes, to_device, depth: int = 1):
+                 shapes, dtypes, to_device, depth: int = 1,
+                 registry=None, prefix: str = "tier_reader"):
+        from deepspeed_tpu import telemetry as _telemetry
+
         self.tier = tier
         self._nvme = isinstance(tier, _NvmeTier)
         self.names_fn = names_fn
@@ -123,6 +127,29 @@ class TierLayerReader:
         # already landed when the sweep reached it (fence was free)
         self.hits = 0
         self.stalls = 0
+        # optional MetricsRegistry fan-out (prefetch hit/stall counters,
+        # bytes read off the tier, fence-wait distribution); with no
+        # registry the handles are shared no-ops — zero branches on the
+        # sweep path either way
+        self._layer_bytes = int(sum(
+            int(np.prod(s)) * np.dtype(d).itemsize
+            for s, d in zip(self.shapes, self.dtypes)))
+        if registry is None or not registry.enabled:
+            null = _telemetry.NULL_METRIC
+            self._c_hits = self._c_stalls = self._c_bytes = null
+            self._h_wait = null
+        else:
+            self._c_hits = registry.counter(
+                f"{prefix}_prefetch_hits",
+                "layer reads already landed when the sweep arrived")
+            self._c_stalls = registry.counter(
+                f"{prefix}_prefetch_stalls",
+                "sweep reached a layer with reads still in flight")
+            self._c_bytes = registry.counter(
+                f"{prefix}_bytes_read", "bytes read off the tier")
+            self._h_wait = registry.histogram(
+                f"{prefix}_wait_seconds",
+                "time blocked on a tier fence (exposed IO cost)")
 
     def _submit(self, l: int):
         return [self.tier.get_submit(n, s, d)
@@ -141,13 +168,18 @@ class TierLayerReader:
             for i, l in enumerate(order):
                 if self.tier.reads_pending() == 0:
                     self.hits += 1
+                    self._c_hits.inc()
                 else:
                     self.stalls += 1
+                    self._c_stalls.inc()
                 t0 = time.perf_counter()
                 self.tier.fence_reads()
+                dt = time.perf_counter() - t0
+                self._h_wait.observe(dt)
                 if on_wait is not None:
-                    on_wait(time.perf_counter() - t0)
+                    on_wait(dt)
                 self.tier.next_read_slot()
+                self._c_bytes.inc(self._layer_bytes)
                 bufs = pending
                 if i + 1 < len(order):
                     pending = self._submit(order[i + 1])
@@ -163,6 +195,7 @@ class TierLayerReader:
             while idx < len(order) and len(ready) < self.depth:
                 nxt = order[idx]
                 idx += 1
+                self._c_bytes.inc(self._layer_bytes)
                 ready.append((nxt, self.to_device(self._submit(nxt), nxt)))
 
         pump()
@@ -430,6 +463,19 @@ class ParamStreamEngine:
 
         self.batch_sharding = self.mesh.sharding(self.mesh.batch_spec())
         self._jits_built = False
+        # registry: streaming reader hit/stall/bytes/wait metrics fan in
+        # here; per-step phase seconds land as counters so the overlap
+        # accounting phase_report() already computes becomes scrapable
+        self.registry = MetricsRegistry(
+            enabled=config.telemetry.enabled)
+        self._c_steps = self.registry.counter(
+            "pstream_steps", "optimizer steps taken")
+        self._c_skipped = self.registry.counter(
+            "pstream_skipped_steps", "overflow-skipped steps")
+        self._h_step = self.registry.histogram(
+            "pstream_step_seconds", "train_batch wall time",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                     60.0, 120.0))
         self._preader = self._make_reader()
 
         self.global_steps = 0
@@ -520,7 +566,8 @@ class ParamStreamEngine:
             self.tier, names_fn=self._layer_keys,
             shapes=[(sz,) for sz in self._bsizes],
             dtypes=[self._cdt_np] * len(self._bnames),
-            to_device=lambda bufs, _l: self._bufs_to_device(bufs))
+            to_device=lambda bufs, _l: self._bufs_to_device(bufs),
+            registry=self.registry, prefix="pstream")
 
     def _submit_layer_read(self, l: int):
         return [self.tier.get_submit(n, (sz,), self._cdt_np)
@@ -711,6 +758,7 @@ class ParamStreamEngine:
                                   "overflow": jnp.int32(1)}
             self.step_times.append(time.perf_counter() - t0)
             ph["total"] = self.step_times[-1]
+            self._record_step_telemetry(ph, skipped=True)
             return jnp.float32(loss)
 
         res_ssq, res_fin = 0.0, True
@@ -741,6 +789,7 @@ class ParamStreamEngine:
                                   "overflow": jnp.int32(1)}
             self.step_times.append(time.perf_counter() - t0)
             ph["total"] = self.step_times[-1]
+            self._record_step_telemetry(ph, skipped=True)
             return jnp.float32(loss)
 
         clip = self.config.gradient_clipping
@@ -772,7 +821,22 @@ class ParamStreamEngine:
                               "overflow": jnp.int32(0)}
         self.step_times.append(time.perf_counter() - t0)
         ph["total"] = self.step_times[-1]
+        self._record_step_telemetry(ph, skipped=False)
         return jnp.float32(loss)
+
+    def _record_step_telemetry(self, ph, skipped: bool) -> None:
+        """Fold one step's phase accounting into the registry (phase
+        seconds as counters — their ratios are the overlap story
+        phase_report() tells, now scrapable across the run)."""
+        if not self.registry.enabled:
+            return
+        self._c_steps.inc()
+        if skipped:
+            self._c_skipped.inc()
+        self._h_step.observe(ph.get("total", 0.0))
+        for k, v in ph.items():
+            if k != "total" and v > 0:
+                self.registry.counter(f"pstream_phase_{k}_seconds").inc(v)
 
     # ------------------------------------------------------------- updates
     def _accum_layer(self, gbuf, l: int, flat: List[np.ndarray]) -> None:

@@ -19,3 +19,13 @@ def atomic_write_json(obj, path: str, indent: int = 1) -> None:
     with open(tmp, "w") as f:
         json.dump(obj, f, indent=indent)
     os.replace(tmp, path)
+
+
+def atomic_write_text(text: str, path: str) -> None:
+    """Same temp + ``os.replace`` contract for plain text — the
+    telemetry Prometheus exposition writer, where a scraper racing the
+    write must only ever see a complete file."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)

@@ -23,6 +23,12 @@ timeout -k 30 "$DEADLINE" env JAX_PLATFORMS=cpu python -m pytest tests/ \
   -q --runslow --continue-on-collection-errors -p no:cacheprovider \
   2>&1 | tee "$LOG"
 RC=${PIPESTATUS[0]}
+
+# telemetry sample: every slow-lane run also stamps TELEMETRY_SAMPLE.json
+# (a live registry snapshot off a short gpt2 serving loop) next to
+# SLOW_LANE.json — best-effort, never the reason the lane fails
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/telemetry_dump.py \
+  --cpu --json-out "$REPO/TELEMETRY_SAMPLE.json" >/dev/null 2>&1 || true
 SUMMARY=$(grep -aE '[0-9]+ (passed|failed|error|skipped)' "$LOG" | tail -1)
 
 python - "$OUT" "$RC" "$T0" "$SUMMARY" <<'EOF'

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Telemetry sampler: drive a short serving loop and pretty-print the
+registry snapshot (ISSUE 2 satellite).
+
+Runs a tiny gpt2 ServingEngine on whatever backend is available (pass
+--cpu to force the CPU backend), serves a handful of requests, then
+
+  1. pretty-prints ``registry.snapshot()`` (the on-demand JSON sink),
+  2. writes the Prometheus text exposition next to the JSON stamp and
+     parses it back (the same round-trip the tests assert), and
+  3. stamps TELEMETRY_SAMPLE.json (atomic) with the snapshot + run
+     metadata, so slow-lane runs (tools/run_slow_lane.sh) leave a
+     standing record of what a live registry looks like.
+
+    python tools/telemetry_dump.py --cpu
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend in-process")
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--new-tokens", type=int, default=24)
+    ap.add_argument("--json-out",
+                    default=os.path.join(REPO, "TELEMETRY_SAMPLE.json"))
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from deepspeed_tpu.inference.serving import serving_engine
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.telemetry import parse_prometheus_text
+    from deepspeed_tpu.utils.evidence import atomic_write_json
+
+    cfg = gpt2.GPT2Config.tiny(dim=64, n_layers=2, n_heads=4,
+                               max_seq_len=128)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    prompt_len = 8
+    max_seq = prompt_len + args.new_tokens
+    eng = serving_engine(
+        params, cfg, max_batch=4, page_size=8,
+        num_pages=4 * (-(-max_seq // 8)) + 8, max_seq=max_seq,
+        prefill_bucket=prompt_len, decode_chunk=4)
+
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    for i in range(args.requests):
+        eng.submit(i, rng.integers(1, cfg.vocab_size, prompt_len).tolist(),
+                   max_new_tokens=args.new_tokens)
+    out = eng.run()
+    wall = time.perf_counter() - t0
+
+    snap = eng.registry.snapshot()
+    print(json.dumps(snap, indent=1, sort_keys=True))
+
+    prom_path = args.json_out.rsplit(".", 1)[0] + ".prom"
+    eng.registry.write_prometheus(prom_path)
+    with open(prom_path) as f:
+        families = parse_prometheus_text(f.read())
+    print(f"# prometheus exposition: {prom_path} "
+          f"({len(families)} families, parsed back OK)")
+
+    atomic_write_json({
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(),
+        "model": "gpt2-tiny",
+        "requests": args.requests,
+        "completed": len(out),
+        "wall_s": round(wall, 2),
+        "prometheus_families": len(families),
+        "snapshot": snap,
+    }, args.json_out)
+    print("→", args.json_out)
+
+
+if __name__ == "__main__":
+    main()
