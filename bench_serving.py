@@ -21,6 +21,11 @@ honestly (``truncated: true``) rather than burning the window.
         # adds the ZeRO-Inference weight-streamed config next to the
         # resident baseline (same model, same traffic) — the >HBM
         # serving A/B; --hbm-budget-mb pins layers, default streams all
+    python bench_serving.py --prefix-cache
+        # shared-prefix workload (N users x one system prompt + short
+        # unique tails) served twice — prefix caching OFF then ON —
+        # reporting TTFT, tokens/s and the token-level hit rate per
+        # row; the slow lane stamps this as PREFIX_BENCH.json
 """
 
 import argparse
@@ -80,7 +85,25 @@ def commit(out, path):
     atomic_write_json(out, path)
 
 
-def measure_config(name, args, params, mod, cfg, phase, zero_inference=None):
+def build_prompts(args, cfg):
+    """Request workload.  Default: independent random prompts.
+    ``--prefix-cache``: the shared-prefix fleet shape — N users behind
+    ONE long system prompt, each with a short unique tail — the traffic
+    prefix caching exists for."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    if args.prefix_cache:
+        prefix = rng.integers(1, cfg.vocab_size, args.prefix_len).tolist()
+        return [prefix + rng.integers(1, cfg.vocab_size,
+                                      args.tail_len).tolist()
+                for _ in range(args.requests)]
+    return [rng.integers(1, cfg.vocab_size, args.prompt_len).tolist()
+            for _ in range(args.requests)]
+
+
+def measure_config(name, args, params, mod, cfg, phase, prompts,
+                   zero_inference=None, prefix_cache=None):
     """Build one engine flavor, warm it, drive the request stream under
     the wall-clock cap; returns one evidence row."""
     import jax
@@ -90,25 +113,44 @@ def measure_config(name, args, params, mod, cfg, phase, zero_inference=None):
 
     max_seq = args.prompt_len + args.new_tokens
     t_build = time.perf_counter()
-    config = ({"zero_inference": zero_inference}
-              if zero_inference is not None else None)
+    config = {}
+    if zero_inference is not None:
+        config["zero_inference"] = zero_inference
+    if prefix_cache is not None:
+        config["prefix_cache"] = prefix_cache
+    # prefix rows absorb a cache-hit's uncached suffix in
+    # prefill_bucket-token continuation chunks — a page-sized bucket
+    # (vs the whole padded prompt) is what turns the skipped prefix
+    # into skipped COMPUTE, for the miss row too (same bucket, A/B
+    # stays apples-to-apples)
+    bucket = 16 if args.prefix_cache else args.prompt_len
     engine = init_serving(
-        params, cfg, config=config, max_batch=args.slots, page_size=16,
-        num_pages=args.slots * (-(-max_seq // 16)) + 32,
-        max_seq=max_seq, prefill_bucket=args.prompt_len,
+        params, cfg, config=config or None, max_batch=args.slots,
+        page_size=16, num_pages=args.slots * (-(-max_seq // 16)) + 32,
+        max_seq=max_seq, prefill_bucket=bucket,
         decode_chunk=args.decode_chunk, prefill_chunk=args.prefill_chunk,
         weight_dtype=args.weight_dtype)
 
-    rng = np.random.default_rng(0)
-    prompts = [rng.integers(1, cfg.vocab_size, args.prompt_len).tolist()
-               for _ in range(args.requests)]
-
+    rng = np.random.default_rng(1)
     phase(f"[{name}] warmup (compile prefill + decode)")
     t_compile = time.perf_counter()
-    engine.submit("warmup", prompts[0], max_new_tokens=4)
-    engine.run()
+    # a prefix-cached engine also compiles the continuation-chunk
+    # program the hit path runs: warm up with the SAME disjoint prompt
+    # twice (second admission hits the first's pages) so no timed
+    # request pays a compile
+    warm = rng.integers(1, cfg.vocab_size, args.prompt_len).tolist()
+    reps = 2 if (prefix_cache or {}).get("enabled") else 1
+    for i in range(reps):
+        engine.submit(f"warmup{i}", warm, max_new_tokens=4)
+        engine.run()
     engine.drain_finished()
     compile_s = time.perf_counter() - t_compile
+
+    # warmup traffic must not pollute the timed rows' comparison:
+    # histogram/counter deltas against this snapshot isolate it
+    snap0 = engine.registry.snapshot()
+    ttft0 = snap0["histograms"].get("serving_ttft_seconds", {})
+    cnt0 = snap0["counters"]
 
     phase(f"[{name}] timed run (cap {CAP_S:.0f}s)")
     for i, p in enumerate(prompts):
@@ -163,6 +205,33 @@ def measure_config(name, args, params, mod, cfg, phase, zero_inference=None):
             "telemetry": snap,
         },
     }
+    ttft = snap["histograms"].get("serving_ttft_seconds", {})
+    d_count = int(ttft.get("count", 0)) - int(ttft0.get("count", 0))
+    if d_count > 0:
+        row["detail"]["ttft_ms"] = round(
+            1000 * (ttft.get("sum", 0.0) - ttft0.get("sum", 0.0))
+            / d_count, 2)
+    if args.prefix_cache:
+        def delta(key):
+            return int(cnt.get(key, 0)) - int(cnt0.get(key, 0))
+
+        # token-level hit rate over the TIMED traffic only: warmup used
+        # a disjoint prompt, so its miss + self-hit are delta'd away
+        pt = delta("prefix_cache_prompt_tokens")
+        ct = delta("prefix_cache_cached_tokens")
+        row["detail"]["prefix_cache"] = {
+            "enabled": bool((prefix_cache or {}).get("enabled")),
+            "hits": delta("prefix_cache_hits"),
+            "misses": delta("prefix_cache_misses"),
+            "cached_tokens": ct,
+            "prompt_tokens": pt,
+            "hit_rate": round(ct / pt, 4) if pt else 0.0,
+            "published_pages": delta("prefix_cache_published_pages"),
+            "evicted_pages": delta("prefix_cache_evicted_pages"),
+            "pool_pages": len(engine.allocator.pool),
+            "prefix_len": args.prefix_len,
+            "tail_len": args.tail_len,
+        }
     if zero_inference is not None:
         zi_wait = snap["histograms"].get("zi_prefetch_wait_seconds", {})
         row["detail"]["zero_inference"] = {
@@ -193,6 +262,15 @@ def main():
     ap.add_argument("--model", default="llama",
                     choices=["llama", "mixtral", "gpt2"],
                     help="model family served through the registry")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="A/B the shared-prefix workload with prefix "
+                         "caching off vs on (TTFT, tokens/s, hit rate)")
+    ap.add_argument("--prefix-len", type=int, default=240,
+                    help="shared system-prompt length for the "
+                         "--prefix-cache workload (page-aligned helps)")
+    ap.add_argument("--tail-len", type=int, default=8,
+                    help="per-user unique tail length for the "
+                         "--prefix-cache workload")
     ap.add_argument("--zero-inference", action="store_true",
                     help="also measure the ZeRO-Inference weight-streamed "
                          "engine (host-tier layer streaming) next to the "
@@ -205,6 +283,12 @@ def main():
     ap.add_argument("--json-out", default=os.path.join(REPO,
                                                        "SERVING_BENCH.json"))
     args = ap.parse_args()
+    if args.prefix_cache:
+        if args.zero_inference:
+            raise SystemExit(
+                "--prefix-cache and --zero-inference are separate A/Bs")
+        # the workload defines the prompt length
+        args.prompt_len = args.prefix_len + args.tail_len
 
     import jax
 
@@ -223,21 +307,26 @@ def main():
     phase(f"backend={jax.default_backend()} — init params")
     params = mod.init_params(jax.random.PRNGKey(0), cfg)
 
-    configs = [("resident", None)]
+    # (name, zero_inference, prefix_cache) per engine flavor
+    configs = [("resident", None, None)]
+    if args.prefix_cache:
+        configs = [("prefix_off", None, {"enabled": False}),
+                   ("prefix_on", None, {"enabled": True})]
     if args.zero_inference:
         if args.model == "gpt2":
             raise SystemExit("--zero-inference serves llama/mixtral")
         zi = {"enabled": True, "tier": args.zi_tier,
               "hbm_budget_bytes": (args.hbm_budget_mb * (1 << 20)
                                    or None)}
-        configs.append(("zero_inference", zi))
+        configs.append(("zero_inference", zi, None))
 
+    prompts = build_prompts(args, cfg)
     out = {"metric": "serving_generated_tokens_per_sec",
            "backend": jax.default_backend(), "partial": True, "rows": []}
     commit(out, args.json_out)
-    for name, zi in configs:
+    for name, zi, pc in configs:
         row = measure_config(name, args, params, mod, cfg, phase,
-                             zero_inference=zi)
+                             prompts, zero_inference=zi, prefix_cache=pc)
         out["rows"].append(row)
         # one JSON commit per completed config: a killed window keeps
         # every finished row (round-5: 900 s serving stage, zero output)
@@ -247,6 +336,19 @@ def main():
     # headline compatibility: top-level value mirrors the first row
     out["value"] = out["rows"][0]["value"]
     out["unit"] = "tokens/s"
+    if args.prefix_cache and len(out["rows"]) == 2:
+        off_d, on_d = (r["detail"] for r in out["rows"])
+        out["prefix_ab"] = {
+            "ttft_off_ms": off_d.get("ttft_ms"),
+            "ttft_on_ms": on_d.get("ttft_ms"),
+            "ttft_speedup": (
+                round(off_d["ttft_ms"] / on_d["ttft_ms"], 2)
+                if off_d.get("ttft_ms") and on_d.get("ttft_ms")
+                else None),
+            "tokens_per_s_off": out["rows"][0]["value"],
+            "tokens_per_s_on": out["rows"][1]["value"],
+            "hit_rate": on_d["prefix_cache"]["hit_rate"],
+        }
     commit(out, args.json_out)
 
 

@@ -120,6 +120,80 @@ class ZeroInferenceConfig:
 
 
 @dataclasses.dataclass
+class PrefixCacheConfig:
+    """Automatic prefix caching for the paged-KV serving path (ref:
+    vLLM automatic prefix caching / SGLang RadixAttention; the same
+    memory-wall framing as ZeRO-Infinity, arXiv:2104.07857, applied to
+    HBM KV pages — a scarce tier managed as a deduplicated cache, not
+    per-request scratch).
+
+    Full KV pages are content-addressed by a chained hash of their
+    token span; an incoming prompt maps to its longest cached
+    page-aligned prefix, matched pages are shared into the new
+    sequence's page table with refcount bumps, and prefill starts at
+    the first uncached token.  Pages released by finished or preempted
+    sequences enter a warm pool (eviction-ordered) that is only
+    reclaimed when allocation pressure demands it, so completed
+    requests keep warming the cache.
+
+    ``max_cached_pages`` caps the refcount-0 warm pool in pages;
+    ``max_hbm_fraction`` caps it as a fraction of the usable page pool
+    (both set → the smaller wins).  ``eviction``: ``lru`` (reuse
+    refreshes recency) or ``fifo`` (publish order).
+    """
+
+    enabled: bool = False
+    max_cached_pages: Optional[int] = None   # None = bound by fraction
+    max_hbm_fraction: float = 1.0            # of the usable page pool
+    eviction: str = "lru"                    # lru | fifo
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PrefixCacheConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        p = cls(**{k: v for k, v in d.items() if k in known})
+        if p.eviction not in ("lru", "fifo"):
+            raise ValueError(
+                f"prefix_cache.eviction must be 'lru' or 'fifo', got "
+                f"{p.eviction!r}")
+        if p.max_cached_pages is not None and p.max_cached_pages < 0:
+            raise ValueError(
+                f"prefix_cache.max_cached_pages must be >= 0, got "
+                f"{p.max_cached_pages}")
+        if not 0.0 <= p.max_hbm_fraction <= 1.0:
+            raise ValueError(
+                f"prefix_cache.max_hbm_fraction must be in [0, 1], got "
+                f"{p.max_hbm_fraction}")
+        return p
+
+    @classmethod
+    def coerce(cls, obj) -> "PrefixCacheConfig":
+        """Accept None (disabled), a bool, a dict (writing the block is
+        the opt-in, like ``zero_inference``), or a PrefixCacheConfig."""
+        if obj is None:
+            return cls(enabled=False)
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, bool):
+            return cls(enabled=obj)
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("enabled", True)   # passing a block opts in
+            return cls.from_dict(d)
+        raise TypeError(
+            f"prefix_cache must be a bool, dict or PrefixCacheConfig, "
+            f"got {type(obj).__name__}")
+
+    def pool_cap(self, usable_pages: int) -> int:
+        """Resolve the warm-pool cap against a concrete page pool."""
+        if not self.enabled:
+            return 0
+        cap = int(self.max_hbm_fraction * usable_pages)
+        if self.max_cached_pages is not None:
+            cap = min(cap, self.max_cached_pages)
+        return max(cap, 0)
+
+
+@dataclasses.dataclass
 class TelemetryConfig:
     """Runtime telemetry block (no single reference analogue — it
     unifies the reference's monitor/comms-logger/flops-profiler
@@ -320,6 +394,8 @@ class Config:
     sparse_attention: Optional[Dict[str, Any]] = None
     zero_inference: ZeroInferenceConfig = dataclasses.field(
         default_factory=ZeroInferenceConfig)
+    prefix_cache: PrefixCacheConfig = dataclasses.field(
+        default_factory=PrefixCacheConfig)
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig)
     raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
@@ -424,6 +500,11 @@ class Config:
             # "enabled": false still disables
             c.zero_inference = ZeroInferenceConfig.coerce(
                 d["zero_inference"])
+        if "prefix_cache" in d:
+            # coerce, not from_dict: writing the block IS the opt-in
+            # (same contract as zero_inference above); an explicit
+            # "enabled": false still disables
+            c.prefix_cache = PrefixCacheConfig.coerce(d["prefix_cache"])
         if "telemetry" in d:
             c.telemetry = TelemetryConfig.coerce(d["telemetry"])
         return c

@@ -118,9 +118,17 @@ def init_serving(params, model_config, *, config: Any = None,
     on a host/NVMe tier and stream double-buffered through a bounded
     HBM working set, so the served weight image may exceed HBM.  Its
     ``dtype`` field (e.g. ``int8``) overrides ``weight_dtype``.
+
+    A ``prefix_cache`` block enables automatic prefix caching on the
+    paged-KV path: full KV pages are content-addressed, prompts sharing
+    a page-aligned prefix with earlier traffic skip that prefix's
+    prefill compute, and freed pages stay warm until allocation
+    pressure reclaims them (token-identical on/off).
+
     Remaining ``kw`` (``max_batch``, ``page_size``, ``num_pages``,
-    ``decode_chunk``, ``prefill_chunk``, ``weight_dtype``, …) pass
-    through to the family builder.
+    ``decode_chunk``, ``prefill_chunk``, ``weight_dtype``,
+    ``prefix_cache``, ``admit_lookahead``, …) pass through to the
+    family builder.
     """
     from deepspeed_tpu.inference.serving import serving_engine
 
@@ -128,6 +136,11 @@ def init_serving(params, model_config, *, config: Any = None,
         config = Config.from_dict(config)
     if config is not None and config.zero_inference.enabled:
         kw.setdefault("zero_inference", config.zero_inference)
+    if config is not None and config.prefix_cache.enabled:
+        # `prefix_cache` block → refcounted content-addressed paged-KV
+        # prefix caching in the engine (an explicit prefix_cache= kw
+        # still wins)
+        kw.setdefault("prefix_cache", config.prefix_cache)
     if config is not None:
         # `telemetry` config block → the engine's MetricsRegistry (an
         # explicit telemetry= kw still wins)

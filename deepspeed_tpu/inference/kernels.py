@@ -95,22 +95,136 @@ class PagedKVCache(NamedTuple):
 
 
 class PageAllocator:
-    """Host-side free-list page allocator (continuous batching bookkeeping)."""
+    """Host-side refcounted page allocator with an optional
+    content-addressed warm pool (continuous-batching bookkeeping +
+    automatic prefix caching).
 
-    def __init__(self, num_pages: int):
+    Every page handed out carries a reference count: ``allocate`` mints
+    pages at refcount 1, ``share`` maps already-cached pages into
+    another sequence with a refcount bump, and ``release`` drops one
+    reference per owned page — a page is only reclaimable when its LAST
+    owner releases it.  Full pages whose token span has been
+    content-addressed via ``publish`` do not return to the free list on
+    their last release: they enter a warm pool (capped at
+    ``cache_pages``, eviction-ordered ``lru`` or ``fifo``) where their
+    KV stays resident and matchable, and are only reclaimed when
+    ``allocate`` finds the free list dry — allocation pressure, not
+    request completion, is what erases cache.
+
+    ``cache_pages=0`` (the default) disables publishing entirely and
+    restores the classic free-list semantics: one owner per page,
+    release returns pages immediately.
+    """
+
+    def __init__(self, num_pages: int, cache_pages: int = 0,
+                 eviction: str = "lru"):
+        if eviction not in ("lru", "fifo"):
+            raise ValueError(
+                f"eviction must be 'lru' or 'fifo', got {eviction!r}")
         self.free = list(range(num_pages - 1, -1, -1))
-        self.owned = {}
+        self.owned = {}           # seq_id -> [page, ...]
+        self.refs = {}            # page -> live reference count
+        self.index = {}           # content key -> page (published)
+        self.key_of = {}          # page -> content key
+        self.pool = {}            # page -> eviction priority (refs == 0)
+        self.cache_pages = int(cache_pages)
+        self.eviction = eviction
+        self._published_at = {}   # page -> publish tick (fifo priority)
+        self._tick = 0
+        self.evicted = 0          # lifetime evicted-page count
+        self.published = 0        # lifetime published-page count
 
-    def allocate(self, seq_id: int, n: int = 1):
-        if len(self.free) < n:
+    @property
+    def available(self) -> int:
+        """Pages an ``allocate`` could obtain right now: the free list
+        plus the warm pool (reclaimed on demand)."""
+        return len(self.free) + len(self.pool)
+
+    def allocate(self, seq_id, n: int = 1):
+        """Mint ``n`` fresh pages (refcount 1) for ``seq_id``, evicting
+        warm-pool pages oldest-first when the free list runs dry."""
+        if self.available < n:
             raise MemoryError(f"out of KV pages (need {n}, "
-                              f"free {len(self.free)})")
-        got = [self.free.pop() for _ in range(n)]
+                              f"free {len(self.free)}, "
+                              f"cached {len(self.pool)})")
+        got = []
+        for _ in range(n):
+            p = self.free.pop() if self.free else self._evict_one()
+            self.refs[p] = 1
+            got.append(p)
         self.owned.setdefault(seq_id, []).extend(got)
         return got
 
-    def release(self, seq_id: int):
-        self.free.extend(reversed(self.owned.pop(seq_id, [])))
+    def _evict_one(self) -> int:
+        p = min(self.pool, key=self.pool.get)
+        del self.pool[p]
+        del self.index[self.key_of.pop(p)]
+        self._published_at.pop(p, None)
+        self.evicted += 1
+        return p
+
+    def lookup(self, keys):
+        """Longest cached prefix: walk the chained keys in order and
+        return the matched pages up to the first miss."""
+        pages = []
+        for k in keys:
+            p = self.index.get(k)
+            if p is None:
+                break
+            pages.append(p)
+        return pages
+
+    def share(self, seq_id, pages) -> None:
+        """Map already-cached pages into ``seq_id``'s ownership with a
+        refcount bump each; warm-pool pages revive (leave the pool) —
+        the prefix-hit path.  Shared pages are READ-ONLY by contract:
+        the engine only ever writes at a sequence's own frontier, which
+        lies past every shared page."""
+        for p in pages:
+            if p in self.pool:
+                del self.pool[p]
+                self.refs[p] = 1
+            else:
+                self.refs[p] += 1
+        if pages:
+            self.owned.setdefault(seq_id, []).extend(pages)
+
+    def publish(self, page: int, key: bytes) -> bool:
+        """Content-address a live FULL page so future prompts can match
+        it.  Dedup keeps the first publisher (an identical span already
+        indexed under ``key`` wins); a page publishes at most once.
+        Returns True when the page was newly indexed."""
+        if self.cache_pages <= 0 or key in self.index \
+                or page in self.key_of:
+            return False
+        if page not in self.refs:
+            raise ValueError(f"publish of unowned page {page}")
+        self.index[key] = page
+        self.key_of[page] = key
+        self._tick += 1
+        self._published_at[page] = self._tick
+        self.published += 1
+        return True
+
+    def release(self, seq_id) -> None:
+        """Drop one reference per page owned by ``seq_id``.  Pages
+        hitting refcount 0 return to the free list — unless published,
+        in which case they enter the warm pool and keep their KV
+        matchable until allocation pressure (or the pool cap) evicts
+        them."""
+        for p in reversed(self.owned.pop(seq_id, [])):
+            self.refs[p] -= 1
+            if self.refs[p]:
+                continue
+            del self.refs[p]
+            if p in self.key_of:
+                self._tick += 1
+                self.pool[p] = (self._published_at[p]
+                                if self.eviction == "fifo" else self._tick)
+                while len(self.pool) > self.cache_pages:
+                    self.free.append(self._evict_one())
+            else:
+                self.free.append(p)
 
 
 # ----------------------------------------------- per-layer page writers

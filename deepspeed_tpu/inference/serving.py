@@ -27,6 +27,17 @@ Static-shape tricks worth noting:
   at a sacrificial page keeps them from corrupting live sequences.
 - the decode jit donates the cache, so pages update in place in HBM.
 
+Automatic prefix caching (``prefix_cache=`` / the config block): the
+page allocator is a refcounted, content-addressed pool — full pages are
+keyed by a chained hash of their token span, incoming prompts map to
+their longest cached page-aligned prefix, matched pages are shared
+read-only into the new sequence's table (prefill starts at the first
+uncached token, cutting TTFT), and released pages stay warm in an
+eviction-ordered pool reclaimed only under allocation pressure.  Token-
+identical with caching on or off; composes with split-fuse, chunked
+decode, int8 weights, TP meshes, and the ZeRO-Inference streamed engine
+(which shares this scheduler).
+
 Host-sync discipline (the part that makes this a TPU serving loop and
 not a CPU one): the decode inner loop performs exactly ONE device→host
 transfer per step — the batched sampled tokens.  Sampling runs on-device
@@ -47,8 +58,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.config import TelemetryConfig
+from deepspeed_tpu.config import PrefixCacheConfig, TelemetryConfig
 from deepspeed_tpu.inference.kernels import PagedKVCache, PageAllocator
+from deepspeed_tpu.inference.prefix_cache import (extend_page_keys,
+                                                  matchable_pages,
+                                                  page_keys)
 from deepspeed_tpu.telemetry import (LATENCY_BUCKETS_S, MetricsRegistry,
                                      Span, TelemetryExporter)
 from deepspeed_tpu.utils.logging import logger
@@ -77,6 +91,10 @@ class Request:
     # is observed (preempted requeues carry the cleared state so a
     # recompute never double-counts).  None also means "telemetry off".
     t_submit: Optional[float] = None
+    # cached chained page-key list (prefix caching): grown lazily, never
+    # recomputed — tokens are immutable per incarnation, and a preempted
+    # requeue hands its extended chain to the recompute request
+    page_keys: Optional[List[bytes]] = None
 
 
 @dataclasses.dataclass
@@ -110,7 +128,8 @@ class ServingEngine:
                  prefill_bucket: int = 32, eos_token_id: Optional[int] = None,
                  cache_dtype=jnp.bfloat16, seed: int = 0,
                  decode_chunk: int = 1, prefill_chunk: int = 0,
-                 chunk_prefill_fn=None, mesh=None, telemetry=None):
+                 chunk_prefill_fn=None, mesh=None, telemetry=None,
+                 prefix_cache=None, admit_lookahead: int = 4):
         # Sharded serving (ref: deepspeed/module_inject/replace_module.py
         # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
         # inference): with a mesh, params arrive pre-sharded from the
@@ -161,7 +180,33 @@ class ServingEngine:
 
         # last page is the sacrificial target for inactive-slot writes
         self.trash_page = num_pages - 1
-        self.allocator = PageAllocator(num_pages - 1)
+        # ---- automatic prefix caching: the allocator becomes a
+        # refcounted content-addressed pool (full pages keyed by a
+        # chained hash of their token span); matched prompts share
+        # pages read-only and skip their prefill compute.  The pool cap
+        # is the planner's accounting for pinned shared pages: warm
+        # (refcount-0) cached pages may hold at most this many of the
+        # usable pages — everything above it frees eagerly.
+        pc = PrefixCacheConfig.coerce(prefix_cache)
+        self.prefix_cache = pc
+        self._pc_on = pc.enabled
+        usable = num_pages - 1
+        self.allocator = PageAllocator(
+            usable, cache_pages=pc.pool_cap(usable),
+            eviction=pc.eviction)
+        if self._pc_on and chunk_prefill_fn is None:
+            raise ValueError(
+                "prefix_cache needs chunk_prefill_fn — cache-hit "
+                "admissions prefill only the uncached suffix via the "
+                "continuation forward (forward_paged(..., "
+                "continuation=True))")
+        # bounded admission lookahead (head-of-line blocking fix): when
+        # the queue head cannot fit its pages, up to this many younger
+        # requests are considered instead of stalling the whole queue
+        self.admit_lookahead = int(admit_lookahead)
+        if self.admit_lookahead < 0:
+            raise ValueError(
+                f"admit_lookahead must be >= 0, got {admit_lookahead}")
 
         def put_repl(x):
             x = jnp.asarray(x)
@@ -218,7 +263,40 @@ class ServingEngine:
             "fraction of decode slots active this step")
         self._g_kv_util = r.gauge(
             "serving_kv_page_utilization",
-            "fraction of the usable KV page pool allocated")
+            "fraction of the usable KV page pool referenced by live "
+            "sequences (warm cached pages count as reclaimable, not "
+            "allocated)")
+        self._c_admit_skips = r.counter(
+            "serving_admit_skips",
+            "queue entries skipped over by admission lookahead (head-"
+            "of-line blocking avoided; each admission at queue index i "
+            "adds i)")
+        # prefix-cache metric family (all zero when the feature is off)
+        self._c_pc_hits = r.counter(
+            "prefix_cache_hits",
+            "admissions that matched >= 1 cached page")
+        self._c_pc_misses = r.counter(
+            "prefix_cache_misses", "admissions with no cached prefix")
+        self._c_pc_cached_tokens = r.counter(
+            "prefix_cache_cached_tokens",
+            "prompt tokens served from cached pages (prefill compute "
+            "skipped entirely)")
+        self._c_pc_prompt_tokens = r.counter(
+            "prefix_cache_prompt_tokens",
+            "prompt tokens admitted (hit + miss denominators)")
+        self._c_pc_published = r.counter(
+            "prefix_cache_published_pages",
+            "full pages content-addressed into the index")
+        self._c_pc_evicted = r.counter(
+            "prefix_cache_evicted_pages",
+            "cached pages reclaimed (allocation pressure or pool cap)")
+        self._g_pc_pool = r.gauge(
+            "prefix_cache_pool_pages",
+            "refcount-0 cached pages held warm in the pool")
+        self._g_pc_frac = r.gauge(
+            "prefix_cache_cached_token_fraction",
+            "cumulative cached / admitted prompt tokens")
+        self._evicted_seen = 0
         self._h_ttft = r.histogram(
             "serving_ttft_seconds",
             "submit -> first generated token", LATENCY_BUCKETS_S)
@@ -249,12 +327,19 @@ class ServingEngine:
         ``engine.registry.snapshot()``.  With telemetry disabled the
         counters are no-ops, so this returns zeros (disabling telemetry
         is the explicit opt-out of scheduler accounting)."""
+        pt = int(self._c_pc_prompt_tokens.value)
         return {
             "admitted": int(self._c_admitted.value),
             "preempted": int(self._c_preempted.value),
             "decode_steps": int(self._c_decode_steps.value),
             "decode_syncs": int(self._c_decode_syncs.value),
             "prefill_chunks": int(self._c_prefill_chunks.value),
+            # token-level prefix-cache hit rate (cached / admitted
+            # prompt tokens); 0.0 with the feature off or before any
+            # admission
+            "prefix_hit_rate": (
+                float(self._c_pc_cached_tokens.value) / pt if pt
+                else 0.0),
         }
 
     # -------------------------------------------------- subclass hooks
@@ -372,40 +457,90 @@ class ServingEngine:
         return -(-tokens // self.page_size)
 
     def _admit_one(self) -> bool:
-        """Try to admit the head request; returns True if admitted."""
+        """Admit one queued request into a free slot; returns True if
+        admitted.  Head-of-line blocking fix: when the HEAD request's
+        pages do not fit, up to ``admit_lookahead`` younger requests
+        are considered instead of stalling the whole queue (skipped
+        entries counted in ``serving_admit_skips``).  The head is
+        always tried first, so a large request is never starved — it
+        admits the moment its pages exist."""
         if not self.queue:
             return False
         b = self._free_slot()
         if b is None:
-            return False
-        req = self.queue[0]
+            return False       # no slot: nothing in the window fits
+        window = min(len(self.queue), 1 + self.admit_lookahead)
+        for i in range(window):
+            if self._try_admit(b, self.queue[i]):
+                del self.queue[i]
+                if i:
+                    self._c_admit_skips.inc(i)
+                return True
+        return False
+
+    def _try_admit(self, b: int, req: Request) -> bool:
+        """Admit ``req`` into slot ``b`` if its pages fit; no side
+        effects on failure.  Cache-aware: the prompt's longest cached
+        page-aligned prefix is shared into the page table (refcount
+        bumps, read-only) and prefill starts at the first uncached
+        token — cached-prefix tokens skip compute entirely."""
         T = len(req.tokens)
+        ps = self.page_size
+        # ---- longest cached page-aligned prefix (chained-hash walk).
+        # At least one prompt token always prefills (the engine samples
+        # the first generated token from the last prompt position's
+        # logits), so a fully covered prompt gives up its final page.
+        matched: List[int] = []
+        if self._pc_on:
+            if req.page_keys is None:
+                req.page_keys = page_keys(req.tokens, ps)
+            matched = self.allocator.lookup(
+                req.page_keys[:matchable_pages(T, ps)])
+        cm = len(matched)
+        cached = cm * ps
         bkt = self.prefill_chunk or self.prefill_bucket
-        # bucket-pad for a bounded compile count, clamped to the table
-        # width (a prompt near max_seq must not pad past the row)
-        Tpad = min(-(-T // bkt) * bkt,
-                   self.max_pages_per_seq * self.page_size)
-        need = self._pages_needed(max(Tpad, T + 1))
-        if len(self.allocator.free) < need:
+        # bucket-pad the UNCACHED suffix for a bounded compile count,
+        # clamped to the table width (a prompt near max_seq must not
+        # pad past the row)
+        end = min(cached + -(-(T - cached) // bkt) * bkt,
+                  self.max_pages_per_seq * ps)
+        need = self._pages_needed(max(end, T + 1)) - cm
+        # matched warm-pool pages revive rather than consume free pages,
+        # but they stop being evictable once shared — the fresh-page
+        # demand must be met WITHOUT counting them as reclaimable
+        pooled = sum(1 for p in matched if p in self.allocator.pool)
+        if self.allocator.available - pooled < need:
             return False
-        self.queue.popleft()
         seq_id = self._seq_counter
         self._seq_counter += 1
+        # share BEFORE allocate: allocation pressure must never evict a
+        # page this very admission is about to map
+        if matched:
+            self.allocator.share(seq_id, matched)
         pages = self.allocator.allocate(seq_id, need)
         self._table_host[b, :] = self.trash_page
-        self._table_host[b, :need] = pages
+        self._table_host[b, :cm] = matched
+        self._table_host[b, cm:cm + need] = pages
         self._table_dirty = self._lens_dirty = True
+        if self._pc_on:
+            (self._c_pc_hits if cm else self._c_pc_misses).inc()
+            self._c_pc_cached_tokens.inc(cached)
+            self._c_pc_prompt_tokens.inc(T)
 
         self._rng, rng = jax.random.split(self._rng)
-        if self.prefill_chunk:
-            # split-fuse: defer the prompt to per-iteration chunks; the
-            # slot is not decode-ready until prefill_done reaches T
-            self.slots[b] = _Slot(req=req, seq_len=0, generated=[],
-                                  rng=rng, seq_id=seq_id, prefill_done=0)
+        if self.prefill_chunk or cached:
+            # split-fuse and/or cache-hit admission: the uncached
+            # suffix is absorbed in continuation chunks starting at the
+            # first uncached token; the slot is not decode-ready until
+            # prefill_done reaches T.  (A hit under prefill_chunk=0
+            # absorbs prefill_bucket tokens per iteration.)
+            self.slots[b] = _Slot(req=req, seq_len=cached, generated=[],
+                                  rng=rng, seq_id=seq_id,
+                                  prefill_done=cached)
             self._c_admitted.inc()
             return True
 
-        toks = np.full((1, Tpad), 0, np.int32)
+        toks = np.full((1, end), 0, np.int32)
         toks[0, :T] = req.tokens
         # table row from the HOST copy: a [b:b+1] device slice can alias
         # the live table buffer (full-range slice), which prefill's cache
@@ -422,16 +557,60 @@ class ServingEngine:
                      seq_id=seq_id)
         self.slots[b] = slot
         self._c_admitted.inc()
+        # the prompt's full pages are immutable from here on (decode
+        # writes only at the frontier) — make them matchable now so
+        # concurrent same-prefix requests already hit
+        self._publish_full_pages(b, slot, upto=T)
         # first generated token comes from the REAL last prompt position
         self._append_token(b, self._sample(logits[0, T - 1], slot))
         return True
 
+    def _valid_tokens(self, s: "_Slot") -> int:
+        """Positions of slot ``s`` that hold REAL written KV: mid-
+        prefill that is the absorbed prefix; once decoding, the prompt
+        plus every generated token fed back through decode (the final
+        generated token never is, and structural post-EOS chunk writes
+        land past this bound — never inside a publishable page)."""
+        if s.prefilling:
+            return s.prefill_done
+        return len(s.req.tokens) + max(len(s.generated) - 1, 0)
+
+    def _publish_full_pages(self, b: int, s: "_Slot",
+                            upto: int) -> None:
+        """Content-address every full page of slot ``b`` holding tokens
+        ``0..upto-1`` (chained keys; idempotent — shared prefix pages
+        dedup onto their existing index entries)."""
+        if not self._pc_on:
+            return
+        ps = self.page_size
+        full = min(upto, self.max_pages_per_seq * ps) // ps
+        if full <= 0:
+            return
+        if s.req.page_keys is None:
+            s.req.page_keys = []
+        if len(s.req.page_keys) < full:
+            # incremental: only the pages grown since the last event
+            # (admission hashed the prompt; finish hashes generated)
+            extend_page_keys(s.req.page_keys,
+                             s.req.tokens + s.generated, full, ps)
+        for slot_idx in range(full):
+            page = int(self._table_host[b, slot_idx])
+            if page == self.trash_page:
+                break
+            if self.allocator.publish(page, s.req.page_keys[slot_idx]):
+                self._c_pc_published.inc()
+
     def _advance_prefill(self, b: int, s: "_Slot") -> None:
-        """Absorb the next ``prefill_chunk`` prompt tokens of slot ``b``
-        (one fixed-shape continuation forward: history + chunk).  On the
-        final chunk, sample the first generated token from the last REAL
-        prompt position and flip the slot decode-ready."""
-        C = self.prefill_chunk
+        """Absorb the next chunk of slot ``b``'s prompt (one fixed-shape
+        continuation forward: history + chunk).  On the final chunk,
+        sample the first generated token from the last REAL prompt
+        position and flip the slot decode-ready.
+
+        Chunk size is ``prefill_chunk`` under split-fuse; a cache-hit
+        admission with ``prefill_chunk=0`` absorbs its uncached suffix
+        ``prefill_bucket`` tokens per iteration through the same path
+        (history = the shared cached pages)."""
+        C = self.prefill_chunk or self.prefill_bucket
         T = len(s.req.tokens)
         done = s.prefill_done
         take = min(C, T - done)
@@ -462,6 +641,9 @@ class ServingEngine:
             # decode-ready: the device table/lens row must flip from
             # trash to the real pages before the next decode
             self._table_dirty = self._lens_dirty = True
+            # prompt pages are full and immutable now — make them
+            # matchable before the first token can finish the request
+            self._publish_full_pages(b, s, upto=T)
             self._append_token(b, self._sample(logits[0, take - 1], s))
 
     def _preempt_youngest(self) -> None:
@@ -475,6 +657,11 @@ class ServingEngine:
         s = self.slots[b]
         logger.warning("serving: preempting request %r (%d generated)",
                        s.req.req_id, len(s.generated))
+        # publish-then-release: the victim's full pages stay matchable
+        # in the warm pool, so its recompute-from-scratch requeue (and
+        # any same-prefix request) re-admits against its own cached
+        # prefix — preemption releases REFERENCES, not page contents
+        self._publish_full_pages(b, s, upto=self._valid_tokens(s))
         self.allocator.release(s.seq_id)
         self._table_host[b, :] = self.trash_page
         self._table_dirty = self._lens_dirty = True
@@ -486,7 +673,7 @@ class ServingEngine:
         self.queue.appendleft(Request(
             req.req_id, req.tokens + s.generated,
             req.max_new_tokens - len(s.generated), req.temperature,
-            t_submit=req.t_submit))
+            t_submit=req.t_submit, page_keys=req.page_keys))
         self._c_preempted.inc()
 
     def _sample(self, logits_row, slot: _Slot) -> int:
@@ -513,6 +700,11 @@ class ServingEngine:
         if done:
             self.finished[s.req.req_id] = list(s.req.tokens) + s.generated
             self._newly_finished.append(s.req.req_id)
+            # publish-then-release: the finished request's full pages
+            # (prompt AND generated history — the multi-turn prefix of
+            # a follow-up request) enter the warm pool matchable, and
+            # are reclaimed only under allocation pressure
+            self._publish_full_pages(b, s, upto=self._valid_tokens(s))
             self.allocator.release(s.seq_id)
             self._table_host[b, :] = self.trash_page
             self._table_dirty = self._lens_dirty = True
@@ -538,7 +730,9 @@ class ServingEngine:
             for slot_idx in range(s.seq_len // ps, last_pos // ps + 1):
                 if self._table_host[b, slot_idx] != self.trash_page:
                     continue
-                while not self.allocator.free:
+                # available counts the warm pool: allocate reclaims
+                # cached pages before any preemption is considered
+                while not self.allocator.available:
                     self._preempt_youngest()
                     if self.slots[b] is None:   # we preempted ourselves
                         break
@@ -584,8 +778,20 @@ class ServingEngine:
             self._g_queue.set(len(self.queue))
             self._g_occupancy.set(len(active) / self.max_batch)
             usable = self.trash_page       # pool minus the reserved page
+            # live-referenced pages only: the warm prefix pool is
+            # reclaimable on demand, so it does not count as utilized
             self._g_kv_util.set(
-                (usable - len(self.allocator.free)) / max(usable, 1))
+                (usable - self.allocator.available) / max(usable, 1))
+            if self._pc_on:
+                ev = self.allocator.evicted
+                if ev > self._evicted_seen:
+                    self._c_pc_evicted.inc(ev - self._evicted_seen)
+                    self._evicted_seen = ev
+                self._g_pc_pool.set(len(self.allocator.pool))
+                pt = self._c_pc_prompt_tokens.value
+                if pt:
+                    self._g_pc_frac.set(
+                        self._c_pc_cached_tokens.value / pt)
         if active:
             self._upload_dirty()
             toks = np.zeros((self.max_batch, 1), np.int32)
@@ -870,6 +1076,15 @@ def serving_engine(params, cfg, **kw):
                 "MixtralConfig")
     if isinstance(cfg, GPT2Config):
         return gpt2_serving_engine(params, cfg, **kw)
+    pc = kw.pop("prefix_cache", None)
+    if pc is not None and PrefixCacheConfig.coerce(pc).enabled:
+        # prefix caching lives in the paged-KV decode scheduler; the
+        # encoder engines are fixed-shape batch scorers with no pages
+        # to share — fail loudly, never silently serve uncached
+        raise NotImplementedError(
+            f"prefix_cache needs the paged-KV decode path, which "
+            f"{type(cfg).__name__} does not serve — supported: "
+            "LlamaConfig, MixtralConfig, GPT2Config")
     if isinstance(cfg, BertConfig):
         from deepspeed_tpu.inference.encoder_serving import (
             bert_serving_engine)

@@ -46,7 +46,12 @@ every layer (the serve-anything default).  Composes with:
   head axis shards over ``model``;
 - the continuous-batching scheduler: admission, paging, split-fuse and
   chunked decode run unchanged — only the three compiled entry points
-  are swapped for host-driven streamed executors.
+  are swapped for host-driven streamed executors;
+- automatic prefix caching (``prefix_cache=``): matching, sharing, and
+  warm-pool eviction live in the base scheduler's refcounted allocator
+  and page-table bookkeeping, so streamed block programs read shared
+  pages through the same per-layer page arrays — a cache-hit admission
+  runs the "chunk" phase over the uncached suffix only.
 """
 
 from __future__ import annotations

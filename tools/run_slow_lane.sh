@@ -29,6 +29,13 @@ RC=${PIPESTATUS[0]}
 # SLOW_LANE.json — best-effort, never the reason the lane fails
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/telemetry_dump.py \
   --cpu --json-out "$REPO/TELEMETRY_SAMPLE.json" >/dev/null 2>&1 || true
+
+# prefix-cache A/B: the shared-prefix workload served with caching off
+# vs on (TTFT, tokens/s, hit rate) stamps PREFIX_BENCH.json through the
+# same atomic evidence writer — best-effort like the telemetry sample
+timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_serving.py --cpu \
+  --prefix-cache --requests 32 --new-tokens 16 \
+  --json-out "$REPO/PREFIX_BENCH.json" >/dev/null 2>&1 || true
 SUMMARY=$(grep -aE '[0-9]+ (passed|failed|error|skipped)' "$LOG" | tail -1)
 
 python - "$OUT" "$RC" "$T0" "$SUMMARY" <<'EOF'

@@ -50,19 +50,24 @@ def main():
     cfg = gpt2.GPT2Config.tiny(dim=64, n_layers=2, n_heads=4,
                                max_seq_len=128)
     params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
-    prompt_len = 8
+    prompt_len = 24
     max_seq = prompt_len + args.new_tokens
+    # prefix caching on: the sample registry carries a LIVE
+    # prefix_cache_* metric family (shared-prefix traffic below
+    # produces real hits, published pages and pool occupancy)
     eng = serving_engine(
         params, cfg, max_batch=4, page_size=8,
-        num_pages=4 * (-(-max_seq // 8)) + 8, max_seq=max_seq,
-        prefill_bucket=prompt_len, decode_chunk=4)
+        num_pages=4 * (-(-max_seq // 8)) + 16, max_seq=max_seq,
+        prefill_bucket=8, decode_chunk=4, prefix_cache=True)
 
     rng = np.random.default_rng(0)
+    prefix = rng.integers(1, cfg.vocab_size, prompt_len - 4).tolist()
     t0 = time.perf_counter()
     for i in range(args.requests):
-        eng.submit(i, rng.integers(1, cfg.vocab_size, prompt_len).tolist(),
+        eng.submit(i, prefix + rng.integers(1, cfg.vocab_size, 4).tolist(),
                    max_new_tokens=args.new_tokens)
     out = eng.run()
+    eng.step()                   # settle gauges after the drain
     wall = time.perf_counter() - t0
 
     snap = eng.registry.snapshot()
