@@ -145,6 +145,10 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
         engine.run()
     engine.drain_finished()
     compile_s = time.perf_counter() - t_compile
+    # the flight recorder saw the warmup lifecycle (compile-dominated
+    # spans): drop it so trace_breakdown covers timed traffic only
+    if engine.tracer.enabled:
+        engine.tracer.recorder.clear()
 
     # warmup traffic must not pollute the timed rows' comparison:
     # histogram/counter deltas against this snapshot isolate it
@@ -211,6 +215,14 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
         row["detail"]["ttft_ms"] = round(
             1000 * (ttft.get("sum", 0.0) - ttft0.get("sum", 0.0))
             / d_count, 2)
+    if engine.tracer.enabled:
+        # per-request critical path from the flight recorder (queue
+        # wait / prefill / decode / stream-stall, p50/p95 over the
+        # timed traffic — warmup was cleared from the ring above)
+        from deepspeed_tpu.request_trace import request_breakdown
+
+        row["detail"]["trace_breakdown"] = request_breakdown(
+            engine.tracer.recorder.events())["summary"]
     if args.prefix_cache:
         def delta(key):
             return int(cnt.get(key, 0)) - int(cnt0.get(key, 0))

@@ -28,6 +28,7 @@ from deepspeed_tpu import ops
 from deepspeed_tpu import zero
 from deepspeed_tpu import lr_schedules
 from deepspeed_tpu import telemetry
+from deepspeed_tpu import request_trace
 
 
 def init_inference(*args, **kwargs):

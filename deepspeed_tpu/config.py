@@ -251,6 +251,69 @@ class TelemetryConfig:
 
 
 @dataclasses.dataclass
+class TracingConfig:
+    """Per-request tracing + flight-recorder block (no single reference
+    analogue; the third observability pillar next to ``telemetry`` —
+    per-request event timelines and hang postmortems, see
+    :mod:`deepspeed_tpu.request_trace`).
+
+    Default-on: the recorder is a preallocated ring and each event is
+    one clock read + one tuple store (bounded in
+    ``SERVING_OVERHEAD.json`` ``tracing_overhead``), cheap enough to
+    leave on in production so a hang always leaves a postmortem.
+    ``sample_rate`` thins PER REQUEST (deterministic on the request id:
+    0.1 traces every 10th request's full lifecycle, 0 disables —
+    ``enabled: false`` and ``sample_rate: 0`` both hand out the shared
+    no-op tracer).  ``ring_capacity`` bounds memory: overflow drops the
+    OLDEST events (a postmortem wants the last seconds).  ``dump_dir``
+    receives automatic flight-recorder dumps on ``Watchdog`` timeout,
+    unhandled exception (``install_excepthook``), or ``SIGUSR1``
+    (``sigusr1``).
+    """
+
+    enabled: bool = True
+    sample_rate: float = 1.0             # per-request; 0 = off
+    ring_capacity: int = 65536           # events kept (newest win)
+    dump_dir: str = "/tmp/dstpu_flight"  # postmortem dump target
+    install_excepthook: bool = False     # chain sys.excepthook → dump
+    sigusr1: bool = False                # SIGUSR1 → dump (live probe)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TracingConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        t = cls(**{k: v for k, v in d.items() if k in known})
+        # store the coerced values, not just validate through the cast:
+        # string-sourced configs (env/YAML) must not survive as strings
+        t.sample_rate = float(t.sample_rate)
+        t.ring_capacity = int(t.ring_capacity)
+        if not 0.0 <= t.sample_rate <= 1.0:
+            raise ValueError(
+                f"tracing.sample_rate must be in [0, 1], got "
+                f"{t.sample_rate}")
+        if t.ring_capacity < 1:
+            raise ValueError(
+                f"tracing.ring_capacity must be >= 1, got "
+                f"{t.ring_capacity}")
+        return t
+
+    @classmethod
+    def coerce(cls, obj) -> "TracingConfig":
+        """Accept None (defaults), a bool, a dict, or a TracingConfig —
+        the same loose contract as ``telemetry``."""
+        if obj is None:
+            return cls()
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, bool):
+            return cls(enabled=obj)
+        if isinstance(obj, dict):
+            return cls.from_dict(obj)
+        raise TypeError(
+            f"tracing must be a bool, dict or TracingConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class PrecisionConfig:
     """ref: deepspeed/runtime/fp16/loss_scaler.py + config fp16/bf16 blocks."""
 
@@ -398,6 +461,8 @@ class Config:
         default_factory=PrefixCacheConfig)
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig)
+    tracing: TracingConfig = dataclasses.field(
+        default_factory=TracingConfig)
     raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     # ---------------------------------------------------------------- parse
@@ -507,6 +572,8 @@ class Config:
             c.prefix_cache = PrefixCacheConfig.coerce(d["prefix_cache"])
         if "telemetry" in d:
             c.telemetry = TelemetryConfig.coerce(d["telemetry"])
+        if "tracing" in d:
+            c.tracing = TracingConfig.coerce(d["tracing"])
         return c
 
     @classmethod

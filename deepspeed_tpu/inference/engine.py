@@ -145,4 +145,7 @@ def init_serving(params, model_config, *, config: Any = None,
         # `telemetry` config block → the engine's MetricsRegistry (an
         # explicit telemetry= kw still wins)
         kw.setdefault("telemetry", config.telemetry)
+        # `tracing` block → the engine's RequestTracer flight recorder
+        # (per-request event timelines + hang postmortems)
+        kw.setdefault("tracing", config.tracing)
     return serving_engine(params, model_config, mesh=mesh, **kw)

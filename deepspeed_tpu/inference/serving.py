@@ -58,11 +58,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.config import PrefixCacheConfig, TelemetryConfig
+from deepspeed_tpu.config import (PrefixCacheConfig, TelemetryConfig,
+                                  TracingConfig)
 from deepspeed_tpu.inference.kernels import PagedKVCache, PageAllocator
 from deepspeed_tpu.inference.prefix_cache import (extend_page_keys,
                                                   matchable_pages,
                                                   page_keys)
+from deepspeed_tpu.request_trace import RequestTracer
 from deepspeed_tpu.telemetry import (LATENCY_BUCKETS_S, MetricsRegistry,
                                      Span, TelemetryExporter)
 from deepspeed_tpu.utils.logging import logger
@@ -95,6 +97,11 @@ class Request:
     # recomputed — tokens are immutable per incarnation, and a preempted
     # requeue hands its extended chain to the recompute request
     page_keys: Optional[List[bytes]] = None
+    # flight-recorder state: the per-request sampling decision (made
+    # once at submit) and the first-token edge (a preempted requeue
+    # carries both so a recompute never re-emits first_token)
+    traced: bool = False
+    first_token_seen: bool = False
 
 
 @dataclasses.dataclass
@@ -129,7 +136,8 @@ class ServingEngine:
                  cache_dtype=jnp.bfloat16, seed: int = 0,
                  decode_chunk: int = 1, prefill_chunk: int = 0,
                  chunk_prefill_fn=None, mesh=None, telemetry=None,
-                 prefix_cache=None, admit_lookahead: int = 4):
+                 prefix_cache=None, admit_lookahead: int = 4,
+                 tracing=None):
         # Sharded serving (ref: deepspeed/module_inject/replace_module.py
         # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
         # inference): with a mesh, params arrive pre-sharded from the
@@ -321,6 +329,20 @@ class ServingEngine:
                 self.registry, prometheus_path=tcfg.prometheus_path,
                 interval_s=tcfg.interval_s, http_port=tcfg.http_port)
 
+        # ---- per-request tracing: every lifecycle edge lands in the
+        # flight recorder (queued → admitted → prefill-chunk →
+        # first-token → decode-batch → preempt/requeue → finish).
+        # `tracing` accepts None/bool/dict/TracingConfig — or an
+        # existing RequestTracer to share one recorder across engines.
+        # _trace_on guards every emit site; the disabled tracer is the
+        # shared no-op singleton (no clock, no lock, no ring).
+        if isinstance(tracing, RequestTracer):
+            self.tracer = tracing
+        else:
+            self.tracer = RequestTracer.from_config(
+                TracingConfig.coerce(tracing))
+        self._trace_on = self.tracer.enabled
+
     @property
     def stats(self) -> Dict[str, Any]:
         """Deprecation shim over the registry — prefer
@@ -412,10 +434,17 @@ class ServingEngine:
                 f"request {req_id}: needs {lifetime_pages} pages at full "
                 f"length but the pool has {usable} — it could never "
                 "complete even alone")
+        traced = self._trace_on and self.tracer.sampled(req_id)
         self.queue.append(Request(
             req_id, tokens, max_new_tokens, temperature,
-            t_submit=time.perf_counter() if self._tel_on else None))
+            t_submit=time.perf_counter() if self._tel_on else None,
+            traced=traced))
         self._g_queue.set(len(self.queue))
+        if traced:
+            self.tracer.event("queued", req_id, attrs={
+                "prompt_tokens": len(tokens),
+                "max_new_tokens": max_new_tokens,
+                "queue_depth": len(self.queue)})
 
     @property
     def has_work(self) -> bool:
@@ -471,14 +500,15 @@ class ServingEngine:
             return False       # no slot: nothing in the window fits
         window = min(len(self.queue), 1 + self.admit_lookahead)
         for i in range(window):
-            if self._try_admit(b, self.queue[i]):
+            if self._try_admit(b, self.queue[i], queue_skips=i):
                 del self.queue[i]
                 if i:
                     self._c_admit_skips.inc(i)
                 return True
         return False
 
-    def _try_admit(self, b: int, req: Request) -> bool:
+    def _try_admit(self, b: int, req: Request,
+                   queue_skips: int = 0) -> bool:
         """Admit ``req`` into slot ``b`` if its pages fit; no side
         effects on failure.  Cache-aware: the prompt's longest cached
         page-aligned prefix is shared into the page table (refcount
@@ -526,6 +556,11 @@ class ServingEngine:
             (self._c_pc_hits if cm else self._c_pc_misses).inc()
             self._c_pc_cached_tokens.inc(cached)
             self._c_pc_prompt_tokens.inc(T)
+        if req.traced:
+            # BEFORE the prefill compute below: the trace's
+            # admitted→first_token span is the prefill cost
+            self.tracer.event("admitted", req.req_id, b, attrs={
+                "cached_tokens": cached, "queue_skips": queue_skips})
 
         self._rng, rng = jax.random.split(self._rng)
         if self.prefill_chunk or cached:
@@ -636,6 +671,9 @@ class ServingEngine:
         s.prefill_done = done + take
         s.seq_len = s.prefill_done
         self._c_prefill_chunks.inc()
+        if s.req.traced:
+            self.tracer.event("prefill_chunk", s.req.req_id, b, attrs={
+                "done": s.prefill_done, "of": T, "take": take})
         if s.prefill_done >= T:
             s.prefill_done = -1
             # decode-ready: the device table/lens row must flip from
@@ -667,14 +705,20 @@ class ServingEngine:
         self._table_dirty = self._lens_dirty = True
         self.slots[b] = None
         req = s.req
+        if req.traced:
+            self.tracer.event("preempt", req.req_id, b, attrs={
+                "generated": len(s.generated)})
         # requeue prompt+generated for recompute; the finished output is
         # simply tokens+generated of the FINAL incarnation, which already
         # contains everything produced before preemption
         self.queue.appendleft(Request(
             req.req_id, req.tokens + s.generated,
             req.max_new_tokens - len(s.generated), req.temperature,
-            t_submit=req.t_submit, page_keys=req.page_keys))
+            t_submit=req.t_submit, page_keys=req.page_keys,
+            traced=req.traced, first_token_seen=req.first_token_seen))
         self._c_preempted.inc()
+        if req.traced:
+            self.tracer.event("requeue", req.req_id)
 
     def _sample(self, logits_row, slot: _Slot) -> int:
         from deepspeed_tpu.inference.generation import sample_logits
@@ -695,11 +739,20 @@ class ServingEngine:
             elif s.last_tok_t:
                 self._h_itl.observe(now - s.last_tok_t)
             s.last_tok_t = now
+        if s.req.traced and not s.req.first_token_seen:
+            # adjacent to the TTFT observation above so the trace's
+            # queued→first_token delta agrees with the histogram
+            s.req.first_token_seen = True
+            self.tracer.event("first_token", s.req.req_id, b)
         done = (self.eos is not None and tok == self.eos) or \
             len(s.generated) >= s.req.max_new_tokens
         if done:
             self.finished[s.req.req_id] = list(s.req.tokens) + s.generated
             self._newly_finished.append(s.req.req_id)
+            if s.req.traced:
+                self.tracer.event("finish", s.req.req_id, b, attrs={
+                    "generated": len(s.generated),
+                    "total_tokens": len(s.req.tokens) + len(s.generated)})
             # publish-then-release: the finished request's full pages
             # (prompt AND generated history — the multi-turn prefix of
             # a follow-up request) enter the warm pool matchable, and
@@ -814,6 +867,11 @@ class ServingEngine:
             self._c_decode_steps.inc(K)
             self._c_decode_syncs.inc()
             host_toks = np.asarray(out)         # the ONE host sync
+            if self._trace_on and any(s.req.traced for _, s in active):
+                # one event per BATCH sync (not per token): the decode
+                # timeline at chunk granularity, nothing hotter
+                self.tracer.event("decode_batch", attrs={
+                    "active": len(active), "chunk": K})
             for b, s in active:
                 for j in range(K):
                     self._append_token(b, int(host_toks[b, j]))
@@ -1076,6 +1134,11 @@ def serving_engine(params, cfg, **kw):
                 "MixtralConfig")
     if isinstance(cfg, GPT2Config):
         return gpt2_serving_engine(params, cfg, **kw)
+    # per-request tracing lives in the paged-KV decode scheduler's
+    # lifecycle (queued/admitted/first-token/finish edges); the encoder
+    # engines are fixed-shape batch scorers with no such lifecycle —
+    # the block is accepted and unused there, never an error
+    kw.pop("tracing", None)
     pc = kw.pop("prefix_cache", None)
     if pc is not None and PrefixCacheConfig.coerce(pc).enabled:
         # prefix caching lives in the paged-KV decode scheduler; the

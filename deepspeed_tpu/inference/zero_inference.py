@@ -236,7 +236,12 @@ class ZeroInferenceServingEngine(ServingEngine):
                                 for i in range(n_leaves)],
             shapes=self._bshapes, dtypes=self._bdtypes,
             to_device=self._upload_layer, depth=zi.prefetch_depth,
-            registry=self.registry, prefix="zi_stream")
+            registry=self.registry, prefix="zi_stream",
+            # layer fetch-issue/arrive/stall events land in the same
+            # flight recorder as the request lifecycle (base ctor built
+            # the tracer): a slow request's trace shows WHICH layer's
+            # tier fence it sat behind
+            tracer=self.tracer)
         self._stem_dev = self._place(stem, stem_specs)
         if "embed" in head and head["embed"] is stem["embed"]:
             # tied embeddings: hand head the ALREADY-PLACED table so the

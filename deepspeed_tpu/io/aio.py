@@ -68,10 +68,16 @@ class AioHandle:
         # DSTPU_TELEMETRY=0): submit/byte counters + a pending-depth
         # gauge, the aio-pool occupancy view the streaming schedulers'
         # hit/stall counters summarize per layer
+        from deepspeed_tpu.request_trace import default_tracer
         from deepspeed_tpu.telemetry import default_registry
 
         reg = default_registry()
         self._tel_on = reg.enabled     # guards the pending() samples too
+        # flight-recorder hookup (process default tracer, like the
+        # registry): submit/complete events give a hang postmortem the
+        # io timeline the counters above only aggregate
+        self._tracer = default_tracer()
+        self._trace_on = self._tracer.enabled
         self._c_reads = reg.counter(
             "aio_reads_submitted", "async pread submissions")
         self._c_writes = reg.counter(
@@ -124,6 +130,9 @@ class AioHandle:
             self._c_reads.inc()
             self._c_rbytes.inc(buf.nbytes)
             self._g_pending.set(self.pending())
+        if self._trace_on:
+            self._tracer.event("aio_read_submit", attrs={
+                "bytes": buf.nbytes, "offset": offset})
 
     def pwrite(self, fd: int, buf: np.ndarray, offset: int = 0) -> None:
         assert buf.flags["C_CONTIGUOUS"]
@@ -138,6 +147,9 @@ class AioHandle:
             self._c_writes.inc()
             self._c_wbytes.inc(buf.nbytes)
             self._g_pending.set(self.pending())
+        if self._trace_on:
+            self._tracer.event("aio_write_submit", attrs={
+                "bytes": buf.nbytes, "offset": offset})
 
     @staticmethod
     def _py_rw(fd: int, buf: np.ndarray, offset: int, write: bool):
@@ -163,16 +175,18 @@ class AioHandle:
         """Block until all submitted ops complete; returns #errors."""
         if self.native:
             errs = int(self._lib.dstpu_aio_wait(self._pool))
-            self._g_pending.set(0)
-            return errs
-        errs = 0
-        for f in self._futures:
-            try:
-                f.result()
-            except Exception:
-                errs += 1
-        self._futures = []
+        else:
+            errs = 0
+            for f in self._futures:
+                try:
+                    f.result()
+                except Exception:
+                    errs += 1
+            self._futures = []
         self._g_pending.set(0)
+        if self._trace_on:
+            self._tracer.event("aio_wait_complete",
+                               attrs={"errors": errs})
         return errs
 
     def __del__(self):

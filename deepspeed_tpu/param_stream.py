@@ -113,10 +113,19 @@ class TierLayerReader:
 
     def __init__(self, tier: _Tier, names_fn: Callable[[int], List[str]],
                  shapes, dtypes, to_device, depth: int = 1,
-                 registry=None, prefix: str = "tier_reader"):
+                 registry=None, prefix: str = "tier_reader",
+                 tracer=None):
+        from deepspeed_tpu import request_trace as _request_trace
         from deepspeed_tpu import telemetry as _telemetry
 
         self.tier = tier
+        # flight-recorder hookup: fetch issue/arrive/stall events under
+        # `{prefix}_` phases — the per-layer timeline the hit/stall
+        # COUNTERS above summarize.  No tracer → shared no-op.
+        self._tracer = (tracer if tracer is not None
+                        else _request_trace.NULL_TRACER)
+        self._trace_on = self._tracer.enabled
+        self._prefix = prefix
         self._nvme = isinstance(tier, _NvmeTier)
         self.names_fn = names_fn
         self.shapes = list(shapes)
@@ -152,6 +161,9 @@ class TierLayerReader:
                 "time blocked on a tier fence (exposed IO cost)")
 
     def _submit(self, l: int):
+        if self._trace_on:
+            self._tracer.event(f"{self._prefix}_fetch_issue", attrs={
+                "layer": l, "bytes": self._layer_bytes})
         return [self.tier.get_submit(n, s, d)
                 for n, s, d in zip(self.names_fn(l), self.shapes,
                                    self.dtypes)]
@@ -166,7 +178,8 @@ class TierLayerReader:
         if self._nvme:
             pending = self._submit(order[0])
             for i, l in enumerate(order):
-                if self.tier.reads_pending() == 0:
+                hit = self.tier.reads_pending() == 0
+                if hit:
                     self.hits += 1
                     self._c_hits.inc()
                 else:
@@ -178,6 +191,17 @@ class TierLayerReader:
                 self._h_wait.observe(dt)
                 if on_wait is not None:
                     on_wait(dt)
+                if self._trace_on:
+                    # a stall's blocked interval renders as a slice in
+                    # the Chrome export; a hit is a point arrival
+                    if hit:
+                        self._tracer.event(
+                            f"{self._prefix}_fetch_arrive",
+                            attrs={"layer": l})
+                    else:
+                        self._tracer.event(
+                            f"{self._prefix}_stall",
+                            attrs={"layer": l, "wait_s": dt})
                 self.tier.next_read_slot()
                 self._c_bytes.inc(self._layer_bytes)
                 bufs = pending
@@ -562,12 +586,15 @@ class ParamStreamEngine:
         return [f"p_{l}_{nm}" for nm in self._bnames]
 
     def _make_reader(self) -> TierLayerReader:
+        from deepspeed_tpu.request_trace import default_tracer
+
         return TierLayerReader(
             self.tier, names_fn=self._layer_keys,
             shapes=[(sz,) for sz in self._bsizes],
             dtypes=[self._cdt_np] * len(self._bnames),
             to_device=lambda bufs, _l: self._bufs_to_device(bufs),
-            registry=self.registry, prefix="pstream")
+            registry=self.registry, prefix="pstream",
+            tracer=default_tracer())
 
     def _submit_layer_read(self, l: int):
         return [self.tier.get_submit(n, (sz,), self._cdt_np)
@@ -837,6 +864,17 @@ class ParamStreamEngine:
         for k, v in ph.items():
             if k != "total" and v > 0:
                 self.registry.counter(f"pstream_phase_{k}_seconds").inc(v)
+        from deepspeed_tpu.request_trace import default_tracer
+
+        tr = default_tracer()
+        if tr.enabled:
+            # one flight-recorder event per train step carrying the
+            # whole phase breakdown — a hang postmortem shows which
+            # phase the last completed step spent its time in
+            attrs = {k: round(v, 6) for k, v in ph.items()}
+            attrs["step"] = self.global_steps
+            attrs["skipped"] = skipped
+            tr.event("pstream_step", attrs=attrs)
 
     # ------------------------------------------------------------- updates
     def _accum_layer(self, gbuf, l: int, flat: List[np.ndarray]) -> None:

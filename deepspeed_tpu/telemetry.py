@@ -40,6 +40,7 @@ import math
 import os
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from deepspeed_tpu.utils.evidence import atomic_write_text
@@ -461,6 +462,11 @@ class TelemetryExporter:
         self.port: Optional[int] = None
         if http_port is not None and registry.enabled:
             self._start_http(int(http_port))
+        # postmortem flushing: the watchdog's timeout path (and any
+        # other abort path) force-flushes every live exporter so the
+        # last scrape on disk reflects the moment of death, not the
+        # last interval tick; weak so dead engines release theirs
+        _exporters.add(self)
 
     def maybe_export(self, step: Optional[int] = None,
                      force: bool = False) -> bool:
@@ -513,6 +519,25 @@ class TelemetryExporter:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+
+
+# ----------------------------------------------------- exporter registry
+_exporters: "weakref.WeakSet[TelemetryExporter]" = weakref.WeakSet()
+
+
+def flush_all_exporters() -> int:
+    """Force one export tick on every live :class:`TelemetryExporter`
+    (Prometheus file + monitor bridge), each individually guarded —
+    the watchdog calls this before ``os._exit(42)`` so a hang's final
+    metric state lands on disk.  Returns the number flushed."""
+    n = 0
+    for e in list(_exporters):
+        try:
+            if e.maybe_export(force=True):
+                n += 1
+        except Exception:
+            pass
+    return n
 
 
 # ------------------------------------------------------- default registry

@@ -116,10 +116,31 @@ class CommsLogger:
 
 
 _global_tracer: Optional[Tracer] = None
+_DEFAULT_LOG_DIR = "/tmp/dstpu_trace"
 
 
-def get_tracer(log_dir: str = "/tmp/dstpu_trace") -> Tracer:
+def get_tracer(log_dir: Optional[str] = None) -> Tracer:
+    """Process-wide profiler tracer.
+
+    ``log_dir=None`` means "whatever the singleton already uses".  The
+    old behavior cached the FIRST caller's dir forever and silently
+    ignored every later ``log_dir`` — a second subsystem asking for its
+    own capture directory got a tracer writing somewhere else.  Now an
+    explicit dir re-points the idle singleton; if a capture is ACTIVE
+    the running profiler owns its directory, so the change is refused
+    with a warning instead of being silently dropped."""
     global _global_tracer
     if _global_tracer is None:
-        _global_tracer = Tracer(log_dir)
+        _global_tracer = Tracer(log_dir or _DEFAULT_LOG_DIR)
+    elif log_dir is not None and log_dir != _global_tracer.log_dir:
+        if _global_tracer.active:
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                "get_tracer: capture already active in %s — ignoring "
+                "log_dir=%r until stop() (stop the capture before "
+                "re-pointing the tracer)",
+                _global_tracer.log_dir, log_dir)
+        else:
+            _global_tracer.log_dir = log_dir
     return _global_tracer

@@ -98,8 +98,34 @@ class Watchdog:
                     "watchdog: no heartbeat for %.0fs on host %d — "
                     "likely hung collective (dead peer)",
                     self.timeout_s, jax.process_index())
+                # postmortem BEFORE callbacks or the abort: dump every
+                # live flight recorder (the hung request's last events)
+                # and force-flush telemetry sinks, each individually
+                # guarded — a failing dump must never mask the abort
+                try:
+                    from deepspeed_tpu import request_trace
+
+                    paths = request_trace.postmortem_dump(
+                        "watchdog_timeout")
+                    if paths:
+                        logger.error(
+                            "watchdog: flight-recorder dump → %s",
+                            ", ".join(paths))
+                except Exception:
+                    logger.exception(
+                        "watchdog: flight-recorder dump failed")
+                try:
+                    from deepspeed_tpu import telemetry
+
+                    telemetry.flush_all_exporters()
+                except Exception:
+                    logger.exception("watchdog: telemetry flush failed")
                 if self.on_timeout is not None:
-                    self.on_timeout()
+                    try:
+                        self.on_timeout()
+                    except Exception:
+                        logger.exception(
+                            "watchdog: on_timeout callback raised")
                 if self.abort_on_timeout:
                     os._exit(42)
                 return

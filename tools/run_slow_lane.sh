@@ -36,6 +36,14 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/telemetry_dump.py \
 timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_serving.py --cpu \
   --prefix-cache --requests 32 --new-tokens 16 \
   --json-out "$REPO/PREFIX_BENCH.json" >/dev/null 2>&1 || true
+
+# trace selftest: a short traced serving workload, Chrome-export
+# validation (matched async spans, monotonic ts) + the trace-vs-
+# telemetry TTFT cross-check, stamped into TRACE_SAMPLE.json —
+# best-effort like the samples above
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/trace_report.py \
+  --selftest --cpu --json-out "$REPO/TRACE_SAMPLE.json" \
+  >/dev/null 2>&1 || true
 SUMMARY=$(grep -aE '[0-9]+ (passed|failed|error|skipped)' "$LOG" | tail -1)
 
 python - "$OUT" "$RC" "$T0" "$SUMMARY" <<'EOF'

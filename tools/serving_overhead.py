@@ -33,7 +33,8 @@ sys.path.insert(0, REPO)
 
 
 def measure_point(model_name, slots, decode_chunk, prompt_len=8,
-                  new_tokens=48, requests=None, telemetry=True):
+                  new_tokens=48, requests=None, telemetry=True,
+                  tracing=True):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -57,7 +58,7 @@ def measure_point(model_name, slots, decode_chunk, prompt_len=8,
         params, cfg, max_batch=slots, page_size=8,
         num_pages=slots * (-(-max_seq // 8)) + 8, max_seq=max_seq,
         prefill_bucket=prompt_len, decode_chunk=decode_chunk,
-        telemetry=telemetry)
+        telemetry=telemetry, tracing=tracing)
 
     def decode_steps():
         return int(eng.registry.snapshot()["counters"]
@@ -114,7 +115,7 @@ def measure_point(model_name, slots, decode_chunk, prompt_len=8,
     return {
         "model": model_name, "slots": slots, "decode_chunk": K,
         "requests": requests, "generated": generated,
-        "telemetry": bool(telemetry),
+        "telemetry": bool(telemetry), "tracing": bool(tracing),
         "decode_steps": steps,
         "prefill_chunks": int(eng.registry.snapshot()["counters"]
                               .get("serving_prefill_chunks", 0)),
@@ -126,48 +127,21 @@ def measure_point(model_name, slots, decode_chunk, prompt_len=8,
     }
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--cpu", action="store_true",
-                    help="force the CPU backend in-process")
-    ap.add_argument("--json-out",
-                    default=os.path.join(REPO, "SERVING_OVERHEAD.json"))
-    args = ap.parse_args()
-
-    import jax
-
-    if args.cpu:
-        jax.config.update("jax_platforms", "cpu")
-
-    rows = []
-    # slots sweep at the default chunking, all three families
-    for model in ("llama", "mixtral", "gpt2"):
-        for slots in (1, 2, 4, 8):
-            rows.append(measure_point(model, slots, decode_chunk=8))
-            print(json.dumps(rows[-1]), flush=True)
-    # sync-amortization sweep: K=1 pays one host sync per token
-    for k in (1, 2, 4):
-        rows.append(measure_point("llama", 4, decode_chunk=k))
-        print(json.dumps(rows[-1]), flush=True)
-
-    # telemetry-overhead A/B (ISSUE 2 acceptance): the decode loop with
-    # the registry DISABLED must sit within noise of the enabled loop's
-    # scheduler cost — 3 reps each, best-of (CPU wall jitter dominates a
-    # single rep).  The enabled delta is also reported: that is the
-    # price of TTFT/ITL histograms + gauges on every step.
+def _ab(param, best_of=3, **fixed):
+    """Best-of-N A/B of one measure_point flag: the decode loop with
+    the feature DISABLED must sit within noise of the enabled loop's
+    cost (CPU wall jitter dominates a single rep)."""
     ab = {}
-    for tel in (True, False):
-        reps = [measure_point("llama", 4, decode_chunk=8, telemetry=tel)
-                for _ in range(3)]
+    for on in (True, False):
+        reps = [measure_point("llama", 4, decode_chunk=8,
+                              **{param: on}, **fixed)
+                for _ in range(best_of)]
         best = min(reps, key=lambda r: r["total_ms_per_step"])
-        ab["enabled" if tel else "disabled"] = best
-        print(json.dumps({"telemetry_ab": best}), flush=True)
+        ab["enabled" if on else "disabled"] = best
+        print(json.dumps({f"{param}_ab": best}), flush=True)
     d_ms = (ab["enabled"]["total_ms_per_step"]
             - ab["disabled"]["total_ms_per_step"])
-    telemetry_overhead = {
-        "note": ("best-of-3 ms/decode-step, registry enabled vs "
-                 "disabled on the same build; disabled path = no-op "
-                 "metric singletons, no clock reads in the decode loop"),
+    return ab, {
         "enabled_ms_per_step": ab["enabled"]["total_ms_per_step"],
         "disabled_ms_per_step": ab["disabled"]["total_ms_per_step"],
         "enabled_minus_disabled_ms": round(d_ms, 3),
@@ -176,16 +150,76 @@ def main():
         if ab["disabled"]["total_ms_per_step"] else None,
     }
 
-    out = {
-        "metric": "serving_scheduler_overhead",
-        "backend": jax.default_backend(),
-        "note": ("scheduler_ms_per_step = wall/decode_steps minus pure-"
-                 "jit replay of the engine's compiled decode chunk; "
-                 "host cost is backend-independent, so the CPU rows "
-                 "bound the TPU scheduler overhead"),
-        "rows": rows,
-        "telemetry_overhead": telemetry_overhead,
-    }
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend in-process")
+    ap.add_argument("--ab-only", action="store_true",
+                    help="re-run only the telemetry/tracing overhead "
+                         "A/Bs and merge into an existing json-out "
+                         "(keeps the full sweep's rows)")
+    ap.add_argument("--json-out",
+                    default=os.path.join(REPO, "SERVING_OVERHEAD.json"))
+    args = ap.parse_args()
+    if args.ab_only and not os.path.exists(args.json_out):
+        ap.error(f"--ab-only merges into an existing --json-out, but "
+                 f"{args.json_out} does not exist (run the full sweep "
+                 "first, or fix the path)")
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    rows = []
+    if not args.ab_only:
+        # slots sweep at the default chunking, all three families
+        for model in ("llama", "mixtral", "gpt2"):
+            for slots in (1, 2, 4, 8):
+                rows.append(measure_point(model, slots, decode_chunk=8))
+                print(json.dumps(rows[-1]), flush=True)
+        # sync-amortization sweep: K=1 pays one host sync per token
+        for k in (1, 2, 4):
+            rows.append(measure_point("llama", 4, decode_chunk=k))
+            print(json.dumps(rows[-1]), flush=True)
+
+    # telemetry-overhead A/B (ISSUE 2 acceptance): registry on vs off.
+    # The enabled delta is the price of TTFT/ITL histograms + gauges on
+    # every step.
+    _, telemetry_overhead = _ab("telemetry", tracing=False)
+    telemetry_overhead["backend"] = jax.default_backend()
+    telemetry_overhead["note"] = (
+        "best-of-3 ms/decode-step, registry enabled vs disabled on the "
+        "same build; disabled path = no-op metric singletons, no clock "
+        "reads in the decode loop")
+
+    # tracing-overhead A/B (ISSUE 4 acceptance): flight recorder on vs
+    # off, telemetry on in BOTH arms — the enabled delta is the price
+    # of the lifecycle events (one ring append per edge + one per
+    # decode sync).
+    _, tracing_overhead = _ab("tracing")
+    tracing_overhead["backend"] = jax.default_backend()
+    tracing_overhead["note"] = (
+        "best-of-3 ms/decode-step, flight recorder enabled vs disabled "
+        "(telemetry on in both arms); disabled path = shared no-op "
+        "tracer, no clock read, no ring append")
+
+    if args.ab_only and os.path.exists(args.json_out):
+        with open(args.json_out) as f:
+            out = json.load(f)
+    else:
+        out = {
+            "metric": "serving_scheduler_overhead",
+            "backend": jax.default_backend(),
+            "note": ("scheduler_ms_per_step = wall/decode_steps minus "
+                     "pure-jit replay of the engine's compiled decode "
+                     "chunk; host cost is backend-independent, so the "
+                     "CPU rows bound the TPU scheduler overhead"),
+            "rows": rows,
+        }
+    out["telemetry_overhead"] = telemetry_overhead
+    out["tracing_overhead"] = tracing_overhead
     with open(args.json_out, "w") as f:
         json.dump(out, f, indent=1)
     print("→", args.json_out)
