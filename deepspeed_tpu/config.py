@@ -194,6 +194,79 @@ class PrefixCacheConfig:
 
 
 @dataclasses.dataclass
+class SpeculativeConfig:
+    """Speculative decoding block for the paged-KV serving path (ref:
+    speculative sampling, arXiv:2302.01318 / prompt-lookup decoding;
+    the ZeRO-Inference framing of arXiv:2206.01861 is what makes it
+    decisive here — a weight-streamed decode pays one full layer-weight
+    stream PER SWEEP, so scoring K+1 positions in one sweep divides the
+    streamed bytes per generated token by the mean acceptance length).
+
+    Each decode iteration drafts up to ``draft_tokens`` cheap tokens
+    per active slot, scores all K+1 positions in ONE batched
+    continuation forward (the verify pass), keeps the longest accepted
+    prefix plus one bonus/corrected token, and rewinds the KV frontier
+    past the rejected tail.  Outputs are unchanged: greedy acceptance
+    is exact equality against the target argmax, temperature>0 uses
+    point-mass rejection sampling (drafters propose deterministically,
+    so accepting ``d`` with probability ``p(d)`` and otherwise sampling
+    from ``p`` with ``d``'s mass removed reproduces the target
+    distribution exactly).
+
+    ``drafter``: ``ngram`` (zero-weight prompt-lookup over the
+    request's own prompt + generated history) or ``model`` (a resident
+    small draft model — build it explicitly and pass ``drafter=`` to
+    the engine, the config block cannot carry params).  ``max_ngram``/
+    ``min_ngram`` bound the suffix match the ngram drafter searches.
+    """
+
+    enabled: bool = False
+    drafter: str = "ngram"               # ngram | model
+    draft_tokens: int = 4                # K: drafts per verify sweep
+    max_ngram: int = 3                   # longest suffix match tried
+    min_ngram: int = 1                   # shortest suffix match tried
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SpeculativeConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        s = cls(**{k: v for k, v in d.items() if k in known})
+        s.draft_tokens = int(s.draft_tokens)
+        s.max_ngram = int(s.max_ngram)
+        s.min_ngram = int(s.min_ngram)
+        if s.drafter not in ("ngram", "model"):
+            raise ValueError(
+                f"speculative.drafter must be 'ngram' or 'model', got "
+                f"{s.drafter!r}")
+        if s.draft_tokens < 1:
+            raise ValueError(
+                f"speculative.draft_tokens must be >= 1, got "
+                f"{s.draft_tokens}")
+        if not 1 <= s.min_ngram <= s.max_ngram:
+            raise ValueError(
+                f"speculative needs 1 <= min_ngram <= max_ngram, got "
+                f"min_ngram={s.min_ngram} max_ngram={s.max_ngram}")
+        return s
+
+    @classmethod
+    def coerce(cls, obj) -> "SpeculativeConfig":
+        """Accept None (disabled), a bool, a dict (writing the block is
+        the opt-in, like ``zero_inference``), or a SpeculativeConfig."""
+        if obj is None:
+            return cls(enabled=False)
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, bool):
+            return cls(enabled=obj)
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("enabled", True)   # passing a block opts in
+            return cls.from_dict(d)
+        raise TypeError(
+            f"speculative must be a bool, dict or SpeculativeConfig, "
+            f"got {type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class TelemetryConfig:
     """Runtime telemetry block (no single reference analogue — it
     unifies the reference's monitor/comms-logger/flops-profiler
@@ -459,6 +532,8 @@ class Config:
         default_factory=ZeroInferenceConfig)
     prefix_cache: PrefixCacheConfig = dataclasses.field(
         default_factory=PrefixCacheConfig)
+    speculative: SpeculativeConfig = dataclasses.field(
+        default_factory=SpeculativeConfig)
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig)
     tracing: TracingConfig = dataclasses.field(
@@ -570,6 +645,11 @@ class Config:
             # (same contract as zero_inference above); an explicit
             # "enabled": false still disables
             c.prefix_cache = PrefixCacheConfig.coerce(d["prefix_cache"])
+        if "speculative" in d:
+            # coerce, not from_dict: writing the block IS the opt-in
+            # (same contract as zero_inference / prefix_cache above);
+            # an explicit "enabled": false still disables
+            c.speculative = SpeculativeConfig.coerce(d["speculative"])
         if "telemetry" in d:
             c.telemetry = TelemetryConfig.coerce(d["telemetry"])
         if "tracing" in d:

@@ -125,6 +125,14 @@ def init_serving(params, model_config, *, config: Any = None,
     prefill compute, and freed pages stay warm until allocation
     pressure reclaims them (token-identical on/off).
 
+    A ``speculative`` block enables draft-and-verify multi-token
+    decoding (:mod:`deepspeed_tpu.inference.speculative`): each decode
+    iteration drafts up to K cheap tokens per slot, verifies all K+1
+    positions in one batched forward, and keeps the accepted span —
+    greedy outputs token-identical on/off, and under ``zero_inference``
+    one verify sweep amortizes one full layer-weight stream over the
+    whole accepted span.
+
     Remaining ``kw`` (``max_batch``, ``page_size``, ``num_pages``,
     ``decode_chunk``, ``prefill_chunk``, ``weight_dtype``,
     ``prefix_cache``, ``admit_lookahead``, …) pass through to the
@@ -141,6 +149,11 @@ def init_serving(params, model_config, *, config: Any = None,
         # prefix caching in the engine (an explicit prefix_cache= kw
         # still wins)
         kw.setdefault("prefix_cache", config.prefix_cache)
+    if config is not None and config.speculative.enabled:
+        # `speculative` block → draft-and-verify multi-token decode
+        # (an explicit speculative= kw still wins; a model drafter
+        # instance rides the separate drafter= kw)
+        kw.setdefault("speculative", config.speculative)
     if config is not None:
         # `telemetry` config block → the engine's MetricsRegistry (an
         # explicit telemetry= kw still wins)

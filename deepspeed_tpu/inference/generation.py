@@ -170,6 +170,37 @@ def generate_loop(params, prefill, decode, alloc_cache, tokens,
     return jnp.concatenate(out, axis=1)
 
 
+def greedy_draft_fn(step, alloc_cache, window: int, k: int):
+    """One-dispatch greedy rollout for speculative drafting (see
+    :class:`~deepspeed_tpu.inference.speculative.ModelDrafter`): jit of
+    ``(params, tokens [B, window]) -> drafts [B, k]`` — prefill the
+    (left-padded) history window once, then ``lax.scan`` ``k`` argmax
+    decode steps feeding each token forward.  Everything stays on
+    device until the caller fetches the k drafts, so a draft proposal
+    costs one dispatch + one transfer regardless of ``k``.
+
+    Drafts only gate PERFORMANCE (the verify pass re-scores them under
+    the target model), so the fixed window and its padded positions
+    trade draft quality for a single compiled shape — never
+    correctness."""
+
+    def rollout(params, tokens):
+        cache = alloc_cache(tokens.shape[0], window + k)
+        logits, cache = step(params, tokens, cache)
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+        def one(carry, _):
+            tok, c = carry
+            logits, c = step(params, tok[:, None], c)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return (nxt, c), tok
+
+        (_, _), toks = jax.lax.scan(one, (first, cache), None, length=k)
+        return jnp.swapaxes(toks, 0, 1)                   # [B, k]
+
+    return jax.jit(rollout)
+
+
 def cached_step_alloc(forward_with_cache, cfg, cache_dtype=jnp.bfloat16):
     """The (step, alloc_cache) pair over any model's
     ``forward_with_cache(params, tokens, cfg, cache)`` — shared by the

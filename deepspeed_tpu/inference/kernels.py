@@ -206,6 +206,18 @@ class PageAllocator:
         self.published += 1
         return True
 
+    def writable(self, page: int) -> bool:
+        """True when ``page`` may be written in place: exactly one live
+        reference and never published.  A published page's CONTENT is
+        pinned by its content key (a write would poison the index for
+        every future match), and a shared page belongs to other
+        sequences too.  Structurally the engine only ever writes at a
+        sequence's own frontier, which lies past every shared/published
+        page — the speculative verify sweep asserts this invariant on
+        each page its K+1-position write window touches before any
+        rejected-draft garbage can land (the COW-rollback guarantee)."""
+        return self.refs.get(page, 0) == 1 and page not in self.key_of
+
     def release(self, seq_id) -> None:
         """Drop one reference per page owned by ``seq_id``.  Pages
         hitting refcount 0 return to the free list — unless published,

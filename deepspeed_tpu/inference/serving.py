@@ -45,6 +45,24 @@ for all rows at once (per-row temperature, greedy = argmax), the page
 table and seq_lens upload only when the slot composition changed
 (dirty flags), and between composition changes the device-side
 structural ``seq_lens + 1`` of the decode step is simply trusted.
+Prefill-boundary tokens follow the same discipline: every prompt that
+finishes prefilling within a step queues its last-position logits, and
+ONE batched ``_sample_rows`` fetch appends them all — no per-slot
+device round-trip on the admission path.
+
+Speculative decoding (``speculative=`` / the config block): each decode
+iteration drafts up to K cheap tokens per slot (prompt-lookup n-gram by
+default, or a resident small-model drafter), scores all K+1 positions
+in ONE batched continuation forward — the same multi-position program
+split-fuse chunks run — keeps the longest accepted prefix plus a
+bonus/corrected token, and rewinds each slot's KV frontier past the
+rejected tail (the device's structural ``seq_lens + K+1`` is replaced
+by the host's per-slot accepted length on the next dirty upload).
+Greedy outputs are token-identical to speculation off; temperature>0
+uses point-mass rejection sampling so the distribution is unchanged.
+Composes with chunked decode, split-fuse, int8, TP meshes, the prefix
+cache, and the ZeRO-Inference engine — where one verify sweep amortizes
+one full layer-weight stream over the whole accepted span.
 """
 
 from __future__ import annotations
@@ -58,12 +76,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.config import (PrefixCacheConfig, TelemetryConfig,
-                                  TracingConfig)
+from deepspeed_tpu.config import (PrefixCacheConfig, SpeculativeConfig,
+                                  TelemetryConfig, TracingConfig)
 from deepspeed_tpu.inference.kernels import PagedKVCache, PageAllocator
 from deepspeed_tpu.inference.prefix_cache import (extend_page_keys,
                                                   matchable_pages,
                                                   page_keys)
+from deepspeed_tpu.inference.speculative import (build_drafter,
+                                                 verify_accept)
 from deepspeed_tpu.request_trace import RequestTracer
 from deepspeed_tpu.telemetry import (LATENCY_BUCKETS_S, MetricsRegistry,
                                      Span, TelemetryExporter)
@@ -137,7 +157,7 @@ class ServingEngine:
                  decode_chunk: int = 1, prefill_chunk: int = 0,
                  chunk_prefill_fn=None, mesh=None, telemetry=None,
                  prefix_cache=None, admit_lookahead: int = 4,
-                 tracing=None):
+                 tracing=None, speculative=None, drafter=None):
         # Sharded serving (ref: deepspeed/module_inject/replace_module.py
         # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
         # inference): with a mesh, params arrive pre-sharded from the
@@ -215,6 +235,28 @@ class ServingEngine:
         if self.admit_lookahead < 0:
             raise ValueError(
                 f"admit_lookahead must be >= 0, got {admit_lookahead}")
+        # ---- speculative decoding: draft K cheap tokens per slot,
+        # score all K+1 positions in ONE continuation forward, keep the
+        # accepted prefix + a bonus token, rewind the KV frontier past
+        # the rejects.  The verify pass IS the continuation-chunk
+        # program, so it needs the same forward split-fuse does.  When
+        # enabled, the speculative sweep replaces the chunked decode
+        # scan (decode_chunk is accepted and unused — the sweep already
+        # syncs once per up-to-(K+1) tokens).
+        sc = SpeculativeConfig.coerce(speculative)
+        self.speculative = sc
+        self._spec_on = sc.enabled
+        self.drafter = None
+        if self._spec_on:
+            if chunk_prefill_fn is None:
+                raise ValueError(
+                    "speculative decoding needs chunk_prefill_fn — the "
+                    "verify pass scores K+1 positions per slot via the "
+                    "continuation forward (forward_paged(..., "
+                    "continuation=True)), which must return logits at "
+                    "EVERY position")
+            self.drafter = drafter if drafter is not None \
+                else build_drafter(sc)
 
         def put_repl(x):
             x = jnp.asarray(x)
@@ -232,6 +274,10 @@ class ServingEngine:
         self._table_dirty = True
         self._lens_dirty = True
         self.slots: List[Optional[_Slot]] = [None] * max_batch
+        # prefill-boundary sampling queue: (slot, logits row, key, temp)
+        # collected per admission / final prefill chunk, flushed as ONE
+        # batched _sample_rows fetch per step (no per-slot round-trip)
+        self._pending_boundary: List[Tuple[int, Any, Any, float]] = []
         self.queue: "collections.deque[Request]" = collections.deque()
         self._seq_counter = 0
         self._rng = jax.random.PRNGKey(seed)
@@ -305,6 +351,39 @@ class ServingEngine:
             "prefix_cache_cached_token_fraction",
             "cumulative cached / admitted prompt tokens")
         self._evicted_seen = 0
+        self._c_boundary_syncs = r.counter(
+            "serving_boundary_syncs",
+            "batched prefill-boundary sampling syncs (one per step "
+            "with >= 1 prefill completion — replaces one host "
+            "round-trip per admitted slot)")
+        # speculative-decoding metric family (all zero when off)
+        self._c_spec_drafted = r.counter(
+            "spec_drafted_tokens",
+            "draft tokens proposed across verify sweeps")
+        self._c_spec_accepted = r.counter(
+            "spec_accepted_tokens", "draft tokens accepted by verify")
+        self._c_spec_rejected = r.counter(
+            "spec_rejected_tokens",
+            "draft tokens rejected (KV frontier rolled back past them)")
+        self._c_spec_sweeps = r.counter(
+            "spec_verify_sweeps", "batched draft-and-verify sweeps")
+        self._c_spec_slots = r.counter(
+            "spec_verify_slots",
+            "slot-sweeps verified (the denominator of the mean "
+            "acceptance length)")
+        self._c_spec_emitted = r.counter(
+            "spec_emitted_tokens",
+            "tokens emitted by verify sweeps (accepted + bonus, before "
+            "EOS/budget truncation) — divide by spec_verify_slots for "
+            "the mean acceptance length")
+        self._h_spec_len = r.histogram(
+            "spec_accept_length",
+            "tokens emitted per slot per verify sweep (accepted prefix "
+            "+ bonus; 1 = nothing accepted, a plain decode step)",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16))
+        self._g_spec_occ = r.gauge(
+            "spec_verify_occupancy",
+            "fraction of decode slots active in the last verify sweep")
         self._h_ttft = r.histogram(
             "serving_ttft_seconds",
             "submit -> first generated token", LATENCY_BUCKETS_S)
@@ -596,8 +675,10 @@ class ServingEngine:
         # writes only at the frontier) — make them matchable now so
         # concurrent same-prefix requests already hit
         self._publish_full_pages(b, slot, upto=T)
-        # first generated token comes from the REAL last prompt position
-        self._append_token(b, self._sample(logits[0, T - 1], slot))
+        # first generated token comes from the REAL last prompt
+        # position; sampling is deferred into the step's one batched
+        # boundary flush
+        self._queue_boundary(b, logits[0, T - 1], slot)
         return True
 
     def _valid_tokens(self, s: "_Slot") -> int:
@@ -682,7 +763,7 @@ class ServingEngine:
             # prompt pages are full and immutable now — make them
             # matchable before the first token can finish the request
             self._publish_full_pages(b, s, upto=T)
-            self._append_token(b, self._sample(logits[0, take - 1], s))
+            self._queue_boundary(b, logits[0, take - 1], s)
 
     def _preempt_youngest(self) -> None:
         """vLLM-style recompute preemption: release the youngest slot's
@@ -720,13 +801,27 @@ class ServingEngine:
         if req.traced:
             self.tracer.event("requeue", req.req_id)
 
-    def _sample(self, logits_row, slot: _Slot) -> int:
-        from deepspeed_tpu.inference.generation import sample_logits
+    def _queue_boundary(self, b: int, logits_row, slot: _Slot) -> None:
+        """Defer sampling a prefill-boundary token: hold the slot's
+        last-position logits ROW on device and flush every pending row
+        through one batched :func:`_sample_rows` per step — the old
+        path ran ``sample_logits`` + ``int()`` per slot, one device
+        round-trip per admission."""
+        slot.rng, key = jax.random.split(slot.rng)
+        self._pending_boundary.append(
+            (b, logits_row, key, slot.req.temperature))
 
-        slot.rng, r = jax.random.split(slot.rng)
-        tok = sample_logits(logits_row[None], r,
-                            temperature=slot.req.temperature)
-        return int(tok[0])
+    def _flush_boundary(self) -> None:
+        if not self._pending_boundary:
+            return
+        pend, self._pending_boundary = self._pending_boundary, []
+        rows = jnp.stack([p[1] for p in pend])
+        keys = jnp.stack([p[2] for p in pend])
+        temps = np.asarray([p[3] for p in pend], np.float32)
+        toks = np.asarray(_sample_rows(rows, keys, self._put(temps)))
+        self._c_boundary_syncs.inc()
+        for (b, _, _, _), tok in zip(pend, toks):
+            self._append_token(b, int(tok))
 
     def _append_token(self, b: int, tok: int) -> None:
         s = self.slots[b]
@@ -820,12 +915,20 @@ class ServingEngine:
         for b, s in list(enumerate(self.slots)):
             if s is not None and s.prefilling:
                 self._advance_prefill(b, s)
+        # every prompt that finished prefilling this step samples its
+        # boundary token in ONE batched fetch, before the decode phase
+        # reads generated[-1]
+        self._flush_boundary()
         K = self.decode_chunk
+        # the speculative sweep writes K_draft+1 positions per slot —
+        # provision its whole window, like chunked decode does
+        ahead = (self.speculative.draft_tokens + 1 if self._spec_on
+                 else K)
         ready = lambda: [(b, s) for b, s in enumerate(self.slots)
                          if s is not None and not s.prefilling]
         active = ready()
         if active:
-            self._grow_pages(ahead=K)
+            self._grow_pages(ahead=ahead)
             active = ready()
         if self._tel_on:
             self._g_queue.set(len(self.queue))
@@ -845,7 +948,9 @@ class ServingEngine:
                 if pt:
                     self._g_pc_frac.set(
                         self._c_pc_cached_tokens.value / pt)
-        if active:
+        if active and self._spec_on:
+            self._spec_step(active)
+        elif active:
             self._upload_dirty()
             toks = np.zeros((self.max_batch, 1), np.int32)
             temps = np.zeros((self.max_batch,), np.float32)
@@ -877,6 +982,117 @@ class ServingEngine:
                     self._append_token(b, int(host_toks[b, j]))
                     if self.slots[b] is None:   # finished mid-chunk:
                         break                   # rest is discard
+
+    def _check_frontier_writable(self, active, ahead: int) -> None:
+        """COW guard for the speculative write window: every page the
+        verify's ``ahead`` frontier positions can touch must be
+        privately owned (or the trash page).  Structurally always true
+        — shared/published prefix-cache pages live strictly below the
+        frontier — but a write into one would silently poison the
+        content-addressed index for every future match, so the sweep
+        asserts rather than trusts."""
+        ps = self.page_size
+        for b, s in active:
+            last = min((s.seq_len + ahead - 1) // ps,
+                       self.max_pages_per_seq - 1)
+            for slot_idx in range(s.seq_len // ps, last + 1):
+                pg = int(self._table_host[b, slot_idx])
+                if pg != self.trash_page and \
+                        not self.allocator.writable(pg):
+                    raise RuntimeError(
+                        f"speculative verify would write shared/"
+                        f"published page {pg} (slot {b}, table slot "
+                        f"{slot_idx}) — COW invariant violated")
+
+    def _spec_step(self, active) -> None:
+        """One draft-and-verify sweep over every decode-ready slot.
+
+        Draft: the drafter proposes up to K tokens per slot from the
+        request's own history (host-side; ∅ is fine — that row rides
+        the sweep as a plain decode step).  Verify: ONE continuation
+        forward scores all K+1 positions for the whole batch (under
+        ZeRO-Inference this is one full layer-weight stream, amortized
+        over every accepted token), then :func:`~deepspeed_tpu.
+        inference.speculative.verify_accept` computes on device the
+        accepted prefix length and the bonus/corrected token at every
+        stop position — one host transfer per sweep, same discipline
+        as chunked decode.  Rollback: each slot's ``seq_len`` advances
+        by accepted+1 (not the structural K+1 the forward wrote), so
+        rejected drafts' KV is abandoned above the frontier and
+        overwritten by the next sweep; ``_publish_full_pages`` bounds
+        on ``_valid_tokens`` keep rejected garbage out of the prefix
+        cache."""
+        K = self.speculative.draft_tokens
+        Bm = self.max_batch
+        toks = np.zeros((Bm, K + 1), np.int32)
+        drafts = np.zeros((Bm, K), np.int32)
+        dlens = np.zeros((Bm,), np.int32)
+        temps = np.zeros((Bm,), np.float32)
+        drafted = 0
+        for b, s in active:
+            hist = s.req.tokens + s.generated
+            d = list(self.drafter.propose(hist, K))[:K]
+            dlens[b] = len(d)
+            drafts[b, :len(d)] = d
+            toks[b, 0] = hist[-1]
+            toks[b, 1:1 + len(d)] = d
+            temps[b] = s.req.temperature
+            drafted += len(d)
+        self._c_spec_drafted.inc(drafted)
+        traced_any = self._trace_on and any(
+            s.req.traced for _, s in active)
+        if traced_any:
+            self.tracer.event("spec_draft", attrs={
+                "active": len(active), "drafted": drafted})
+        if self._pc_on:
+            self._check_frontier_writable(active, K + 1)
+        self._upload_dirty()
+        self._rng, r = jax.random.split(self._rng)
+        keys = jax.random.split(r, (K + 1) * Bm).reshape(Bm, K + 1, -1)
+        logits, self.cache = self._chunk_prefill(
+            self.params, self._put(toks), self.cache)
+        n_acc_d, stop_d = verify_accept(
+            logits, self._put(drafts), self._put(dlens),
+            self._put(keys), self._put(temps))
+        if traced_any:
+            self.tracer.event("spec_verify", attrs={
+                "active": len(active), "positions": K + 1})
+        n_acc, stop = jax.device_get((n_acc_d, stop_d))  # the ONE sync
+        self._c_decode_syncs.inc()
+        self._c_decode_steps.inc(K + 1)
+        self._c_spec_sweeps.inc()
+        if self._tel_on:
+            self._g_spec_occ.set(len(active) / Bm)
+        rejected = 0
+        for b, s in active:
+            a = int(n_acc[b])
+            rejected += int(dlens[b]) - a
+            self._c_spec_accepted.inc(a)
+            self._c_spec_slots.inc()
+            self._c_spec_emitted.inc(a + 1)
+            self._h_spec_len.observe(a + 1)
+            # KV rollback: the forward wrote K+1 positions and bumped
+            # the device seq_lens structurally; only accepted+1 of them
+            # (the re-fed token + accepted drafts) hold real history
+            s.seq_len += a + 1
+            if s.req.traced:
+                self.tracer.event("spec_accept", s.req.req_id, b,
+                                  attrs={"drafted": int(dlens[b]),
+                                         "accepted": a})
+            for j in range(a):
+                self._append_token(b, int(drafts[b, j]))
+                if self.slots[b] is None:    # finished mid-span:
+                    break                    # rest is discard
+            if self.slots[b] is not None:
+                self._append_token(b, int(stop[b, a]))
+        self._c_spec_rejected.inc(rejected)
+        if rejected and traced_any:
+            self.tracer.event("spec_rollback", attrs={
+                "rejected": rejected})
+        # every row was rewound below the structural seq_lens the
+        # verify left on device — force the re-upload before the next
+        # forward reads them
+        self._lens_dirty = True
 
     def run(self, max_steps: int = 10_000) -> Dict[Any, List[int]]:
         """Drive until every submitted request completes."""
@@ -1139,6 +1355,16 @@ def serving_engine(params, cfg, **kw):
     # engines are fixed-shape batch scorers with no such lifecycle —
     # the block is accepted and unused there, never an error
     kw.pop("tracing", None)
+    sp = kw.pop("speculative", None)
+    kw.pop("drafter", None)
+    if sp is not None and SpeculativeConfig.coerce(sp).enabled:
+        # speculation lives in the paged-KV decode loop; the encoder
+        # engines have no decode loop to speculate — fail loudly,
+        # never silently serve unaccelerated
+        raise NotImplementedError(
+            f"speculative decoding needs the paged-KV decode path, "
+            f"which {type(cfg).__name__} does not serve — supported: "
+            "LlamaConfig, MixtralConfig, GPT2Config")
     pc = kw.pop("prefix_cache", None)
     if pc is not None and PrefixCacheConfig.coerce(pc).enabled:
         # prefix caching lives in the paged-KV decode scheduler; the

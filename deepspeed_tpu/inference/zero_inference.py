@@ -51,7 +51,14 @@ every layer (the serve-anything default).  Composes with:
   warm-pool eviction live in the base scheduler's refcounted allocator
   and page-table bookkeeping, so streamed block programs read shared
   pages through the same per-layer page arrays — a cache-hit admission
-  runs the "chunk" phase over the uncached suffix only.
+  runs the "chunk" phase over the uncached suffix only;
+- speculative decoding (``speculative=``): the verify pass is the
+  SAME host-driven "chunk" executor, so one full layer-weight stream
+  scores K+1 positions per slot and the streamed bytes per generated
+  token drop by the mean acceptance length — the single biggest lever
+  on a decode loop whose throughput is pinned to stream bandwidth
+  (``zi_bytes_uploaded`` / generated tokens is the contract metric;
+  SPEC_BENCH.json carries the A/B).
 """
 
 from __future__ import annotations
@@ -391,6 +398,10 @@ class ZeroInferenceServingEngine(ServingEngine):
         return self._forward_view(phase, toks, view)
 
     def _streamed_chunk_prefill(self, _params, toks, view):
+        # doubles as the speculative VERIFY executor: the scheduler
+        # hands it [B, K+1] draft windows over the full cache, so one
+        # layer-stack sweep (= one full weight stream for the streamed
+        # suffix) scores every position of every active slot
         return self._forward_view("chunk", toks, view)
 
     def _streamed_decode_chunk(self, _params, toks, cache, keys, temps):

@@ -201,7 +201,14 @@ def forward_paged(params, tokens, cfg: GPT2Config, cache,
     :func:`~deepspeed_tpu.inference.kernels.paged_attention_step`; the
     GPT-2 block itself differs (learned positions added at the ragged
     per-row frontier, LayerNorm+bias, fused QKV, GELU MLP, tied head).
-    tokens: [B, T] → (logits [B, T, V] f32, cache)."""
+    tokens: [B, T] → (logits [B, T, V] f32, cache).
+
+    Multi-position decode contract: ``continuation=True`` returns
+    logits at EVERY position (speculative verify scores K+1 draft
+    positions in one call).  Draft positions past the learned table
+    CLAMP into the last wpe row — harmless, because an acceptance at
+    such a position would exceed the request's token budget and the
+    host discards it (the engine bounds real positions by max_seq)."""
     from deepspeed_tpu.inference.kernels import (paged_attention_step,
                                                  paged_forward_prelude,
                                                  pallas_paged_gate)

@@ -403,6 +403,12 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
     non-empty cache): chunked prefill — the chunk's K/V scatter in at
     each row's frontier and attention runs over history + chunk (the
     FastGen split-fuse read path).  tokens: [B, T] → (logits, cache).
+
+    Multi-position decode contract: the continuation path returns
+    logits at EVERY position, not just the last — the serving engine's
+    speculative verify depends on it to score a K+1-token draft window
+    in one sweep (custom ``chunk_prefill_fn`` replacements must honor
+    this; see MIGRATION.md).
     """
     from deepspeed_tpu.inference.kernels import (paged_attention_step,
                                                  pallas_paged_gate)

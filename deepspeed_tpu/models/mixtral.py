@@ -386,7 +386,9 @@ def forward_paged(params, tokens, cfg: MixtralConfig, cache,
     decode/chunk kernels, ragged frontiers) with the capacity-free dense
     top-k expert combine swapped in as the FFN — so every ServingEngine
     feature (split-fuse chunked prefill, K-token decode chunks, paged
-    preemption) works for MoE unchanged.  tokens: [B, T] →
+    preemption, speculative draft-and-verify — the continuation path
+    returns logits at every position, the multi-position contract the
+    verify pass needs) works for MoE unchanged.  tokens: [B, T] →
     (logits [B, T, V] f32, cache)."""
     return _llama.forward_paged(
         params, tokens, cfg.llama_view(), cache, interpret=interpret,

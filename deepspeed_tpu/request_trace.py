@@ -64,10 +64,15 @@ _TRACKS = (
     ("pstream_", "param_stream"),
     ("zi_", "zero_inference"),
     ("tier_", "tier_reader"),
+    ("spec_", "speculative"),
 )
+# NOTE: spec_accept is per-request (rides the request's async span as an
+# instant, with drafted/accepted attrs); the batch-level speculation
+# sweep events (spec_draft / spec_verify / spec_rollback) stay on the
+# "speculative" track via the prefix table above
 _SERVING_PHASES = frozenset((
     "queued", "admitted", "prefill_chunk", "first_token", "decode_batch",
-    "preempt", "requeue", "finish"))
+    "preempt", "requeue", "finish", "spec_accept"))
 
 # every enabled tracer registers here so a postmortem (watchdog
 # timeout, excepthook, SIGUSR1) can dump ALL live recorders without a
@@ -423,6 +428,48 @@ def _pct(vals: List[float], q: float) -> float:
     return s[min(int(q * (len(s) - 1) + 0.5), len(s) - 1)]
 
 
+def speculation_summary(
+        spec: Dict[Any, Dict[str, int]]) -> Optional[Dict[str, Any]]:
+    """Fleet-level speculation totals from per-request ``spec_accept``
+    accumulations (``{req: {sweeps, drafted, accepted}}``) — shared by
+    :func:`request_breakdown` and ``tools/trace_report.py``'s Chrome
+    ingestion.  ``mean_accept_len`` is tokens emitted per verify sweep
+    (accepted prefix + the bonus token): the factor by which one model
+    sweep — and, under ZeRO-Inference, one full weight stream — was
+    amortized."""
+    if not spec:
+        return None
+    sweeps = sum(r["sweeps"] for r in spec.values())
+    drafted = sum(r["drafted"] for r in spec.values())
+    accepted = sum(r["accepted"] for r in spec.values())
+    return {
+        "sweeps": sweeps,
+        "drafted_tokens": drafted,
+        "accepted_tokens": accepted,
+        "rejected_tokens": drafted - accepted,
+        "mean_accept_len": round((accepted + sweeps) / sweeps, 4),
+    }
+
+
+def attach_speculation(per: Dict[Any, Dict[str, float]],
+                       spec: Dict[Any, Dict[str, int]]) -> None:
+    """Fold per-request speculation accumulations into the waterfall
+    rows (``spec_sweeps``/``spec_drafted``/``spec_accepted`` plus the
+    per-request ``spec_mean_accept_len``).  Requests with spec instants
+    but no surviving lifecycle edges (ring overflow evicted them) are
+    skipped — an all-zero waterfall row would inflate the request count;
+    their sweeps still count in :func:`speculation_summary`."""
+    for req, srec in spec.items():
+        row = per.get(req)
+        if row is None:
+            continue
+        row["spec_sweeps"] = srec["sweeps"]
+        row["spec_drafted"] = srec["drafted"]
+        row["spec_accepted"] = srec["accepted"]
+        row["spec_mean_accept_len"] = round(
+            (srec["accepted"] + srec["sweeps"]) / srec["sweeps"], 4)
+
+
 def summarize_components(per: Dict[Any, Dict[str, float]],
                          stall_s: float = 0.0) -> Dict[str, Any]:
     """p50/p95/mean summary over per-request component rows — the one
@@ -449,13 +496,24 @@ def request_breakdown(events: List[Event]) -> Dict[str, Any]:
     token, ``decode`` = first token→finish, ``ttft`` = queued→first
     token, ``total`` = queued→finish; ``stream_stall_s`` totals every
     ``*_stall`` event's blocked seconds (the exposed — non-hidden — IO
-    cost under the same window)."""
+    cost under the same window).  Traced speculation (``spec_accept``
+    per sweep) folds into per-request acceptance columns and a
+    fleet-level ``summary.speculation`` block, attributing the decode
+    span to amortized verify sweeps."""
     edges: Dict[Any, Dict[str, int]] = {}
+    spec: Dict[Any, Dict[str, int]] = {}
     stall_s = 0.0
     for t, req, slot, phase, attrs in events:
         if phase.endswith("_stall") and attrs:
             stall_s += float(attrs.get("wait_s", 0.0))
         if req is None or phase not in _SERVING_PHASES:
+            continue
+        if phase == "spec_accept":
+            srec = spec.setdefault(
+                req, {"sweeps": 0, "drafted": 0, "accepted": 0})
+            srec["sweeps"] += 1
+            srec["drafted"] += int((attrs or {}).get("drafted", 0))
+            srec["accepted"] += int((attrs or {}).get("accepted", 0))
             continue
         r = edges.setdefault(req, {})
         if phase == "finish":
@@ -479,7 +537,12 @@ def request_breakdown(events: List[Event]) -> Dict[str, Any]:
             row["total_s"] = (fin - q) / 1e9
         if row:
             per[req] = row
-    return {"requests": per, "summary": summarize_components(per, stall_s)}
+    attach_speculation(per, spec)
+    summary = summarize_components(per, stall_s)
+    sp = speculation_summary(spec)
+    if sp:
+        summary["speculation"] = sp
+    return {"requests": per, "summary": summary}
 
 
 # ------------------------------------------------------------- postmortem

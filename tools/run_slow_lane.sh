@@ -37,6 +37,21 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_serving.py --cpu \
   --prefix-cache --requests 32 --new-tokens 16 \
   --json-out "$REPO/PREFIX_BENCH.json" >/dev/null 2>&1 || true
 
+# speculative-decoding A/B: the repetitive-motif workload served with
+# speculation off vs on, plus the ZeRO-Inference streamed pair whose
+# rows record weight bytes streamed per generated token — stamps
+# SPEC_BENCH.json, best-effort like the samples above.  --cpu-dim 512
+# scales the smoke model past cache-resident (~28 MB bf16) so decode
+# pays real weight reads — the bandwidth-bound regime speculation
+# amortizes (the 64-dim toy is dispatch-bound and can't show it)
+# requests > slots keeps the batch backfilled: per-slot acceptance
+# variance otherwise leaves a low-occupancy straggler tail that still
+# pays one full weight sweep per verify
+timeout -k 10 900 env JAX_PLATFORMS=cpu python bench_serving.py --cpu \
+  --speculative --zero-inference --slots 4 --requests 12 \
+  --new-tokens 96 --cpu-dim 512 --cpu-layers 4 --repeats 2 \
+  --json-out "$REPO/SPEC_BENCH.json" >/dev/null 2>&1 || true
+
 # trace selftest: a short traced serving workload, Chrome-export
 # validation (matched async spans, monotonic ts) + the trace-vs-
 # telemetry TTFT cross-check, stamped into TRACE_SAMPLE.json —

@@ -52,13 +52,15 @@ def main():
     params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
     prompt_len = 24
     max_seq = prompt_len + args.new_tokens
-    # prefix caching on: the sample registry carries a LIVE
-    # prefix_cache_* metric family (shared-prefix traffic below
-    # produces real hits, published pages and pool occupancy)
+    # prefix caching + speculation on: the sample registry carries LIVE
+    # prefix_cache_* AND spec_* metric families (shared-prefix traffic
+    # below produces real hits; the repetitive histories greedy decode
+    # settles into give the ngram drafter real acceptances)
     eng = serving_engine(
         params, cfg, max_batch=4, page_size=8,
         num_pages=4 * (-(-max_seq // 8)) + 16, max_seq=max_seq,
-        prefill_bucket=8, decode_chunk=4, prefix_cache=True)
+        prefill_bucket=8, decode_chunk=4, prefix_cache=True,
+        speculative={"draft_tokens": 4})
 
     rng = np.random.default_rng(0)
     prefix = rng.integers(1, cfg.vocab_size, prompt_len - 4).tolist()

@@ -42,8 +42,19 @@ def breakdown_from_chrome(trace: dict) -> dict:
     matching the JSONL path, which only measures observed edges."""
     spans = {}   # (id, name) -> [begin_ts, end_ts] in us
     truncated = set()
+    spec = {}    # id -> {sweeps, drafted, accepted} from spec_accept
     for ev in trace.get("traceEvents", []):
-        if ev.get("cat") != "request" or ev.get("ph") not in ("b", "e"):
+        if ev.get("cat") != "request":
+            continue
+        if ev.get("ph") == "n" and ev.get("name") == "spec_accept":
+            args = ev.get("args") or {}
+            rec = spec.setdefault(
+                ev["id"], {"sweeps": 0, "drafted": 0, "accepted": 0})
+            rec["sweeps"] += 1
+            rec["drafted"] += int(args.get("drafted", 0))
+            rec["accepted"] += int(args.get("accepted", 0))
+            continue
+        if ev.get("ph") not in ("b", "e"):
             continue
         if (ev.get("args") or {}).get("truncated"):
             truncated.add(ev["id"])
@@ -72,9 +83,17 @@ def breakdown_from_chrome(trace: dict) -> dict:
                 for ev in trace.get("traceEvents", [])
                 if ev.get("ph") == "X"
                 and str(ev.get("name", "")).endswith("_stall"))
-    from deepspeed_tpu.request_trace import summarize_components
+    from deepspeed_tpu.request_trace import (attach_speculation,
+                                             speculation_summary,
+                                             summarize_components)
 
+    spec = {rid: rec for rid, rec in spec.items()
+            if rid not in truncated}
+    attach_speculation(per, spec)
     summary = summarize_components(per, stall)
+    sp = speculation_summary(spec)
+    if sp:
+        summary["speculation"] = sp
     if truncated:
         summary["truncated_requests"] = sorted(str(r) for r in truncated)
     return {"requests": per, "summary": summary}
@@ -105,10 +124,13 @@ def print_report(bd: dict, limit: int = 20) -> None:
                              ("decode_s", "=")):
                 bar += ch * max(int(width * row.get(comp, 0.0) / total),
                                 1 if row.get(comp, 0.0) > 0 else 0)
+        spec = (f"  spec×{row['spec_sweeps']} "
+                f"len={row['spec_mean_accept_len']:.2f}"
+                if row.get("spec_sweeps") else "")
         print(f"{str(req)[:12]:>12} | {ms(row.get('queue_wait_s', 0)):>9} | "
               f"{ms(row.get('prefill_s', 0)):>10} | "
               f"{ms(row.get('decode_s', 0)):>9} | "
-              f"{ms(row.get('total_s', 0)):>9}  {bar}")
+              f"{ms(row.get('total_s', 0)):>9}  {bar}{spec}")
     if len(per) > len(shown):
         print(f"... {len(per) - len(shown)} more requests")
     print("\ncritical path (seconds):")
@@ -119,6 +141,17 @@ def print_report(bd: dict, limit: int = 20) -> None:
             print(f"  {comp:<13} p50={c['p50']:.4f}  p95={c['p95']:.4f}  "
                   f"mean={c['mean']:.4f}  (n={c['n']})")
     print(f"  stream_stall_s total={summary['stream_stall_s']:.4f}")
+    sp = summary.get("speculation")
+    if sp:
+        # decode-time attribution: each verify sweep is one model sweep
+        # (one full weight stream under ZeRO-Inference) amortized over
+        # mean_accept_len emitted tokens
+        print(f"  speculation: {sp['sweeps']} verify sweeps, "
+              f"{sp['drafted_tokens']} drafted / "
+              f"{sp['accepted_tokens']} accepted "
+              f"({sp['rejected_tokens']} rolled back), "
+              f"mean accept len {sp['mean_accept_len']:.2f} "
+              f"tokens/sweep")
     if summary.get("truncated_requests"):
         print(f"  still in flight at export (excluded from stats): "
               f"{', '.join(summary['truncated_requests'])}")
@@ -162,10 +195,14 @@ def selftest(args) -> int:
     params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
     prompt_len = 24
     max_seq = prompt_len + args.new_tokens
+    # speculation on: the stamped sample demonstrates draft/verify/
+    # rollback attribution (spec_accept instants inside request spans,
+    # sweep events on the speculative track, summary.speculation)
     eng = serving_engine(
         params, cfg, max_batch=4, page_size=8,
         num_pages=4 * (-(-max_seq // 8)) + 16, max_seq=max_seq,
         prefill_bucket=8, decode_chunk=4, prefix_cache=True,
+        speculative={"draft_tokens": 4},
         tracing={"sample_rate": 1.0})
 
     rng = np.random.default_rng(0)
