@@ -161,6 +161,20 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
         config["prefix_cache"] = prefix_cache
     if speculative is not None:
         config["speculative"] = speculative
+    # SLO classification rides every row (--slo-ttft-ms 0 disables):
+    # the same engine that reports tokens/s reports how many of those
+    # tokens came from requests that met their latency objective —
+    # goodput next to throughput, so an A/B win that only moved
+    # throughput is visible as such
+    objective = {}
+    if args.slo_ttft_ms > 0:
+        objective["ttft_s"] = args.slo_ttft_ms / 1000.0
+    if args.slo_itl_ms > 0:
+        objective["itl_s"] = args.slo_itl_ms / 1000.0
+    if args.slo_deadline_ms > 0:
+        objective["deadline_s"] = args.slo_deadline_ms / 1000.0
+    if objective:
+        config["slo"] = {"tiers": {"default": objective}}
     # prefix rows absorb a cache-hit's uncached suffix in
     # prefill_bucket-token continuation chunks — a page-sized bucket
     # (vs the whole padded prompt) is what turns the skipped prefix
@@ -270,6 +284,27 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
         # counter delta over the TIMED traffic only (warmup delta'd away)
         return int(cnt.get(key, 0)) - int(cnt0.get(key, 0))
 
+    if objective:
+        fin = delta("slo_default_attained_requests") + \
+            delta("slo_default_violated_requests")
+        good = delta("slo_default_goodput_tokens")
+        row["detail"]["slo"] = {
+            "tier": "default",
+            "objective": objective,
+            "finished": fin,
+            "attained": delta("slo_default_attained_requests"),
+            "attainment": (round(
+                delta("slo_default_attained_requests") / fin, 4)
+                if fin else 1.0),
+            "ttft_violations": delta("slo_default_ttft_violations"),
+            "itl_violations": delta("slo_default_itl_violations"),
+            "deadline_violations": delta(
+                "slo_default_deadline_violations"),
+            # tokens from SLO-attained requests over the same wall the
+            # tokens/s headline uses: goodput next to throughput
+            "goodput_tokens_per_s": (round(good / dt, 1)
+                                     if dt > 0 else 0.0),
+        }
     if args.speculative:
         slots = delta("spec_verify_slots")
         emitted = delta("spec_emitted_tokens")
@@ -376,6 +411,15 @@ def main():
                          "A/Bs (--speculative) measure the right regime")
     ap.add_argument("--cpu-layers", type=int, default=0,
                     help="scale the --cpu smoke model's depth (0 = 2)")
+    ap.add_argument("--slo-ttft-ms", type=float, default=5000.0,
+                    help="SLO TTFT objective for the default tier; "
+                         "rows then record attainment + goodput next "
+                         "to tokens/s (0 disables the slo block)")
+    ap.add_argument("--slo-itl-ms", type=float, default=0.0,
+                    help="SLO worst inter-token-gap objective (0 = "
+                         "unset)")
+    ap.add_argument("--slo-deadline-ms", type=float, default=0.0,
+                    help="SLO end-to-end deadline (0 = unset)")
     ap.add_argument("--repeats", type=int, default=1,
                     help="measure each config N times and keep the best "
                          "row (tokens/s) — rides out scheduler noise on "
@@ -463,6 +507,16 @@ def main():
         out["spec_ab"] = {
             "tokens_per_s_off": off["value"],
             "tokens_per_s_on": on["value"],
+            # did the throughput win also move goodput? (None when the
+            # slo block was disabled via --slo-ttft-ms 0)
+            "goodput_off": off["detail"].get(
+                "slo", {}).get("goodput_tokens_per_s"),
+            "goodput_on": on["detail"].get(
+                "slo", {}).get("goodput_tokens_per_s"),
+            "attainment_off": off["detail"].get(
+                "slo", {}).get("attainment"),
+            "attainment_on": on["detail"].get(
+                "slo", {}).get("attainment"),
             "speedup": (round(on["value"] / off["value"], 3)
                         if off["value"] else None),
             "ttft_off_ms": off["detail"].get("ttft_ms"),
@@ -500,6 +554,12 @@ def main():
             "tokens_per_s_off": out["rows"][0]["value"],
             "tokens_per_s_on": out["rows"][1]["value"],
             "hit_rate": on_d["prefix_cache"]["hit_rate"],
+            "goodput_off": off_d.get("slo", {}).get(
+                "goodput_tokens_per_s"),
+            "goodput_on": on_d.get("slo", {}).get(
+                "goodput_tokens_per_s"),
+            "attainment_off": off_d.get("slo", {}).get("attainment"),
+            "attainment_on": on_d.get("slo", {}).get("attainment"),
         }
     commit(out, args.json_out)
 

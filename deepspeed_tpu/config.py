@@ -267,6 +267,125 @@ class SpeculativeConfig:
 
 
 @dataclasses.dataclass
+class SLOTierObjective:
+    """One tier's latency objectives (all optional — an unset objective
+    never violates).  ``ttft_s``: submit → first token; ``itl_s``: the
+    WORST inter-token gap a client of this request observed (chunked
+    decode delivers bursts, so the sync-interval gap is what this
+    bounds); ``deadline_s``: submit → finish.  ``target`` is the
+    attainment objective (the fraction of requests that must meet every
+    set objective — the SLO proper); the burn rate divides the observed
+    violation rate by the error budget ``1 - target``."""
+
+    ttft_s: Optional[float] = None
+    itl_s: Optional[float] = None
+    deadline_s: Optional[float] = None
+    target: float = 0.99
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SLOTierObjective":
+        known = {f.name for f in dataclasses.fields(cls)}
+        t = cls(**{k: v for k, v in d.items() if k in known})
+        for name in ("ttft_s", "itl_s", "deadline_s"):
+            v = getattr(t, name)
+            if v is not None:
+                v = float(v)
+                setattr(t, name, v)
+                if v <= 0:
+                    raise ValueError(
+                        f"slo tier objective {name} must be positive or "
+                        f"null, got {v}")
+        t.target = float(t.target)
+        if not 0.0 < t.target <= 1.0:
+            raise ValueError(
+                f"slo tier target must be in (0, 1], got {t.target}")
+        return t
+
+
+@dataclasses.dataclass
+class SLOConfig:
+    """Per-tier serving SLO block (the control-plane contract the
+    multi-replica router routes on; same stall-attribution motivation
+    as the ZeRO-Infinity tiering papers, arXiv:2104.07857 /
+    arXiv:2101.06840 — a stream stall that silently eats a TTFT budget
+    must surface as a violated objective, not folklore).
+
+    ``tiers`` maps tier name → :class:`SLOTierObjective`; ``submit``
+    callers pick a tier per request (unset → ``default_tier``).  Every
+    request is classified attained/violated at finish; the tracker
+    keeps a ``window_s`` rolling attainment + goodput (tokens/s counted
+    ONLY for attained requests) and one burn-rate gauge per entry of
+    ``burn_windows_s``.  When the burn rate exceeds
+    ``burn_threshold`` in EVERY window simultaneously (the standard
+    multiwindow alert — fast windows catch the spike, slow windows
+    suppress flapping), the alert hook fires a structured
+    ``slo_burn_alert`` event into the flight recorder."""
+
+    enabled: bool = False
+    tiers: Dict[str, SLOTierObjective] = dataclasses.field(
+        default_factory=dict)
+    default_tier: str = "default"
+    window_s: float = 60.0
+    burn_windows_s: tuple = (60.0, 300.0)
+    burn_threshold: float = 2.0
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SLOConfig":
+        d = dict(d)
+        tiers = {name: (t if isinstance(t, SLOTierObjective)
+                        else SLOTierObjective.from_dict(t))
+                 for name, t in d.pop("tiers", {}).items()}
+        known = {f.name for f in dataclasses.fields(cls)}
+        s = cls(**{k: v for k, v in d.items() if k in known and
+                   k != "tiers"}, tiers=tiers)
+        if not s.tiers:
+            # a bare {"enabled": true} block still tracks: one default
+            # tier with no objectives (everything attains — the
+            # goodput == throughput baseline)
+            s.tiers = {s.default_tier: SLOTierObjective()}
+        if s.default_tier not in s.tiers:
+            raise ValueError(
+                f"slo.default_tier {s.default_tier!r} not in tiers "
+                f"{sorted(s.tiers)}")
+        s.window_s = float(s.window_s)
+        if s.window_s <= 0:
+            raise ValueError(
+                f"slo.window_s must be positive, got {s.window_s}")
+        s.burn_windows_s = tuple(float(w) for w in s.burn_windows_s)
+        if not s.burn_windows_s or any(w <= 0 for w in s.burn_windows_s):
+            raise ValueError(
+                f"slo.burn_windows_s must be non-empty positive, got "
+                f"{s.burn_windows_s}")
+        s.burn_threshold = float(s.burn_threshold)
+        if s.burn_threshold <= 0:
+            raise ValueError(
+                f"slo.burn_threshold must be positive, got "
+                f"{s.burn_threshold}")
+        return s
+
+    @classmethod
+    def coerce(cls, obj) -> "SLOConfig":
+        """Accept None (disabled), a bool, a dict (writing the block is
+        the opt-in, like ``prefix_cache``), or an SLOConfig."""
+        if obj is None:
+            return cls(enabled=False)
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, bool):
+            return cls.from_dict({"enabled": obj}) if obj \
+                else cls(enabled=False)
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("enabled", True)   # passing a block opts in
+            if not d["enabled"]:
+                return cls(enabled=False)
+            return cls.from_dict(d)
+        raise TypeError(
+            f"slo must be a bool, dict or SLOConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class TelemetryConfig:
     """Runtime telemetry block (no single reference analogue — it
     unifies the reference's monitor/comms-logger/flops-profiler
@@ -534,6 +653,7 @@ class Config:
         default_factory=PrefixCacheConfig)
     speculative: SpeculativeConfig = dataclasses.field(
         default_factory=SpeculativeConfig)
+    slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig)
     tracing: TracingConfig = dataclasses.field(
@@ -650,6 +770,11 @@ class Config:
             # (same contract as zero_inference / prefix_cache above);
             # an explicit "enabled": false still disables
             c.speculative = SpeculativeConfig.coerce(d["speculative"])
+        if "slo" in d:
+            # coerce, not from_dict: writing the block IS the opt-in
+            # (same contract as prefix_cache / speculative above); an
+            # explicit "enabled": false still disables
+            c.slo = SLOConfig.coerce(d["slo"])
         if "telemetry" in d:
             c.telemetry = TelemetryConfig.coerce(d["telemetry"])
         if "tracing" in d:

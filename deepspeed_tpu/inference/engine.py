@@ -154,6 +154,11 @@ def init_serving(params, model_config, *, config: Any = None,
         # (an explicit speculative= kw still wins; a model drafter
         # instance rides the separate drafter= kw)
         kw.setdefault("speculative", config.speculative)
+    if config is not None and config.slo.enabled:
+        # `slo` block → per-tier SLO classification, burn-rate alerts
+        # and goodput accounting on the engine's registry (an explicit
+        # slo= kw still wins)
+        kw.setdefault("slo", config.slo)
     if config is not None:
         # `telemetry` config block → the engine's MetricsRegistry (an
         # explicit telemetry= kw still wins)

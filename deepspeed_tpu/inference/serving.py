@@ -70,21 +70,24 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
+import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.config import (PrefixCacheConfig, SpeculativeConfig,
-                                  TelemetryConfig, TracingConfig)
+from deepspeed_tpu.config import (PrefixCacheConfig, SLOConfig,
+                                  SpeculativeConfig, TelemetryConfig,
+                                  TracingConfig)
 from deepspeed_tpu.inference.kernels import PagedKVCache, PageAllocator
 from deepspeed_tpu.inference.prefix_cache import (extend_page_keys,
                                                   matchable_pages,
                                                   page_keys)
 from deepspeed_tpu.inference.speculative import (build_drafter,
                                                  verify_accept)
-from deepspeed_tpu.request_trace import RequestTracer
+from deepspeed_tpu.request_trace import RequestTracer, event_to_dict
+from deepspeed_tpu.slo import NULL_SLO_TRACKER, SLOTracker
 from deepspeed_tpu.telemetry import (LATENCY_BUCKETS_S, MetricsRegistry,
                                      Span, TelemetryExporter)
 from deepspeed_tpu.utils.logging import logger
@@ -101,6 +104,17 @@ def _sample_rows(logits: jnp.ndarray, keys: jnp.ndarray,
     scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return jnp.where(temps == 0.0, greedy, sampled.astype(jnp.int32))
+
+
+# one-shot flag for the ServingEngine.stats deprecation warning (the
+# shim is read in loops; warning per read would drown real output)
+_stats_shim_warned = False
+
+
+def _req_key(req_id: Any) -> str:
+    """Canonical string form of a request id — the /requestz?id= query
+    arrives as text, so matching happens in string space."""
+    return str(req_id)
 
 
 @dataclasses.dataclass
@@ -122,6 +136,11 @@ class Request:
     # carries both so a recompute never re-emits first_token)
     traced: bool = False
     first_token_seen: bool = False
+    # introspection/SLO state: wall-clock arrival (never cleared —
+    # unlike t_submit — so /statusz ages and the SLO deadline survive
+    # the first token AND a preemption requeue) and the SLO tier
+    t_arrival: float = 0.0
+    tier: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -157,7 +176,8 @@ class ServingEngine:
                  decode_chunk: int = 1, prefill_chunk: int = 0,
                  chunk_prefill_fn=None, mesh=None, telemetry=None,
                  prefix_cache=None, admit_lookahead: int = 4,
-                 tracing=None, speculative=None, drafter=None):
+                 tracing=None, speculative=None, drafter=None,
+                 slo=None):
         # Sharded serving (ref: deepspeed/module_inject/replace_module.py
         # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
         # inference): with a mesh, params arrive pre-sharded from the
@@ -422,12 +442,52 @@ class ServingEngine:
                 TracingConfig.coerce(tracing))
         self._trace_on = self.tracer.enabled
 
+        # ---- SLO & goodput accounting (the control-plane contract the
+        # multi-replica router will route on): requests carry a tier,
+        # are classified attained/violated at finish, and the tracker
+        # keeps rolling attainment, multiwindow burn rates, and goodput
+        # (attained-request tokens/s) live in the registry.  Burn-rate
+        # trips fire structured slo_burn_alert events into the flight
+        # recorder.  perf_counter clock: every timestamp the tracker
+        # sees (shared `now` reads from the token path) is on it.
+        self.slo_cfg = SLOConfig.coerce(slo)
+        self.slo_tracker = (
+            SLOTracker(self.slo_cfg, self.registry, tracer=self.tracer,
+                       clock=time.perf_counter)
+            if self.slo_cfg.enabled else NULL_SLO_TRACKER)
+        self._slo_on = self.slo_tracker.enabled
+
+        # ---- introspection: /statusz (live engine snapshot),
+        # /healthz (liveness/readiness, watchdog-fed), /requestz?id=
+        # (one request's ring events) ride the telemetry HTTP server
+        self._t_start = time.perf_counter()
+        self._last_step_t: Optional[float] = None
+        self._watchdog = None
+        self._closed = False
+        if self._tel_exporter is not None:
+            self._tel_exporter.register_provider("statusz", self.statusz)
+            self._tel_exporter.register_provider("healthz", self.healthz)
+            self._tel_exporter.register_provider("requestz",
+                                                 self.requestz)
+
     @property
     def stats(self) -> Dict[str, Any]:
         """Deprecation shim over the registry — prefer
         ``engine.registry.snapshot()``.  With telemetry disabled the
         counters are no-ops, so this returns zeros (disabling telemetry
-        is the explicit opt-out of scheduler accounting)."""
+        is the explicit opt-out of scheduler accounting).
+
+        Deprecated since PR 6; scheduled for removal in PR 9.  Warns
+        once per process (every reader named here has migrated —
+        bench_serving, tools, examples — so a warning means new code)."""
+        global _stats_shim_warned
+        if not _stats_shim_warned:
+            _stats_shim_warned = True
+            warnings.warn(
+                "ServingEngine.stats is a deprecated read-only shim; "
+                "read engine.registry.snapshot() instead.  The shim "
+                "will be removed in PR 9.",
+                DeprecationWarning, stacklevel=2)
         pt = int(self._c_pc_prompt_tokens.value)
         return {
             "admitted": int(self._c_admitted.value),
@@ -498,7 +558,12 @@ class ServingEngine:
 
     # ------------------------------------------------------------- requests
     def submit(self, req_id, tokens, max_new_tokens: int = 32,
-               temperature: float = 0.0) -> None:
+               temperature: float = 0.0,
+               tier: Optional[str] = None) -> None:
+        """Queue a request.  ``tier`` names an SLO tier from the
+        ``slo`` config block (None → the block's default tier); naming
+        a tier with the block disabled raises rather than silently
+        dropping the latency objective."""
         tokens = list(map(int, tokens))
         if not tokens:
             raise ValueError(f"request {req_id}: empty prompt")
@@ -514,10 +579,15 @@ class ServingEngine:
                 f"length but the pool has {usable} — it could never "
                 "complete even alone")
         traced = self._trace_on and self.tracer.sampled(req_id)
+        now = time.perf_counter()
+        if self._slo_on or tier is not None:
+            # BEFORE the queue append: an unknown tier must reject the
+            # request, not classify it later under a KeyError
+            self.slo_tracker.on_submit(req_id, tier, now=now)
         self.queue.append(Request(
             req_id, tokens, max_new_tokens, temperature,
-            t_submit=time.perf_counter() if self._tel_on else None,
-            traced=traced))
+            t_submit=now if self._tel_on else None,
+            traced=traced, t_arrival=now, tier=tier))
         self._g_queue.set(len(self.queue))
         if traced:
             self.tracer.event("queued", req_id, attrs={
@@ -792,11 +862,15 @@ class ServingEngine:
         # requeue prompt+generated for recompute; the finished output is
         # simply tokens+generated of the FINAL incarnation, which already
         # contains everything produced before preemption
+        # NOT re-announced to the SLO tracker: its record (and with it
+        # the original arrival time) survives under the same req_id, so
+        # the recompute is judged against the user's real clock
         self.queue.appendleft(Request(
             req.req_id, req.tokens + s.generated,
             req.max_new_tokens - len(s.generated), req.temperature,
             t_submit=req.t_submit, page_keys=req.page_keys,
-            traced=req.traced, first_token_seen=req.first_token_seen))
+            traced=req.traced, first_token_seen=req.first_token_seen,
+            t_arrival=req.t_arrival, tier=req.tier))
         self._c_preempted.inc()
         if req.traced:
             self.tracer.event("requeue", req.req_id)
@@ -826,14 +900,20 @@ class ServingEngine:
     def _append_token(self, b: int, tok: int) -> None:
         s = self.slots[b]
         s.generated.append(tok)
-        if self._tel_on:
+        if self._tel_on or self._slo_on:
+            # ONE clock read shared by the TTFT/ITL histograms and the
+            # SLO tracker — the slo-on-top-of-telemetry cost is a dict
+            # hit, not a second perf_counter
             now = time.perf_counter()
-            if s.req.t_submit is not None:
-                self._h_ttft.observe(now - s.req.t_submit)
-                s.req.t_submit = None      # once per request lifetime
-            elif s.last_tok_t:
-                self._h_itl.observe(now - s.last_tok_t)
-            s.last_tok_t = now
+            if self._tel_on:
+                if s.req.t_submit is not None:
+                    self._h_ttft.observe(now - s.req.t_submit)
+                    s.req.t_submit = None  # once per request lifetime
+                elif s.last_tok_t:
+                    self._h_itl.observe(now - s.last_tok_t)
+                s.last_tok_t = now
+            if self._slo_on:
+                self.slo_tracker.on_token(s.req.req_id, now=now)
         if s.req.traced and not s.req.first_token_seen:
             # adjacent to the TTFT observation above so the trace's
             # queued→first_token delta agrees with the histogram
@@ -844,6 +924,11 @@ class ServingEngine:
         if done:
             self.finished[s.req.req_id] = list(s.req.tokens) + s.generated
             self._newly_finished.append(s.req.req_id)
+            if self._slo_on:
+                # classify against the tier objectives NOW: attainment,
+                # burn rates and goodput update; a burn trip fires the
+                # alert into the flight recorder
+                self.slo_tracker.on_finish(s.req.req_id)
             if s.req.traced:
                 self.tracer.event("finish", s.req.req_id, b, attrs={
                     "generated": len(s.generated),
@@ -895,6 +980,7 @@ class ServingEngine:
         """One scheduling iteration: admit → batched decode.  Returns
         request ids that finished during this step."""
         self._newly_finished = []
+        self._last_step_t = time.perf_counter()   # /healthz heartbeat
         if self._tel_on:
             # span: wall time into serving_step_seconds + a
             # TraceAnnotation so captured device timelines show the
@@ -905,6 +991,11 @@ class ServingEngine:
                 self._tel_exporter.maybe_export()
         else:
             self._step_inner()
+        if self._slo_on:
+            # time-driven window refresh (rate-limited to ~1/s inside):
+            # an idle engine's burn gauges must decay as violations age
+            # out, not stay latched at their last finish-time values
+            self.slo_tracker.maybe_refresh()
         return list(self._newly_finished)
 
     def _step_inner(self) -> None:
@@ -1109,6 +1200,199 @@ class ServingEngine:
         call this instead of letting ``finished`` grow unboundedly)."""
         out, self.finished = self.finished, {}
         return out
+
+    # --------------------------------------------------- introspection
+    # (/statusz, /healthz and /requestz providers — registered on the
+    # telemetry HTTP server when the config block carries http_port;
+    # all three are also plain methods a fleet supervisor or test can
+    # call in-process)
+    def attach_watchdog(self, watchdog) -> None:
+        """Feed ``/healthz`` from a :class:`~deepspeed_tpu.utils.
+        watchdog.Watchdog`: readiness goes false the moment the
+        watchdog fires, so a fleet probe drains traffic off a hung
+        engine before the abort lands."""
+        self._watchdog = watchdog
+
+    def statusz(self) -> Dict[str, Any]:
+        """Live machine-readable engine snapshot: per-slot state,
+        in-flight requests with phase and age, KV/prefix-cache pool
+        occupancy and fragmentation, speculation acceptance, SLO
+        attainment per tier, and the full metrics snapshot.  Assembled
+        from host-side bookkeeping only — no device sync, safe to poll
+        every second (``tools/dstpu_top.py`` does)."""
+        now = time.perf_counter()
+        slots: List[Dict[str, Any]] = []
+        mapped_capacity = 0
+        valid_tokens = 0
+        for b, s in enumerate(self.slots):
+            if s is None:
+                slots.append({"slot": b, "state": "idle"})
+                continue
+            pages = int(np.sum(self._table_host[b] != self.trash_page))
+            mapped_capacity += pages * self.page_size
+            valid_tokens += self._valid_tokens(s)
+            row: Dict[str, Any] = {
+                "slot": b,
+                "state": "prefill" if s.prefilling else "decode",
+                "req": _req_key(s.req.req_id),
+                "tier": s.req.tier,
+                "prompt_tokens": len(s.req.tokens),
+                "generated": len(s.generated),
+                "max_new_tokens": s.req.max_new_tokens,
+                "seq_len": s.seq_len,
+                "pages": pages,
+                "age_s": round(now - s.req.t_arrival, 3),
+            }
+            if s.prefilling:
+                row["prefill_done"] = s.prefill_done
+            slots.append(row)
+        queue = [{"req": _req_key(r.req_id), "tier": r.tier,
+                  "prompt_tokens": len(r.tokens),
+                  "age_s": round(now - r.t_arrival, 3)}
+                 for r in list(self.queue)[:32]]
+        al = self.allocator
+        usable = self.trash_page       # pool minus the reserved page
+        live = usable - al.available
+        spec_slots = int(self._c_spec_slots.value)
+        cnt_hits = int(self._c_pc_hits.value)
+        cnt_miss = int(self._c_pc_misses.value)
+        pt = int(self._c_pc_prompt_tokens.value)
+        status: Dict[str, Any] = {
+            "schema_version": 1,
+            "engine": type(self).__name__,
+            "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "uptime_s": round(now - self._t_start, 3),
+            "last_step_age_s": (
+                round(now - self._last_step_t, 3)
+                if self._last_step_t is not None else None),
+            "max_batch": self.max_batch,
+            "active_slots": sum(1 for s in self.slots if s is not None),
+            "slots": slots,
+            "queue": {"depth": len(self.queue), "head": queue},
+            "finished_pending_drain": len(self.finished),
+            "kv": {
+                "page_size": self.page_size,
+                "pages_usable": usable,
+                "pages_free": len(al.free),
+                "pages_warm": len(al.pool),
+                "pages_live": live,
+                "utilization": round(live / max(usable, 1), 4),
+                # internal fragmentation of the mapped working set:
+                # the fraction of page capacity mapped into live slots
+                # that holds no real KV yet (bucket padding + decode
+                # headroom) — high values mean page_size is oversized
+                # for the traffic
+                "fragmentation": round(
+                    1.0 - valid_tokens / mapped_capacity, 4)
+                if mapped_capacity else 0.0,
+            },
+            "prefix_cache": {
+                "enabled": self._pc_on,
+                "warm_pool_pages": len(al.pool),
+                "published_lifetime": al.published,
+                "evicted_lifetime": al.evicted,
+                "admission_hits": cnt_hits,
+                "admission_misses": cnt_miss,
+                "token_hit_rate": round(
+                    self._c_pc_cached_tokens.value / pt, 4) if pt
+                else 0.0,
+            },
+            "speculative": {
+                "enabled": self._spec_on,
+                "verify_sweeps": int(self._c_spec_sweeps.value),
+                "mean_accept_len": round(
+                    self._c_spec_emitted.value / spec_slots, 4)
+                if spec_slots else None,
+            },
+            "slo": self.slo_tracker.snapshot(now=now),
+            "metrics": self.registry.snapshot(),
+        }
+        return status
+
+    def healthz(self) -> Dict[str, Any]:
+        """Liveness/readiness for a fleet supervisor probe.  ``ready``
+        goes false after :meth:`shutdown` or once an attached
+        watchdog has fired (the HTTP endpoint turns that into a 503)."""
+        now = time.perf_counter()
+        h: Dict[str, Any] = {
+            "alive": True,
+            "ready": not self._closed,
+            "uptime_s": round(now - self._t_start, 3),
+            "last_step_age_s": (
+                round(now - self._last_step_t, 3)
+                if self._last_step_t is not None else None),
+            "queue_depth": len(self.queue),
+            "active_slots": sum(1 for s in self.slots if s is not None),
+            "watchdog": None,
+        }
+        wd = self._watchdog
+        if wd is not None:
+            h["watchdog"] = wd.health()
+            if wd.fired:
+                h["ready"] = False
+        return h
+
+    def requestz(self, req_id) -> Dict[str, Any]:
+        """Drill into ONE request: its flight-recorder events (from the
+        ring — a wrapped ring may have lost the oldest) plus its
+        current disposition.  ``req_id`` matches on the string form, so
+        the HTTP query ``/requestz?id=3`` finds integer id 3."""
+        rid = str(req_id)
+        events = []
+        if self.tracer.enabled:
+            events = [e for e in self.tracer.recorder.events()
+                      if e[1] is not None and _req_key(e[1]) == rid]
+        # list() snapshots: this runs on the HTTP serving thread while
+        # the engine thread mutates queue/finished — iterating the live
+        # containers would raise "mutated during iteration"
+        in_queue = any(_req_key(r.req_id) == rid
+                       for r in list(self.queue))
+        slot = next((b for b, s in enumerate(list(self.slots))
+                     if s is not None
+                     and _req_key(s.req.req_id) == rid), None)
+        finished = any(_req_key(k) == rid for k in list(self.finished))
+        out: Dict[str, Any] = {
+            "req": rid,
+            "found": bool(events) or in_queue or slot is not None
+            or finished,
+            "state": ("finished" if finished
+                      else "active" if slot is not None
+                      else "queued" if in_queue
+                      else "unknown"),
+            "slot": slot,
+            "tracing_enabled": self.tracer.enabled,
+            "events": [event_to_dict(e) for e in events],
+        }
+        if events:
+            from deepspeed_tpu.request_trace import request_breakdown
+
+            rows = request_breakdown(events)["requests"]
+            if rows:
+                out["breakdown"] = next(iter(rows.values()))
+        return out
+
+    def shutdown(self) -> None:
+        """Idempotent teardown: final sink flush, then stop the
+        telemetry/introspection HTTP server and join its thread — so
+        back-to-back engine constructions on one fixed port (the test
+        suite's pattern) never hit ``EADDRINUSE`` or leak the serving
+        thread."""
+        if self._closed:
+            return
+        self._closed = True
+        ex = self._tel_exporter
+        if ex is not None:
+            try:
+                ex.maybe_export(force=True)
+            except Exception:
+                pass
+            ex.close()
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
 
 
 def _shard_params_for_serving(params, specs_tree, mesh):
@@ -1364,6 +1648,16 @@ def serving_engine(params, cfg, **kw):
         raise NotImplementedError(
             f"speculative decoding needs the paged-KV decode path, "
             f"which {type(cfg).__name__} does not serve — supported: "
+            "LlamaConfig, MixtralConfig, GPT2Config")
+    so = kw.pop("slo", None)
+    if so is not None and SLOConfig.coerce(so).enabled:
+        # SLO classification hangs off the decode scheduler's lifecycle
+        # (submit/first-token/finish edges); the encoder engines score
+        # fixed-shape lots with no such lifecycle — fail loudly, never
+        # silently drop a latency objective
+        raise NotImplementedError(
+            f"the slo block needs the paged-KV decode path, which "
+            f"{type(cfg).__name__} does not serve — supported: "
             "LlamaConfig, MixtralConfig, GPT2Config")
     pc = kw.pop("prefix_cache", None)
     if pc is not None and PrefixCacheConfig.coerce(pc).enabled:

@@ -429,6 +429,24 @@ class ZeroInferenceServingEngine(ServingEngine):
         return jnp.stack(cols, axis=1), cache
 
     # ------------------------------------------------------- inspection
+    def statusz(self) -> Dict[str, Any]:
+        """Base snapshot + the weight-streaming view: the residency
+        plan, bytes shipped, and the stall totals that attribute a
+        blown TTFT budget to the tier fence it sat behind (the
+        ZeRO-Infinity / ZeRO-Offload stall-attribution question)."""
+        s = ServingEngine.statusz(self)
+        s["zero_inference"] = {
+            "tier": self._zi.tier,
+            "plan": dict(self.plan),
+            "layer_h2d_uploads": int(self._c_h2d.value),
+            "layer_sweeps": int(self._c_sweeps.value),
+            "bytes_uploaded": int(self._c_bytes.value),
+            "stream_stalls": int(self._h_wait.count),
+            "stream_stall_s": round(float(self._h_wait.sum), 6),
+            "h2d_bandwidth_bytes_per_s": float(self._g_bw.value),
+        }
+        return s
+
     def hbm_weight_working_set_bytes(self) -> int:
         """Peak weight bytes resident in HBM under the plan: stem +
         head + pinned layers + the streaming double buffer — the

@@ -438,13 +438,25 @@ def parse_prometheus_text(text: str) -> Dict[str, Any]:
 
 
 class TelemetryExporter:
-    """Periodic sink driver: rate-limited MonitorMaster bridge +
-    Prometheus file + optional stdlib-http ``/metrics`` endpoint.
+    """Periodic sink driver + live introspection server.
 
+    Sinks: rate-limited MonitorMaster bridge + Prometheus file.
     ``maybe_export(step)`` is safe to call every iteration — it is one
-    ``time.monotonic()`` compare until ``interval_s`` elapses.  The HTTP
-    server (``http_port``; 0 picks an ephemeral port, see ``.port``)
-    renders the exposition on demand in a daemon thread.
+    ``time.monotonic()`` compare until ``interval_s`` elapses.
+
+    The HTTP server (``http_port``; 0 picks an ephemeral port, see
+    ``.port``) renders ``/metrics`` on demand in a daemon thread, and
+    doubles as the engine introspection surface: providers registered
+    via :meth:`register_provider` serve ``/statusz`` (live engine
+    snapshot), ``/healthz`` (liveness/readiness; returns 503 when the
+    provider reports unready), and ``/requestz?id=`` (one request's
+    flight-recorder events).  Unregistered introspection paths 404 —
+    a bare exporter is still just a metrics endpoint.
+
+    Lifecycle: the socket binds with ``SO_REUSEADDR`` and
+    :meth:`close` is idempotent (shutdown + close + thread join), so
+    back-to-back engine constructions in one process can reuse a fixed
+    port without ``EADDRINUSE`` or leaking the serving thread.
     """
 
     def __init__(self, registry: MetricsRegistry, *, monitor=None,
@@ -460,6 +472,11 @@ class TelemetryExporter:
         self._httpd = None
         self._http_thread = None
         self.port: Optional[int] = None
+        # introspection providers: name -> zero-arg callable returning a
+        # JSON-serializable dict ("statusz", "healthz") or a one-arg
+        # callable taking the request id ("requestz").  Read via a dict
+        # lookup per GET — registration order and timing are free.
+        self._providers: Dict[str, Any] = {}
         if http_port is not None and registry.enabled:
             self._start_http(int(http_port))
         # postmortem flushing: the watchdog's timeout path (and any
@@ -484,30 +501,87 @@ class TelemetryExporter:
             self.registry.write_prometheus(self.prometheus_path)
         return True
 
+    # ---------------------------------------------------- introspection
+    def register_provider(self, name: str, fn) -> None:
+        """Attach an introspection provider: ``statusz``/``healthz``
+        take no args and return a JSON dict (healthz may include
+        ``"ready": false`` to force a 503); ``requestz`` takes the
+        request-id string.  Re-registering a name replaces it (the
+        engine owns its endpoints)."""
+        if name not in ("statusz", "healthz", "requestz"):
+            raise ValueError(
+                f"unknown introspection provider {name!r} — expected "
+                "statusz, healthz or requestz")
+        self._providers[name] = fn
+
     # ------------------------------------------------------------- http
     def _start_http(self, port: int) -> None:
         import http.server
 
         registry = self.registry
+        providers = self._providers
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):          # noqa: N802 (stdlib contract)
-                if self.path.rstrip("/") not in ("", "/metrics"):
-                    self.send_error(404)
-                    return
-                body = registry.prometheus_text().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_json(self, obj, code: int = 200) -> None:
+                import json
+
+                self._send(code, (json.dumps(obj, indent=1,
+                                             sort_keys=True)
+                                  + "\n").encode())
+
+            def do_GET(self):          # noqa: N802 (stdlib contract)
+                from urllib.parse import parse_qs, urlparse
+
+                u = urlparse(self.path)
+                route = u.path.rstrip("/") or "/metrics"
+                try:
+                    if route == "/metrics":
+                        self._send(200, registry.prometheus_text()
+                                   .encode(),
+                                   "text/plain; version=0.0.4")
+                    elif route == "/statusz" and "statusz" in providers:
+                        self._send_json(providers["statusz"]())
+                    elif route == "/healthz" and "healthz" in providers:
+                        h = providers["healthz"]()
+                        self._send_json(
+                            h, 200 if h.get("ready", True) else 503)
+                    elif route == "/requestz" and \
+                            "requestz" in providers:
+                        rid = parse_qs(u.query).get("id", [None])[0]
+                        if rid is None:
+                            self._send_json(
+                                {"error": "missing ?id= query"}, 400)
+                        else:
+                            d = providers["requestz"](rid)
+                            self._send_json(
+                                d, 200 if d.get("found") else 404)
+                    else:
+                        self.send_error(404)
+                except Exception as e:   # a broken provider must not
+                    try:                 # kill the serving thread
+                        self._send_json({"error": repr(e)}, 500)
+                    except Exception:
+                        pass
+
             def log_message(self, *a):   # keep scrapes out of stderr
                 pass
 
-        self._httpd = http.server.ThreadingHTTPServer(
-            ("127.0.0.1", port), Handler)
+        class Server(http.server.ThreadingHTTPServer):
+            # explicit (HTTPServer already sets it, but the lifecycle
+            # contract — back-to-back engines on one fixed port — is
+            # load-bearing enough to pin rather than inherit)
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._httpd = Server(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]
         self._http_thread = threading.Thread(
             target=self._httpd.serve_forever,
@@ -515,10 +589,15 @@ class TelemetryExporter:
         self._http_thread.start()
 
     def close(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
+        """Stop the HTTP server and join its thread.  Idempotent —
+        engine teardown and explicit calls can both run it."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        t, self._http_thread = self._http_thread, None
+        if t is not None:
+            t.join(timeout=5.0)
 
 
 # ----------------------------------------------------- exporter registry

@@ -77,6 +77,18 @@ class Watchdog:
     def pet(self) -> None:
         self._last = time.monotonic()
 
+    def last_pet_age_s(self) -> float:
+        """Seconds since the last heartbeat — the liveness signal
+        ``/healthz`` exposes (a fleet probe sees the hang building
+        BEFORE the timeout fires)."""
+        return time.monotonic() - self._last
+
+    def health(self) -> dict:
+        """JSON view for health endpoints."""
+        return {"fired": bool(self.fired),
+                "timeout_s": self.timeout_s,
+                "last_heartbeat_age_s": round(self.last_pet_age_s(), 3)}
+
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:

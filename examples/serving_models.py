@@ -92,9 +92,14 @@ def main():
     built = ("none" if mesh is None
              else {ax: mesh.size(ax) for ax in ("model", "expert")
                    if mesh.size(ax) > 1})
+    # registry snapshot, not the deprecated eng.stats shim
+    cnt = eng.registry.snapshot()["counters"]
+    sched = {k: int(cnt.get(f"serving_{k}", 0))
+             for k in ("admitted_requests", "preempted_requests",
+                       "decode_steps", "decode_syncs")}
     print(f"{args.model}: served {len(outs)} requests "
           f"({gen} tokens) in {dt:.1f}s  mesh={built}  "
-          f"stats={eng.stats}")
+          f"sched={sched}")
     for rid in sorted(outs):
         print(f"  {rid}: {outs[rid][:18]}{'…' if len(outs[rid]) > 18 else ''}")
 

@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Bench regression gate: diff the stamped evidence files against a
+committed baseline manifest and exit nonzero on regressions — the perf
+trajectory (serving tokens/s, prefix-cache TTFT wins, speculative
+amortization, observability overhead) becomes an enforced contract
+rather than folklore.
+
+Manifest (``BENCH_BASELINE.json``): one entry per gated metric —
+
+    {"file": "SPEC_BENCH.json",          # evidence file (repo-relative)
+     "path": "spec_ab.speedup",          # dot path into its JSON
+     "baseline": 1.448,                  # the committed value
+     "direction": "higher",              # higher|lower is better
+     "rel_tol": 0.25,                    # allowed fractional slack
+     "abs_tol": 0.0,                     # allowed absolute slack
+     "when": {"path": "backend",         # optional: gate only when a
+              "equals": "cpu"}}          #   provenance key matches
+
+A ``higher`` metric regresses when
+``value < baseline * (1 - rel_tol) - abs_tol``; a ``lower`` metric when
+``value > baseline * (1 + rel_tol) + abs_tol`` (abs_tol carries
+near-zero metrics like overhead fractions, where any rel_tol is
+meaningless).  A missing file SKIPs (the slow lane stamps evidence
+best-effort; an absent stamp is not a regression) unless ``--strict``;
+a missing *path inside a present file* FAILS — that is a schema break,
+exactly what the gate exists to catch.
+
+    python tools/bench_gate.py --check            # gate, exit 1 on fail
+    python tools/bench_gate.py --check --json-out BENCH_GATE.json
+    python tools/bench_gate.py --update           # re-baseline from the
+                                                  # current evidence
+
+``tools/run_slow_lane.sh`` runs ``--check`` after re-stamping the
+evidence files, so every slow-lane cadence leaves a pass/fail verdict
+(``BENCH_GATE.json``) next to the stamps.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_MANIFEST = os.path.join(REPO, "BENCH_BASELINE.json")
+
+
+def get_path(obj, dot_path: str):
+    """Resolve ``a.b.0.c`` (ints index lists); raises KeyError."""
+    cur = obj
+    for part in dot_path.split("."):
+        if isinstance(cur, list):
+            cur = cur[int(part)]
+        elif isinstance(cur, dict):
+            cur = cur[part]
+        else:
+            raise KeyError(
+                f"{dot_path!r}: hit a leaf before {part!r}")
+    return cur
+
+
+def check_entry(entry: dict, files_root: str, cache: dict) -> dict:
+    """Evaluate one manifest entry → a verdict row."""
+    fname = entry["file"]
+    row = {"file": fname, "path": entry["path"],
+           "baseline": entry.get("baseline"),
+           "direction": entry.get("direction", "higher")}
+    fpath = os.path.join(files_root, fname)
+    if fname not in cache:
+        try:
+            with open(fpath) as f:
+                cache[fname] = json.load(f)
+        except FileNotFoundError:
+            cache[fname] = None
+        except json.JSONDecodeError as e:
+            cache[fname] = e
+    doc = cache[fname]
+    if doc is None:
+        row.update(status="SKIP", reason="evidence file missing")
+        return row
+    if isinstance(doc, json.JSONDecodeError):
+        row.update(status="FAIL", reason=f"unparseable JSON: {doc}")
+        return row
+    when = entry.get("when")
+    if when:
+        try:
+            actual = get_path(doc, when["path"])
+        except (KeyError, IndexError, ValueError):
+            actual = None
+        if actual != when["equals"]:
+            row.update(status="SKIP",
+                       reason=f"{when['path']}={actual!r} != "
+                              f"{when['equals']!r}")
+            return row
+    try:
+        value = get_path(doc, entry["path"])
+    except (KeyError, IndexError, ValueError) as e:
+        row.update(status="FAIL",
+                   reason=f"metric path missing (schema break): {e}")
+        return row
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        row.update(status="FAIL",
+                   reason=f"metric is not numeric: {value!r}")
+        return row
+    row["value"] = value
+    base = float(entry["baseline"])
+    rel = float(entry.get("rel_tol", 0.0))
+    ab = float(entry.get("abs_tol", 0.0))
+    if entry.get("direction", "higher") == "higher":
+        floor = base * (1.0 - rel) - ab
+        row["bound"] = round(floor, 6)
+        ok = value >= floor
+    else:
+        ceil = base * (1.0 + rel) + ab
+        row["bound"] = round(ceil, 6)
+        ok = value <= ceil
+    row["status"] = "PASS" if ok else "FAIL"
+    if not ok:
+        row["reason"] = (
+            f"{entry['path']} = {value} regressed past bound "
+            f"{row['bound']} (baseline {base}, "
+            f"{entry.get('direction', 'higher')} is better)")
+    return row
+
+
+def run_gate(manifest: dict, files_root: str,
+             strict: bool = False) -> dict:
+    """Gate every manifest entry; returns the verdict document."""
+    cache: dict = {}
+    rows = [check_entry(e, files_root, cache)
+            for e in manifest["entries"]]
+    if strict:
+        for r in rows:
+            if r["status"] == "SKIP":
+                r["status"] = "FAIL"
+                r["reason"] = "--strict: " + r.get("reason", "skipped")
+    n_fail = sum(r["status"] == "FAIL" for r in rows)
+    return {
+        "ok": n_fail == 0,
+        "checked": len(rows),
+        "passed": sum(r["status"] == "PASS" for r in rows),
+        "skipped": sum(r["status"] == "SKIP" for r in rows),
+        "failed": n_fail,
+        "rows": rows,
+    }
+
+
+def update_baselines(manifest: dict, files_root: str) -> dict:
+    """Rewrite every reachable entry's baseline from the current
+    evidence (tolerances and provenance guards stay as committed)."""
+    cache: dict = {}
+    updated = skipped = 0
+    for e in manifest["entries"]:
+        row = check_entry(e, files_root, cache)
+        if "value" in row:
+            e["baseline"] = row["value"]
+            updated += 1
+        else:
+            skipped += 1
+    return {"updated": updated, "skipped": skipped}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--manifest", default=DEFAULT_MANIFEST)
+    ap.add_argument("--files-root", default=REPO,
+                    help="directory holding the evidence files")
+    ap.add_argument("--check", action="store_true",
+                    help="gate the current evidence (default action)")
+    ap.add_argument("--update", action="store_true",
+                    help="re-baseline the manifest from the current "
+                         "evidence files")
+    ap.add_argument("--strict", action="store_true",
+                    help="missing evidence files fail instead of skip")
+    ap.add_argument("--json-out", default=None,
+                    help="also write the verdict document (atomic)")
+    args = ap.parse_args()
+
+    with open(args.manifest) as f:
+        manifest = json.load(f)
+
+    if args.update:
+        res = update_baselines(manifest, args.files_root)
+        from deepspeed_tpu.utils.evidence import atomic_write_json
+
+        atomic_write_json(manifest, args.manifest)
+        print(f"bench_gate: re-baselined {res['updated']} entries "
+              f"({res['skipped']} unreachable) → {args.manifest}")
+        return 0
+
+    verdict = run_gate(manifest, args.files_root, strict=args.strict)
+    import time
+
+    verdict["t"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    verdict["manifest"] = os.path.relpath(args.manifest, args.files_root)
+    for r in verdict["rows"]:
+        mark = {"PASS": "ok  ", "SKIP": "skip", "FAIL": "FAIL"}[
+            r["status"]]
+        detail = (f"{r.get('value')} vs bound {r.get('bound')}"
+                  if "value" in r else r.get("reason", ""))
+        print(f"[{mark}] {r['file']}:{r['path']}  {detail}")
+    print(f"bench_gate: {verdict['passed']} passed, "
+          f"{verdict['skipped']} skipped, {verdict['failed']} FAILED")
+    if args.json_out:
+        from deepspeed_tpu.utils.evidence import atomic_write_json
+
+        atomic_write_json(verdict, args.json_out)
+        print("→", args.json_out)
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""dstpu_top: the serving "htop" — poll an engine's ``/statusz`` and
+render slots, queue, KV/prefix-cache occupancy, speculation acceptance
+and per-tier SLO burn live in the terminal.
+
+The engine side is the introspection server the telemetry HTTP sink
+grew in PR 6: point any engine at a port (``telemetry.http_port`` in
+the config block) and this tool at the same port.
+
+    python tools/dstpu_top.py --url http://127.0.0.1:8080
+    python tools/dstpu_top.py --url ... --interval 1
+    python tools/dstpu_top.py --url ... --once        # one frame, exit
+    python tools/dstpu_top.py --once --json           # raw snapshot
+
+Pure stdlib.  Uses curses when stdout is a tty (clean redraws, q to
+quit); falls back to plain ANSI-clear refresh otherwise (``--plain``
+forces it — pipeable).  ``--once`` renders a single frame and exits,
+which is also what the tests drive.
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def fetch(url: str, timeout: float = 5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _bar(frac: float, width: int = 20) -> str:
+    frac = min(max(frac, 0.0), 1.0)
+    n = int(round(frac * width))
+    return "[" + "#" * n + "." * (width - n) + f"] {100 * frac:5.1f}%"
+
+
+def render(status: dict, health: dict | None = None) -> list:
+    """One frame of text lines from a /statusz snapshot."""
+    L = []
+    hdr = (f"{status.get('engine', '?')}  up {status.get('uptime_s', 0):.0f}s"
+           f"  step age {status.get('last_step_age_s')}s")
+    if health is not None:
+        hdr += ("  READY" if health.get("ready") else "  NOT-READY")
+        wd = health.get("watchdog")
+        if wd:
+            hdr += (f"  wd {'FIRED' if wd['fired'] else 'ok'} "
+                    f"({wd['last_heartbeat_age_s']:.0f}s/"
+                    f"{wd['timeout_s']:.0f}s)")
+    L.append(hdr)
+    L.append("-" * 78)
+
+    kv = status.get("kv", {})
+    usable = max(kv.get("pages_usable", 1), 1)
+    L.append(f"kv    live {_bar(kv.get('pages_live', 0) / usable)}"
+             f"  free {kv.get('pages_free', 0)}"
+             f"  warm {kv.get('pages_warm', 0)}"
+             f"  frag {kv.get('fragmentation', 0.0):.2f}")
+    pc = status.get("prefix_cache", {})
+    if pc.get("enabled"):
+        L.append(f"cache warm {pc.get('warm_pool_pages', 0)} pages"
+                 f"  hit-rate {pc.get('token_hit_rate', 0.0):.3f}"
+                 f"  published {pc.get('published_lifetime', 0)}"
+                 f"  evicted {pc.get('evicted_lifetime', 0)}")
+    sp = status.get("speculative", {})
+    if sp.get("enabled"):
+        mal = sp.get("mean_accept_len")
+        L.append(f"spec  sweeps {sp.get('verify_sweeps', 0)}"
+                 f"  mean accept "
+                 f"{mal if mal is not None else '-'}")
+    zi = status.get("zero_inference")
+    if zi:
+        L.append(f"zi    streamed {zi['plan'].get('n_streamed', 0)}/"
+                 f"{zi['plan'].get('n_layers', 0)} layers"
+                 f"  stalls {zi.get('stream_stalls', 0)}"
+                 f" ({zi.get('stream_stall_s', 0.0):.2f}s)"
+                 f"  {zi.get('bytes_uploaded', 0) / 1e6:.0f} MB up")
+
+    slo = status.get("slo", {})
+    if slo.get("enabled"):
+        L.append("-" * 78)
+        L.append(f"{'tier':<14}{'attain':>8}{'target':>8}"
+                 f"{'goodput t/s':>13}  {'burn':<24}{'alert':>6}")
+        for name, t in sorted(slo.get("tiers", {}).items()):
+            burns = " ".join(f"{w}={b:.1f}"
+                             for w, b in sorted(t["burn_rates"].items()))
+            L.append(f"{name:<14}{t['attainment']:>8.3f}"
+                     f"{t['target']:>8.3f}"
+                     f"{t['goodput_tokens_per_s']:>13.1f}  "
+                     f"{burns:<24}"
+                     f"{'FIRE' if t.get('alert_active') else '-':>6}")
+
+    L.append("-" * 78)
+    q = status.get("queue", {})
+    L.append(f"slots {status.get('active_slots', 0)}/"
+             f"{status.get('max_batch', 0)} active"
+             f"   queue {q.get('depth', 0)}"
+             f"   finished-pending {status.get('finished_pending_drain', 0)}")
+    L.append(f"{'slot':<5}{'state':<9}{'req':<12}{'tier':<12}"
+             f"{'prog':<12}{'seq':>5}{'pages':>6}{'age s':>8}")
+    for s in status.get("slots", []):
+        if s.get("state") == "idle":
+            L.append(f"{s['slot']:<5}idle")
+            continue
+        if s["state"] == "prefill":
+            prog = f"{s.get('prefill_done', 0)}/{s['prompt_tokens']}"
+        else:
+            prog = f"{s['generated']}/{s['max_new_tokens']}"
+        L.append(f"{s['slot']:<5}{s['state']:<9}"
+                 f"{str(s['req'])[:11]:<12}"
+                 f"{str(s.get('tier') or '-')[:11]:<12}"
+                 f"{prog:<12}{s['seq_len']:>5}{s['pages']:>6}"
+                 f"{s['age_s']:>8.1f}")
+    for r in q.get("head", [])[:8]:
+        L.append(f"  ..  queued   {str(r['req'])[:11]:<12}"
+                 f"{str(r.get('tier') or '-')[:11]:<12}"
+                 f"{r['prompt_tokens']:>4} toks"
+                 f"{r['age_s']:>9.1f}")
+    return L
+
+
+def one_frame(base: str):
+    status = fetch(base + "/statusz")
+    try:
+        health = fetch(base + "/healthz")
+    except urllib.error.HTTPError as e:       # 503 = not ready, still JSON
+        health = json.loads(e.read().decode())
+    return status, health
+
+
+def loop_plain(base: str, interval: float, once: bool) -> int:
+    while True:
+        try:
+            status, health = one_frame(base)
+            lines = render(status, health)
+        except Exception as e:
+            lines = [f"dstpu_top: {base} unreachable: {e}"]
+        if not once:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+        print("\n".join(lines), flush=True)
+        if once:
+            return 0
+        time.sleep(interval)
+
+
+def loop_curses(base: str, interval: float) -> int:
+    import curses
+
+    def run(scr):
+        curses.curs_set(0)
+        scr.nodelay(True)
+        while True:
+            try:
+                status, health = one_frame(base)
+                lines = render(status, health)
+            except Exception as e:
+                lines = [f"dstpu_top: {base} unreachable: {e}"]
+            scr.erase()
+            maxy, maxx = scr.getmaxyx()
+            for y, line in enumerate(lines[:maxy - 1]):
+                scr.addnstr(y, 0, line, maxx - 1)
+            scr.addnstr(maxy - 1, 0,
+                        f"q quit   refresh {interval:.1f}s", maxx - 1,
+                        curses.A_REVERSE)
+            scr.refresh()
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < interval:
+                if scr.getch() in (ord("q"), ord("Q")):
+                    return
+                time.sleep(0.05)
+
+    curses.wrapper(run)
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="engine introspection base URL "
+                         "(telemetry.http_port)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    ap.add_argument("--plain", action="store_true",
+                    help="plain refresh instead of curses")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: print the raw /statusz JSON")
+    args = ap.parse_args()
+    base = args.url.rstrip("/")
+    if args.json:
+        print(json.dumps(fetch(base + "/statusz"), indent=1,
+                         sort_keys=True))
+        return 0
+    if args.once or args.plain or not sys.stdout.isatty():
+        return loop_plain(base, args.interval, args.once)
+    return loop_curses(base, args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

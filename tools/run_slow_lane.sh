@@ -24,11 +24,14 @@ timeout -k 30 "$DEADLINE" env JAX_PLATFORMS=cpu python -m pytest tests/ \
   2>&1 | tee "$LOG"
 RC=${PIPESTATUS[0]}
 
-# telemetry sample: every slow-lane run also stamps TELEMETRY_SAMPLE.json
-# (a live registry snapshot off a short gpt2 serving loop) next to
-# SLOW_LANE.json — best-effort, never the reason the lane fails
+# telemetry + introspection samples: every slow-lane run also stamps
+# TELEMETRY_SAMPLE.json (a live registry snapshot off a short gpt2
+# serving loop) and STATUSZ_SAMPLE.json (/statusz, /healthz and a
+# /requestz drill-down fetched over real HTTP from the same engine)
+# next to SLOW_LANE.json — best-effort, never the reason the lane fails
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/telemetry_dump.py \
-  --cpu --json-out "$REPO/TELEMETRY_SAMPLE.json" >/dev/null 2>&1 || true
+  --cpu --json-out "$REPO/TELEMETRY_SAMPLE.json" \
+  --statusz-out "$REPO/STATUSZ_SAMPLE.json" >/dev/null 2>&1 || true
 
 # prefix-cache A/B: the shared-prefix workload served with caching off
 # vs on (TTFT, tokens/s, hit rate) stamps PREFIX_BENCH.json through the
@@ -59,6 +62,14 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python bench_serving.py --cpu \
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/trace_report.py \
   --selftest --cpu --json-out "$REPO/TRACE_SAMPLE.json" \
   >/dev/null 2>&1 || true
+
+# bench regression gate: AFTER the stamps above, diff the evidence
+# files against the committed BENCH_BASELINE.json and leave a verdict
+# in BENCH_GATE.json — the perf trajectory as an enforced contract.
+# The lane itself stays best-effort (exit 0), but the verdict is
+# visible per cadence run and tier-1 tests assert the gate logic.
+timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/bench_gate.py \
+  --check --json-out "$REPO/BENCH_GATE.json" || true
 SUMMARY=$(grep -aE '[0-9]+ (passed|failed|error|skipped)' "$LOG" | tail -1)
 
 python - "$OUT" "$RC" "$T0" "$SUMMARY" <<'EOF'

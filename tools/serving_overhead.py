@@ -34,7 +34,7 @@ sys.path.insert(0, REPO)
 
 def measure_point(model_name, slots, decode_chunk, prompt_len=8,
                   new_tokens=48, requests=None, telemetry=True,
-                  tracing=True):
+                  tracing=True, slo=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -54,11 +54,17 @@ def measure_point(model_name, slots, decode_chunk, prompt_len=8,
     params = mod.init_params(jax.random.PRNGKey(0), cfg)
     requests = requests or 2 * slots
     max_seq = prompt_len + new_tokens
+    # the slo arm declares real objectives on the default tier so the
+    # enabled path pays classification + window bookkeeping, not a
+    # degenerate no-objective fast path
+    slo_block = {"tiers": {"default": {
+        "ttft_s": 30.0, "itl_s": 5.0, "deadline_s": 120.0}}} \
+        if slo else None
     eng = serving_engine(
         params, cfg, max_batch=slots, page_size=8,
         num_pages=slots * (-(-max_seq // 8)) + 8, max_seq=max_seq,
         prefill_bucket=prompt_len, decode_chunk=decode_chunk,
-        telemetry=telemetry, tracing=tracing)
+        telemetry=telemetry, tracing=tracing, slo=slo_block)
 
     def decode_steps():
         return int(eng.registry.snapshot()["counters"]
@@ -116,6 +122,7 @@ def measure_point(model_name, slots, decode_chunk, prompt_len=8,
         "model": model_name, "slots": slots, "decode_chunk": K,
         "requests": requests, "generated": generated,
         "telemetry": bool(telemetry), "tracing": bool(tracing),
+        "slo": bool(slo),
         "decode_steps": steps,
         "prefill_chunks": int(eng.registry.snapshot()["counters"]
                               .get("serving_prefill_chunks", 0)),
@@ -205,6 +212,18 @@ def main():
         "(telemetry on in both arms); disabled path = shared no-op "
         "tracer, no clock read, no ring append")
 
+    # slo-overhead A/B (ISSUE 6 acceptance): per-tier classification +
+    # rolling windows + burn gauges on vs off, telemetry/tracing on in
+    # both arms — the enabled delta is the price of one shared clock
+    # read per token and the finish-time classification.
+    _, slo_overhead = _ab("slo")
+    slo_overhead["backend"] = jax.default_backend()
+    slo_overhead["note"] = (
+        "best-of-3 ms/decode-step, SLO tracker enabled (default tier "
+        "with ttft/itl/deadline objectives) vs disabled on the same "
+        "build (telemetry+tracing on in both arms); disabled path = "
+        "shared no-op tracker")
+
     if args.ab_only and os.path.exists(args.json_out):
         with open(args.json_out) as f:
             out = json.load(f)
@@ -220,6 +239,7 @@ def main():
         }
     out["telemetry_overhead"] = telemetry_overhead
     out["tracing_overhead"] = tracing_overhead
+    out["slo_overhead"] = slo_overhead
     with open(args.json_out, "w") as f:
         json.dump(out, f, indent=1)
     print("→", args.json_out)

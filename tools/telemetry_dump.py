@@ -7,10 +7,14 @@ Runs a tiny gpt2 ServingEngine on whatever backend is available (pass
 
   1. pretty-prints ``registry.snapshot()`` (the on-demand JSON sink),
   2. writes the Prometheus text exposition next to the JSON stamp and
-     parses it back (the same round-trip the tests assert), and
+     parses it back (the same round-trip the tests assert),
   3. stamps TELEMETRY_SAMPLE.json (atomic) with the snapshot + run
      metadata, so slow-lane runs (tools/run_slow_lane.sh) leave a
-     standing record of what a live registry looks like.
+     standing record of what a live registry looks like, and
+  4. stamps STATUSZ_SAMPLE.json from the engine's introspection server
+     (ISSUE 6): /statusz, /healthz and a /requestz drill-down fetched
+     over REAL HTTP from the live engine — the snapshot schema is
+     versioned in-repo and round-trip-parsed by a tier-1 test.
 
     python tools/telemetry_dump.py --cpu
 """
@@ -33,6 +37,8 @@ def main():
     ap.add_argument("--new-tokens", type=int, default=24)
     ap.add_argument("--json-out",
                     default=os.path.join(REPO, "TELEMETRY_SAMPLE.json"))
+    ap.add_argument("--statusz-out",
+                    default=os.path.join(REPO, "STATUSZ_SAMPLE.json"))
     args = ap.parse_args()
 
     import jax
@@ -56,18 +62,27 @@ def main():
     # prefix_cache_* AND spec_* metric families (shared-prefix traffic
     # below produces real hits; the repetitive histories greedy decode
     # settles into give the ngram drafter real acceptances)
+    # slo + introspection on: the stamps carry live slo_* families and
+    # the /statusz sample comes over REAL HTTP (ephemeral port) from
+    # the same traced engine
     eng = serving_engine(
         params, cfg, max_batch=4, page_size=8,
         num_pages=4 * (-(-max_seq // 8)) + 16, max_seq=max_seq,
         prefill_bucket=8, decode_chunk=4, prefix_cache=True,
-        speculative={"draft_tokens": 4})
+        speculative={"draft_tokens": 4},
+        slo={"tiers": {"interactive": {"ttft_s": 10.0,
+                                       "deadline_s": 60.0},
+                       "batch": {"deadline_s": 300.0, "target": 0.9}},
+             "default_tier": "interactive"},
+        telemetry={"http_port": 0, "interval_s": 0.0})
 
     rng = np.random.default_rng(0)
     prefix = rng.integers(1, cfg.vocab_size, prompt_len - 4).tolist()
     t0 = time.perf_counter()
     for i in range(args.requests):
         eng.submit(i, prefix + rng.integers(1, cfg.vocab_size, 4).tolist(),
-                   max_new_tokens=args.new_tokens)
+                   max_new_tokens=args.new_tokens,
+                   tier="batch" if i % 2 else "interactive")
     out = eng.run()
     eng.step()                   # settle gauges after the drain
     wall = time.perf_counter() - t0
@@ -93,6 +108,36 @@ def main():
         "snapshot": snap,
     }, args.json_out)
     print("→", args.json_out)
+
+    # introspection sample over real HTTP: the engine registered its
+    # /statusz, /healthz and /requestz providers on the telemetry
+    # server at construction — fetch all three so the stamped schema is
+    # exactly what a fleet supervisor or dstpu_top would see
+    import urllib.request
+
+    base = f"http://127.0.0.1:{eng._tel_exporter.port}"
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    statusz = get("/statusz")
+    healthz = get("/healthz")
+    requestz = get("/requestz?id=0")
+    atomic_write_json({
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(),
+        "model": "gpt2-tiny",
+        "endpoints": ["/statusz", "/healthz", "/requestz?id=",
+                      "/metrics"],
+        "statusz": statusz,
+        "healthz": healthz,
+        "requestz_sample": requestz,
+    }, args.statusz_out)
+    print(f"# introspection: fetched /statusz /healthz /requestz over "
+          f"http from {base}")
+    print("→", args.statusz_out)
+    eng.shutdown()
 
 
 if __name__ == "__main__":
