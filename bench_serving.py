@@ -34,6 +34,13 @@ honestly (``truncated: true``) rather than burning the window.
         # pair whose rows record weight bytes streamed PER GENERATED
         # TOKEN (the ZeRO-Inference amortization contract); the slow
         # lane stamps this as SPEC_BENCH.json
+    python bench_serving.py --kv-tier
+        # eviction-churn workload (--prefix-groups distinct system
+        # prompts revisited in a second pass, over a KV pool sized to
+        # hold only ~1.5 of them) served with the spill tier OFF then
+        # ON — hit rate, p50 TTFT, demote/promote volume, and a
+        # token-identity check between the arms (the bit-exact spill
+        # contract); the slow lane stamps this as KV_TIER_BENCH.json
 """
 
 import argparse
@@ -125,6 +132,19 @@ def build_prompts(args, cfg):
     import numpy as np
 
     rng = np.random.default_rng(0)
+    if args.kv_tier:
+        # eviction churn: G distinct shared prefixes visited in TWO
+        # passes.  The pool holds ~1.5 prefixes beyond the decode
+        # working set, so by the time pass 2 revisits a group its
+        # pages were reclaimed — dropped (tier off: re-prefill) or
+        # demoted (tier on: promoted back by DMA)
+        groups = [rng.integers(1, cfg.vocab_size,
+                               args.prefix_len).tolist()
+                  for _ in range(args.prefix_groups)]
+        per = max(args.requests // (2 * args.prefix_groups), 1)
+        return [g + rng.integers(1, cfg.vocab_size,
+                                 args.tail_len).tolist()
+                for _ in range(2) for g in groups for _ in range(per)]
     if args.prefix_cache:
         prefix = rng.integers(1, cfg.vocab_size, args.prefix_len).tolist()
         return [prefix + rng.integers(1, cfg.vocab_size,
@@ -144,9 +164,10 @@ def build_prompts(args, cfg):
 
 def measure_config(name, args, params, mod, cfg, phase, prompts,
                    zero_inference=None, prefix_cache=None,
-                   speculative=None):
+                   speculative=None, kv_tier=None):
     """Build one engine flavor, warm it, drive the request stream under
-    the wall-clock cap; returns one evidence row."""
+    the wall-clock cap; returns ``(evidence row, finished outputs)`` —
+    the outputs feed the kv-tier A/B's token-identity check."""
     import jax
     import numpy as np
 
@@ -161,6 +182,8 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
         config["prefix_cache"] = prefix_cache
     if speculative is not None:
         config["speculative"] = speculative
+    if kv_tier is not None:
+        config["kv_tier"] = kv_tier
     # SLO classification rides every row (--slo-ttft-ms 0 disables):
     # the same engine that reports tokens/s reports how many of those
     # tokens came from requests that met their latency objective —
@@ -180,10 +203,29 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
     # (vs the whole padded prompt) is what turns the skipped prefix
     # into skipped COMPUTE, for the miss row too (same bucket, A/B
     # stays apples-to-apples)
-    bucket = 16 if args.prefix_cache else args.prompt_len
+    bucket = 16 if (args.prefix_cache or args.kv_tier) \
+        else args.prompt_len
+    num_pages = args.slots * (-(-max_seq // 16)) + 32
+    if args.kv_tier:
+        # pool sized to FORCE eviction: room for ~2 of the
+        # --prefix-groups shared prefixes (prompts SHARE their group's
+        # prefix pages, so that is the real working set) plus each
+        # slot's private tail+decode pages.  With >2 groups cycling,
+        # publishing group C's prefix must reclaim group A's — so pass
+        # 2's revisits always find their group demoted (tier on) or
+        # dropped (tier off)
+        prefix_pages = -(-args.prefix_len // 16)
+        tail_pages = 1 + -(-(args.tail_len + args.new_tokens) // 16)
+        num_pages = (2 * prefix_pages
+                     + args.slots * tail_pages + 2)
+        if name == "kv_tier_ref":
+            # the no-eviction oracle: every prefix stays warm — the
+            # identity gate compares the on arm against this row
+            num_pages = (args.slots * (-(-max_seq // 16))
+                         + args.prefix_groups * prefix_pages + 8)
     engine = init_serving(
         params, cfg, config=config or None, max_batch=args.slots,
-        page_size=16, num_pages=args.slots * (-(-max_seq // 16)) + 32,
+        page_size=16, num_pages=num_pages,
         max_seq=max_seq, prefill_bucket=bucket,
         decode_chunk=args.decode_chunk, prefill_chunk=args.prefill_chunk,
         weight_dtype=args.weight_dtype)
@@ -321,6 +363,29 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
             "mean_accepted_len": (round(emitted / slots, 3)
                                   if slots else None),
         }
+    if args.kv_tier:
+        pt = delta("prefix_cache_prompt_tokens")
+        ct = delta("prefix_cache_cached_tokens")
+        row["detail"]["kv_tier"] = {
+            "enabled": bool((kv_tier or {}).get("enabled")),
+            "prefix_groups": args.prefix_groups,
+            "prefix_len": args.prefix_len,
+            "num_pages": num_pages,
+            "hit_rate": round(ct / pt, 4) if pt else 0.0,
+            "hits": delta("prefix_cache_hits"),
+            "misses": delta("prefix_cache_misses"),
+            "evicted_pages": delta("prefix_cache_evicted_pages"),
+            "demoted_pages": delta("kv_tier_demoted_pages"),
+            "promoted_pages": delta("kv_tier_promoted_pages"),
+            "promote_deferrals": delta("kv_tier_promote_deferrals"),
+            "admit_waits": delta("kv_tier_admit_waits"),
+            "occupancy": (engine._kv_pool.occupancy()
+                          if engine._kv_pool is not None else None),
+        }
+        tb = row["detail"].get("trace_breakdown", {})
+        if "ttft_s" in tb:
+            row["detail"]["kv_tier"]["ttft_p50_ms"] = round(
+                1000 * tb["ttft_s"]["p50"], 2)
     if args.prefix_cache:
         # token-level hit rate over the TIMED traffic only: warmup used
         # a disjoint prompt, so its miss + self-hit are delta'd away
@@ -353,8 +418,9 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
                 round(delta("zi_bytes_uploaded") / generated, 1)
                 if generated else None),
         }
+    outputs = {str(k): list(map(int, v)) for k, v in out.items()}
     del engine
-    return row
+    return row, outputs
 
 
 def main():
@@ -384,6 +450,21 @@ def main():
     ap.add_argument("--tail-len", type=int, default=8,
                     help="per-user unique tail length for the "
                          "--prefix-cache workload")
+    ap.add_argument("--kv-tier", action="store_true",
+                    help="A/B the eviction-churn workload with the "
+                         "tiered KV cache (host/NVMe spill) off vs on "
+                         "(hit rate, p50 TTFT, token identity)")
+    ap.add_argument("--prefix-groups", type=int, default=4,
+                    help="distinct shared prefixes in the --kv-tier "
+                         "workload (the pool holds ~1.5 of them)")
+    ap.add_argument("--kv-host-pool-mb", type=int, default=64,
+                    help="host pool size for the --kv-tier on arm")
+    ap.add_argument("--kv-nvme-dir", default=None,
+                    help="also spill host-pool overflow to NVMe files "
+                         "under this dir in the --kv-tier on arm")
+    ap.add_argument("--kv-quantize-cold", action="store_true",
+                    help="int8-quantize demoted pages in the on arm "
+                         "(disables the bit-exact identity check)")
     ap.add_argument("--speculative", action="store_true",
                     help="A/B the repetitive-motif workload with "
                          "speculative decoding off vs on (tokens/s, "
@@ -427,6 +508,9 @@ def main():
     ap.add_argument("--json-out", default=os.path.join(REPO,
                                                        "SERVING_BENCH.json"))
     args = ap.parse_args()
+    if args.kv_tier and (args.prefix_cache or args.speculative
+                         or args.zero_inference):
+        raise SystemExit("--kv-tier is its own A/B")
     if args.prefix_cache:
         if args.zero_inference:
             raise SystemExit(
@@ -434,6 +518,7 @@ def main():
         if args.speculative:
             raise SystemExit(
                 "--prefix-cache and --speculative are separate A/Bs")
+    if args.prefix_cache or args.kv_tier:
         # the workload defines the prompt length
         args.prompt_len = args.prefix_len + args.tail_len
 
@@ -454,15 +539,37 @@ def main():
     phase(f"backend={jax.default_backend()} — init params")
     params = mod.init_params(jax.random.PRNGKey(0), cfg)
 
-    # (name, zero_inference, prefix_cache, speculative) per engine flavor
-    configs = [("resident", None, None, None)]
+    # (name, zero_inference, prefix_cache, speculative, kv_tier) per
+    # engine flavor
+    configs = [("resident", None, None, None, None)]
     if args.prefix_cache:
-        configs = [("prefix_off", None, {"enabled": False}, None),
-                   ("prefix_on", None, {"enabled": True}, None)]
+        configs = [("prefix_off", None, {"enabled": False}, None, None),
+                   ("prefix_on", None, {"enabled": True}, None, None)]
+    if args.kv_tier:
+        # BOTH arms run the prefix cache — the A/B is the spill tier
+        kvt_on = {"enabled": True,
+                  "host_pool_bytes": args.kv_host_pool_mb << 20,
+                  "quantize_cold": args.kv_quantize_cold}
+        if args.kv_nvme_dir:
+            kvt_on["nvme_dir"] = args.kv_nvme_dir
+        configs = [
+            ("kv_tier_off", None, {"enabled": True}, None, None),
+            ("kv_tier_on", None, {"enabled": True}, None, kvt_on),
+            # the oracle row: same traffic over a pool that never
+            # evicts.  Promotions restore the ORIGINAL page bytes, so
+            # the on arm must match this row token-for-token on the
+            # bit-exact path — that is the identity the gate enforces.
+            # (The off arm may diverge from it on greedy near-ties:
+            # its partial-prefix re-prefills recompute KV through the
+            # continuation-chunk path, whose bf16 rounding differs
+            # from the whole-prompt flash prefill that wrote the
+            # original pages — a pre-existing cross-strategy property
+            # of the prefix cache, reported as off_path_divergences.)
+            ("kv_tier_ref", None, {"enabled": True}, None, None)]
     spec_on = {"enabled": True, "draft_tokens": args.draft_tokens}
     if args.speculative:
-        configs = [("spec_off", None, None, None),
-                   ("spec_on", None, None, spec_on)]
+        configs = [("spec_off", None, None, None, None),
+                   ("spec_on", None, None, spec_on, None)]
     if args.zero_inference:
         if args.model == "gpt2":
             raise SystemExit("--zero-inference serves llama/mixtral")
@@ -473,23 +580,26 @@ def main():
             # the amortization pair: same streamed engine, speculation
             # off vs on — rows record weight bytes streamed per
             # generated token
-            configs += [("zi_spec_off", zi, None, None),
-                        ("zi_spec_on", zi, None, spec_on)]
+            configs += [("zi_spec_off", zi, None, None, None),
+                        ("zi_spec_on", zi, None, spec_on, None)]
         else:
-            configs.append(("zero_inference", zi, None, None))
+            configs.append(("zero_inference", zi, None, None, None))
 
     prompts = build_prompts(args, cfg)
     out = {"metric": "serving_generated_tokens_per_sec",
            "backend": jax.default_backend(), "partial": True, "rows": []}
     commit(out, args.json_out)
-    for name, zi, pc, spec in configs:
-        row = None
+    outputs_by_config = {}
+    for name, zi, pc, spec, kvt in configs:
+        row = outs = None
         for rep in range(max(args.repeats, 1)):
-            cand = measure_config(name, args, params, mod, cfg, phase,
-                                  prompts, zero_inference=zi,
-                                  prefix_cache=pc, speculative=spec)
+            cand, c_outs = measure_config(
+                name, args, params, mod, cfg, phase, prompts,
+                zero_inference=zi, prefix_cache=pc, speculative=spec,
+                kv_tier=kvt)
             if row is None or cand["value"] > row["value"]:
-                row = cand
+                row, outs = cand, c_outs
+        outputs_by_config[name] = outs
         row["detail"]["repeats"] = max(args.repeats, 1)
         out["rows"].append(row)
         # one JSON commit per completed config: a killed window keeps
@@ -542,6 +652,46 @@ def main():
                 "mean_accepted_len": zon["detail"]["speculative"][
                     "mean_accepted_len"],
             }
+    if args.kv_tier and len(out["rows"]) == 3:
+        off_r, on_r, _ref_r = out["rows"]
+        off_d, on_d = off_r["detail"], on_r["detail"]
+        off_kt, on_kt = off_d["kv_tier"], on_d["kv_tier"]
+        # token identity against the no-eviction ORACLE row, over the
+        # requests both runs completed (the wall-clock cap can
+        # truncate different subsets): a promotion serves the exact
+        # bytes the original pages held, so on the bit-exact path any
+        # on-vs-ref mismatch is a correctness bug the gate must catch
+        o_off = outputs_by_config["kv_tier_off"]
+        o_on = outputs_by_config["kv_tier_on"]
+        o_ref = outputs_by_config["kv_tier_ref"]
+        both = sorted(set(o_ref) & set(o_on))
+        mismatched = sum(1 for k in both if o_ref[k] != o_on[k])
+        off_div = sum(1 for k in sorted(set(o_ref) & set(o_off))
+                      if o_ref[k] != o_off[k])
+        out["kv_tier_ab"] = {
+            "hit_rate_off": off_kt["hit_rate"],
+            "hit_rate_on": on_kt["hit_rate"],
+            "ttft_p50_off_ms": off_kt.get("ttft_p50_ms",
+                                          off_d.get("ttft_ms")),
+            "ttft_p50_on_ms": on_kt.get("ttft_p50_ms",
+                                        on_d.get("ttft_ms")),
+            "tokens_per_s_off": off_r["value"],
+            "tokens_per_s_on": on_r["value"],
+            "evicted_pages_off": off_kt["evicted_pages"],
+            "demoted_pages_on": on_kt["demoted_pages"],
+            "promoted_pages_on": on_kt["promoted_pages"],
+            "quantize_cold": args.kv_quantize_cold,
+            "compared_requests": len(both),
+            "mismatched_requests": mismatched,
+            # informational: the off arm's partial-hit re-prefills may
+            # flip greedy near-ties vs the oracle (cross-strategy bf16
+            # rounding, pre-existing prefix-cache property)
+            "off_path_divergences": off_div,
+        }
+        t_off = out["kv_tier_ab"]["ttft_p50_off_ms"]
+        t_on = out["kv_tier_ab"]["ttft_p50_on_ms"]
+        out["kv_tier_ab"]["ttft_speedup"] = (
+            round(t_off / t_on, 3) if t_off and t_on else None)
     if args.prefix_cache and len(out["rows"]) == 2:
         off_d, on_d = (r["detail"] for r in out["rows"])
         out["prefix_ab"] = {

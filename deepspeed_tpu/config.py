@@ -194,6 +194,96 @@ class PrefixCacheConfig:
 
 
 @dataclasses.dataclass
+class KVTierConfig:
+    """Tiered KV cache for the paged prefix pool (ref: ZeRO-Infinity's
+    memory tiering, arXiv:2104.07857, and ZeRO-Offload's host staging,
+    arXiv:2101.06840 — applied to KV pages the way PR 1 applied it to
+    layer weights).
+
+    With the block on, a published refcount-0 prefix-cache page that
+    would be reclaimed under allocation pressure (or proactively, once
+    the warm pool fills past ``demote_watermark``) is DEMOTED to a host
+    pool — and from there, when the host pool overflows and
+    ``nvme_dir`` is set, spilled to NVMe via the aio pool — instead of
+    being dropped from the content index.  A later prompt matching the
+    demoted span re-admits it through a double-buffered promotion
+    pipeline (``param_stream.TierPageReader``) overlapped with the
+    uncached-suffix prefill chunks, so an evicted system prompt costs a
+    DMA instead of a re-prefill.
+
+    ``quantize_cold``: int8-quantize pages on demote (per-token-row
+    scales; dequantized on promote) so the cold tiers hold ~2x the
+    pages.  Off by default — the spill path is then bit-exact and
+    served tokens are identical to tiering off.  ``demote_watermark``
+    is a fraction of the warm-pool cap: occupancy above it demotes the
+    oldest warm pages proactively (1.0 = demote only under allocation
+    pressure).  ``promote_group_pages`` is the double-buffer granule of
+    the promotion pipeline.
+    """
+
+    enabled: bool = False
+    host_pool_bytes: int = 256 << 20
+    nvme_dir: Optional[str] = None
+    nvme_pool_bytes: Optional[int] = None    # None = unbounded
+    quantize_cold: bool = False
+    demote_watermark: float = 1.0
+    promote_group_pages: int = 8
+    aio_threads: int = 4
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "KVTierConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        k = cls(**{kk: v for kk, v in d.items() if kk in known})
+        k.host_pool_bytes = int(k.host_pool_bytes)
+        k.promote_group_pages = int(k.promote_group_pages)
+        k.aio_threads = int(k.aio_threads)
+        k.demote_watermark = float(k.demote_watermark)
+        if k.host_pool_bytes < 0:
+            raise ValueError(
+                f"kv_tier.host_pool_bytes must be >= 0, got "
+                f"{k.host_pool_bytes}")
+        if k.nvme_pool_bytes is not None:
+            # store the coerced value, like every sibling field — a
+            # string from env/YAML must not survive to compare against
+            # byte counts at the first spill
+            k.nvme_pool_bytes = int(k.nvme_pool_bytes)
+            if k.nvme_pool_bytes <= 0:
+                raise ValueError(
+                    f"kv_tier.nvme_pool_bytes must be positive or null "
+                    f"(null = unbounded), got {k.nvme_pool_bytes}")
+        if not 0.0 <= k.demote_watermark <= 1.0:
+            raise ValueError(
+                f"kv_tier.demote_watermark must be in [0, 1], got "
+                f"{k.demote_watermark}")
+        if k.promote_group_pages < 1:
+            raise ValueError(
+                f"kv_tier.promote_group_pages must be >= 1, got "
+                f"{k.promote_group_pages}")
+        if k.aio_threads < 1:
+            raise ValueError(
+                f"kv_tier.aio_threads must be >= 1, got {k.aio_threads}")
+        return k
+
+    @classmethod
+    def coerce(cls, obj) -> "KVTierConfig":
+        """Accept None (disabled), a bool, a dict (writing the block is
+        the opt-in, like ``prefix_cache``), or a KVTierConfig."""
+        if obj is None:
+            return cls(enabled=False)
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, bool):
+            return cls(enabled=obj)
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("enabled", True)   # passing a block opts in
+            return cls.from_dict(d)
+        raise TypeError(
+            f"kv_tier must be a bool, dict or KVTierConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class SpeculativeConfig:
     """Speculative decoding block for the paged-KV serving path (ref:
     speculative sampling, arXiv:2302.01318 / prompt-lookup decoding;
@@ -651,6 +741,8 @@ class Config:
         default_factory=ZeroInferenceConfig)
     prefix_cache: PrefixCacheConfig = dataclasses.field(
         default_factory=PrefixCacheConfig)
+    kv_tier: KVTierConfig = dataclasses.field(
+        default_factory=KVTierConfig)
     speculative: SpeculativeConfig = dataclasses.field(
         default_factory=SpeculativeConfig)
     slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
@@ -765,6 +857,11 @@ class Config:
             # (same contract as zero_inference above); an explicit
             # "enabled": false still disables
             c.prefix_cache = PrefixCacheConfig.coerce(d["prefix_cache"])
+        if "kv_tier" in d:
+            # coerce, not from_dict: writing the block IS the opt-in
+            # (same contract as prefix_cache above); an explicit
+            # "enabled": false still disables
+            c.kv_tier = KVTierConfig.coerce(d["kv_tier"])
         if "speculative" in d:
             # coerce, not from_dict: writing the block IS the opt-in
             # (same contract as zero_inference / prefix_cache above);

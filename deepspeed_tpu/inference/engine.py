@@ -149,6 +149,11 @@ def init_serving(params, model_config, *, config: Any = None,
         # prefix caching in the engine (an explicit prefix_cache= kw
         # still wins)
         kw.setdefault("prefix_cache", config.prefix_cache)
+    if config is not None and config.kv_tier.enabled:
+        # `kv_tier` block → host/NVMe spill + cold-page quantization
+        # for the paged prefix pool (an explicit kv_tier= kw still
+        # wins); requires the prefix_cache block — the engine validates
+        kw.setdefault("kv_tier", config.kv_tier)
     if config is not None and config.speculative.enabled:
         # `speculative` block → draft-and-verify multi-token decode
         # (an explicit speculative= kw still wins; a model drafter

@@ -114,6 +114,19 @@ class PageAllocator:
     ``cache_pages=0`` (the default) disables publishing entirely and
     restores the classic free-list semantics: one owner per page,
     release returns pages immediately.
+
+    Tiering (the ZeRO-Infinity idea applied to KV pages): with a
+    ``spill`` pool installed (:class:`~deepspeed_tpu.inference.kv_tier.
+    KVTierPool`) and a ``demote_hook``, a warm page reclaimed by
+    ``_evict_one`` is offered to the hook first — on success the page's
+    KV survives on a host/NVMe tier and its content key keeps matching
+    through :meth:`lookup_tiered`, so eviction demotes instead of
+    forgetting.  Tier hits re-enter HBM through PROMOTION: the engine
+    allocates a fresh page, marks it with :meth:`begin_promotion`
+    (unmatchable and unreclaimable until the payload lands), and
+    :meth:`finish_promotion` publishes it once the upload completes.
+    ``available`` excludes in-flight promotions so admission can never
+    double-count a page as both warm and free.
     """
 
     def __init__(self, num_pages: int, cache_pages: int = 0,
@@ -133,11 +146,23 @@ class PageAllocator:
         self._tick = 0
         self.evicted = 0          # lifetime evicted-page count
         self.published = 0        # lifetime published-page count
+        # ---- KV tiering (installed by the engine when kv_tier is on)
+        self.spill = None         # KVTierPool: demoted-page index
+        self.demote_hook = None   # (page, key) -> bool: capture to tier
+        self.promoting = {}       # page -> key, promotion in flight
+        self._parked = []         # promoting pages released pre-landing
+        self.demoted = 0          # lifetime demoted-page count
+        self.promoted = 0         # lifetime promoted-page count
 
     @property
     def available(self) -> int:
         """Pages an ``allocate`` could obtain right now: the free list
-        plus the warm pool (reclaimed on demand)."""
+        plus the warm pool (reclaimed on demand).  Pages with an
+        in-flight promotion are structurally excluded — they are owned
+        (never in either list), ``_publish_full_pages`` skips them so
+        they cannot enter the warm pool, and ``release`` PARKS rather
+        than frees them — so an async upload can never land in a page
+        this count let someone else re-allocate."""
         return len(self.free) + len(self.pool)
 
     def allocate(self, seq_id, n: int = 1):
@@ -158,10 +183,41 @@ class PageAllocator:
     def _evict_one(self) -> int:
         p = min(self.pool, key=self.pool.get)
         del self.pool[p]
-        del self.index[self.key_of.pop(p)]
+        key = self.key_of.pop(p)
+        del self.index[key]
         self._published_at.pop(p, None)
-        self.evicted += 1
+        # demote instead of drop: the hook copies the page's KV to the
+        # spill tier (device->host), and the key keeps matching there —
+        # the physical page is reclaimed either way
+        if self.demote_hook is not None and self.demote_hook(p, key):
+            self.demoted += 1
+        else:
+            self.evicted += 1
         return p
+
+    def oldest_warm(self, n: int):
+        """The ``n`` oldest warm-pool pages with their keys — the
+        watermark-demotion candidates (bookkeeping untouched; pair with
+        :meth:`reclaim_warm` after the engine captured their KV)."""
+        order = sorted(self.pool, key=self.pool.get)[:max(n, 0)]
+        return [(p, self.key_of[p]) for p in order]
+
+    def reclaim_warm(self, pages, demoted: bool) -> None:
+        """Remove warm pages from the pool + index and free them,
+        counting them demoted (their KV lives on the spill tier now) or
+        evicted (dropped).  Pages that left the pool since
+        :meth:`oldest_warm` (revived by a share) are skipped."""
+        for p in pages:
+            if p not in self.pool:
+                continue
+            del self.pool[p]
+            del self.index[self.key_of.pop(p)]
+            self._published_at.pop(p, None)
+            self.free.append(p)
+            if demoted:
+                self.demoted += 1
+            else:
+                self.evicted += 1
 
     def lookup(self, keys):
         """Longest cached prefix: walk the chained keys in order and
@@ -173,6 +229,57 @@ class PageAllocator:
                 break
             pages.append(p)
         return pages
+
+    def lookup_tiered(self, keys):
+        """Longest cached prefix across ALL tiers: walk the chained
+        keys and return ``("hbm", page)`` / ``("tier", key)`` matches
+        up to the first total miss.  HBM wins when a span is in both
+        (a promoted page's spill copy is kept as a free re-demote)."""
+        out = []
+        for k in keys:
+            p = self.index.get(k)
+            if p is not None:
+                out.append(("hbm", p))
+                continue
+            if self.spill is not None and self.spill.has(k):
+                out.append(("tier", k))
+                continue
+            break
+        return out
+
+    # ------------------------------------------------------- promotion
+    # (tier hit -> fresh HBM page; the engine streams the payload back
+    # and calls finish; the page is quarantined from reclaim meanwhile)
+    def begin_promotion(self, page: int, key: bytes) -> None:
+        """Mark an allocated page as receiving a tier promotion: it
+        must not be published (content hasn't landed) nor ever handed
+        back out before :meth:`finish_promotion` or
+        :meth:`cancel_promotion` resolves it."""
+        if page not in self.refs:
+            raise ValueError(f"begin_promotion of unowned page {page}")
+        self.promoting[page] = key
+
+    def finish_promotion(self, page: int, key: bytes) -> bool:
+        """Payload landed: publish the page under its content key so
+        concurrent same-prefix admissions share it.  A page whose owner
+        vanished mid-flight (parked by ``release``) just frees.
+        Returns True when the page was newly indexed."""
+        self.promoting.pop(page, None)
+        if page in self._parked:
+            self._parked.remove(page)
+            self.free.append(page)
+            return False
+        self.promoted += 1
+        return self.publish(page, key)
+
+    def cancel_promotion(self, page: int) -> None:
+        """Abandon an in-flight promotion (preemption): the page stays
+        owned by its sequence (released through the normal path) unless
+        it was already parked, in which case it frees now."""
+        self.promoting.pop(page, None)
+        if page in self._parked:
+            self._parked.remove(page)
+            self.free.append(page)
 
     def share(self, seq_id, pages) -> None:
         """Map already-cached pages into ``seq_id``'s ownership with a
@@ -235,6 +342,11 @@ class PageAllocator:
                                 if self.eviction == "fifo" else self._tick)
                 while len(self.pool) > self.cache_pages:
                     self.free.append(self._evict_one())
+            elif p in self.promoting:
+                # released mid-promotion (preempt raced the upload):
+                # park until the promotion resolves — freeing now could
+                # hand the page to a new owner while the payload lands
+                self._parked.append(p)
             else:
                 self.free.append(p)
 

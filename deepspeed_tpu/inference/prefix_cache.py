@@ -24,10 +24,47 @@ constructed token sequence, not traffic.
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
-from typing import List, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 _SEED = b"dstpu-prefix-cache-v1"
+
+
+@dataclasses.dataclass
+class TierEntry:
+    """Index record of ONE demoted page: which tier holds its KV and in
+    what encoding.  The HBM index (``PageAllocator.index``) maps key →
+    physical page; everything evicted OUT of HBM keeps matching through
+    these records in the :class:`~deepspeed_tpu.inference.kv_tier.
+    KVTierPool` — the chained-key walk treats an entry in ANY tier as a
+    hit, it just re-admits through promotion instead of a refcount bump.
+
+    ``buffers`` is the per-buffer geometry of the spilled payload —
+    ``(name, shape, dtype)`` triples; 2 buffers (k, v) on the bit-exact
+    path, 4 (k codes, k scales, v codes, v scales) when the page was
+    quantized cold.  ``data`` holds the host arrays while the entry is
+    host-resident; an NVMe-resident entry's payload lives in the files
+    named by ``buffers`` and ``data`` is None."""
+
+    key: bytes
+    location: str                 # "host" | "nvme"
+    quantized: bool
+    dtype: str                    # the PAGE dtype promotion restores
+    buffers: Tuple[Tuple[str, tuple, str], ...]
+    nbytes: int
+    data: Optional[tuple] = None  # host arrays iff location == "host"
+    tick: int = 0                 # age for the host->nvme->drop cascade
+
+    @property
+    def names(self) -> List[str]:
+        return [b[0] for b in self.buffers]
+
+
+def key_hex(key: bytes) -> str:
+    """Canonical short form of a page key for file names / trace
+    attrs."""
+    return key.hex()
 
 
 def page_key(prev_key: bytes, span: Sequence[int]) -> bytes:

@@ -77,11 +77,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.config import (PrefixCacheConfig, SLOConfig,
-                                  SpeculativeConfig, TelemetryConfig,
-                                  TracingConfig)
+from deepspeed_tpu.config import (KVTierConfig, PrefixCacheConfig,
+                                  SLOConfig, SpeculativeConfig,
+                                  TelemetryConfig, TracingConfig)
 from deepspeed_tpu.inference.kernels import PagedKVCache, PageAllocator
 from deepspeed_tpu.inference.prefix_cache import (extend_page_keys,
+                                                  key_hex,
                                                   matchable_pages,
                                                   page_keys)
 from deepspeed_tpu.inference.speculative import (build_drafter,
@@ -144,6 +145,34 @@ class Request:
 
 
 @dataclasses.dataclass
+class _Promotion:
+    """One admission's in-flight tier→HBM page promotion: the demoted
+    keys being streamed back, their freshly allocated target pages, and
+    the double-buffered reader driving the transfer.  ``primed`` holds
+    group 0's presubmitted tier-read buffers (issued at admission so
+    NVMe reads overlap whatever the engine does before the slot's first
+    suffix-prefill chunk needs the pages); it stays None while the aio
+    priority group asks KV promotion to yield to layer-weight streams.
+    ``deferred`` counts the steps this slot's prefill stood aside so
+    the promotion could hide under other slots' compute."""
+
+    keys: List[bytes]
+    page_map: Dict[bytes, int]         # key -> target HBM page
+    reader: Any                        # param_stream.TierPageReader
+    primed: Optional[list] = None
+    t_start: float = 0.0
+    deferred: int = 0
+    channel: bool = False              # owns the NVMe read channel
+
+
+# promotion deferral cap: how many scheduler iterations one slot's
+# prefill may stand aside waiting for its tier reads (or for aio
+# priority) before it blocks on the fence — bounds starvation when the
+# promoting slot is the only work
+_KV_PROMO_DEFER_CAP = 16
+
+
+@dataclasses.dataclass
 class _Slot:
     req: Request
     seq_len: int                       # tokens resident in the KV cache
@@ -152,6 +181,7 @@ class _Slot:
     seq_id: int = -1                   # PageAllocator owner key
     prefill_done: int = -1             # chunked prefill progress; -1 = done
     last_tok_t: float = 0.0            # inter-token latency clock
+    promo: Optional[_Promotion] = None  # in-flight tier-page promotion
 
     @property
     def prefilling(self) -> bool:
@@ -177,7 +207,7 @@ class ServingEngine:
                  chunk_prefill_fn=None, mesh=None, telemetry=None,
                  prefix_cache=None, admit_lookahead: int = 4,
                  tracing=None, speculative=None, drafter=None,
-                 slo=None):
+                 slo=None, kv_tier=None):
         # Sharded serving (ref: deepspeed/module_inject/replace_module.py
         # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
         # inference): with a mesh, params arrive pre-sharded from the
@@ -442,6 +472,85 @@ class ServingEngine:
                 TracingConfig.coerce(tracing))
         self._trace_on = self.tracer.enabled
 
+        # ---- tiered KV cache (ZeRO-Infinity tiering for the prefix
+        # pool): published refcount-0 pages reclaimed under pressure
+        # demote to a host pool (spilling onward to NVMe) instead of
+        # dropping from the content index; tier hits re-admit through a
+        # double-buffered promotion overlapped with the uncached
+        # suffix's prefill chunks.  The allocator owns the index
+        # states; the KVTierPool owns the payloads; this engine owns
+        # the device<->host data movement.
+        kvt = KVTierConfig.coerce(kv_tier)
+        self.kv_tier = kvt
+        self._kvt_on = kvt.enabled
+        self._kv_pool = None
+        # slot whose in-flight promotion owns the NVMe read channel
+        # (host-resident promotions run concurrently and never claim it)
+        self._promo_channel: Optional[int] = None
+        self._kvt_wm_pages: Optional[int] = None
+        if self._kvt_on:
+            if not self._pc_on:
+                raise ValueError(
+                    "kv_tier needs the prefix_cache block — only "
+                    "published refcount-0 prefix-cache pages demote; "
+                    "without content addressing there is nothing to "
+                    "spill or match")
+            from deepspeed_tpu.inference.kv_tier import KVTierPool
+
+            self._kv_pool = KVTierPool(
+                kvt, page_shape=(n_layers, n_kv, page_size, head_dim),
+                page_dtype=cache_dtype, registry=self.registry)
+            self.allocator.spill = self._kv_pool
+            self.allocator.demote_hook = self._demote_for_evict
+            if kvt.demote_watermark < 1.0 and self.allocator.cache_pages:
+                self._kvt_wm_pages = int(
+                    kvt.demote_watermark * self.allocator.cache_pages)
+            # compile the promote scatter + every pow2 demote-gather
+            # bucket NOW (against the sacrificial trash page), off the
+            # serving critical path — the first real demote/promote
+            # must cost a DMA, not an XLA compile inside a request's
+            # TTFT
+            z = np.zeros((n_layers, n_kv, 1, page_size, head_dim),
+                         np.dtype(cache_dtype))
+            self._upload_promoted([self.trash_page], z, z)
+            n = 1
+            while True:
+                self._fetch_pages_host([self.trash_page] * n)
+                if n >= self.max_pages_per_seq:
+                    break
+                n *= 2
+            # biggest prewarmed gather bucket: batched demotions chunk
+            # their fetches to it so no sweep size compiles in-run
+            self._kvt_fetch_cap = n
+        self._c_kvt_demoted = r.counter(
+            "kv_tier_demoted_pages",
+            "warm pages captured to the host/NVMe tier instead of "
+            "being dropped (re-demotes of still-spilled spans count: "
+            "they kept a key matchable)")
+        self._c_kvt_promoted = r.counter(
+            "kv_tier_promoted_pages",
+            "demoted pages streamed back into fresh HBM pages on a "
+            "tier hit")
+        self._c_kvt_deferrals = r.counter(
+            "kv_tier_promote_deferrals",
+            "scheduler iterations a promoting slot's prefill stood "
+            "aside (promotion hiding under other slots' compute)")
+        self._c_kvt_admit_waits = r.counter(
+            "kv_tier_admit_waits",
+            "admission ATTEMPTS held back because the tier hit needed "
+            "the busy NVMe promotion channel (the admit loop may retry "
+            "a waiting request several times per scheduler iteration, "
+            "so this measures wait pressure, not distinct requests; "
+            "waiting keeps the demoted span a DMA instead of "
+            "re-prefilling it)")
+        self._g_kvt_inflight = r.gauge(
+            "kv_tier_promoting_pages",
+            "pages with a tier promotion in flight right now")
+        self._h_kvt_promote = r.histogram(
+            "kv_tier_promote_seconds",
+            "admission-submit -> pages-landed latency of one "
+            "promotion (all of its pages)")
+
         # ---- SLO & goodput accounting (the control-plane contract the
         # multi-replica router will route on): requests carry a tier,
         # are classified attained/violated at finish, and the tracker
@@ -665,41 +774,86 @@ class ServingEngine:
         token — cached-prefix tokens skip compute entirely."""
         T = len(req.tokens)
         ps = self.page_size
-        # ---- longest cached page-aligned prefix (chained-hash walk).
-        # At least one prompt token always prefills (the engine samples
-        # the first generated token from the last prompt position's
-        # logits), so a fully covered prompt gives up its final page.
-        matched: List[int] = []
+        # ---- longest cached page-aligned prefix (chained-hash walk
+        # across EVERY tier: HBM index hits share read-only as before;
+        # demoted spans on the host/NVMe tier are hits too, re-admitted
+        # through promotion).  At least one prompt token always
+        # prefills (the engine samples the first generated token from
+        # the last prompt position's logits), so a fully covered prompt
+        # gives up its final page.
+        matched: List[Tuple[str, Any]] = []
         if self._pc_on:
             if req.page_keys is None:
                 req.page_keys = page_keys(req.tokens, ps)
-            matched = self.allocator.lookup(
-                req.page_keys[:matchable_pages(T, ps)])
+            keys = req.page_keys[:matchable_pages(T, ps)]
+            if self._kvt_on:
+                matched = self.allocator.lookup_tiered(keys)
+                if self._promo_channel is not None and any(
+                        kind == "tier" and
+                        self._kv_pool.location(k) == "nvme"
+                        for kind, k in matched):
+                    # the NVMe read channel is single-consumer (one
+                    # promotion's alternating aio slots at a time).
+                    # Host-resident tier hits promote concurrently —
+                    # their reads are dict lookups — but an admission
+                    # needing NVMe bytes while another promotion owns
+                    # the channel WAITS: admitting with only the HBM
+                    # prefix would re-prefill a span that is sitting
+                    # demoted, turning a DMA back into compute.  The
+                    # lookahead window keeps other traffic admitting.
+                    self._c_kvt_admit_waits.inc()
+                    return False
+            else:
+                matched = [("hbm", p)
+                           for p in self.allocator.lookup(keys)]
         cm = len(matched)
         cached = cm * ps
+        hbm_pages = [p for kind, p in matched if kind == "hbm"]
+        tier_keys = [k for kind, k in matched if kind == "tier"]
         bkt = self.prefill_chunk or self.prefill_bucket
         # bucket-pad the UNCACHED suffix for a bounded compile count,
         # clamped to the table width (a prompt near max_seq must not
         # pad past the row)
         end = min(cached + -(-(T - cached) // bkt) * bkt,
                   self.max_pages_per_seq * ps)
-        need = self._pages_needed(max(end, T + 1)) - cm
+        # tier-matched spans skip prefill COMPUTE but still need fresh
+        # physical pages for the promoted payload to land in
+        need = self._pages_needed(max(end, T + 1)) - cm + len(tier_keys)
         # matched warm-pool pages revive rather than consume free pages,
         # but they stop being evictable once shared — the fresh-page
         # demand must be met WITHOUT counting them as reclaimable
-        pooled = sum(1 for p in matched if p in self.allocator.pool)
+        pooled = sum(1 for p in hbm_pages if p in self.allocator.pool)
         if self.allocator.available - pooled < need:
             return False
         seq_id = self._seq_counter
         self._seq_counter += 1
         # share BEFORE allocate: allocation pressure must never evict a
-        # page this very admission is about to map
-        if matched:
-            self.allocator.share(seq_id, matched)
+        # page this very admission is about to map.  (It MAY demote a
+        # warm page into the tier pool mid-allocate — the pool pins
+        # this admission's tier keys below, so the cascade can't drop
+        # the very entries about to be promoted.)
+        if tier_keys:
+            self._kv_pool.pin(tier_keys)
+        if hbm_pages:
+            self.allocator.share(seq_id, hbm_pages)
+        # batch-demote the shortfall up front: one device read for the
+        # whole admission instead of one per page inside _evict_one
+        self._ensure_free(need)
         pages = self.allocator.allocate(seq_id, need)
+        fresh = iter(pages)
+        row: List[int] = []
+        page_map: Dict[bytes, int] = {}
+        for kind, val in matched:
+            if kind == "hbm":
+                row.append(val)
+            else:
+                pg = next(fresh)
+                page_map[val] = pg
+                row.append(pg)
+        suffix = list(fresh)
         self._table_host[b, :] = self.trash_page
-        self._table_host[b, :cm] = matched
-        self._table_host[b, cm:cm + need] = pages
+        self._table_host[b, :cm] = row
+        self._table_host[b, cm:cm + len(suffix)] = suffix
         self._table_dirty = self._lens_dirty = True
         if self._pc_on:
             (self._c_pc_hits if cm else self._c_pc_misses).inc()
@@ -709,9 +863,13 @@ class ServingEngine:
             # BEFORE the prefill compute below: the trace's
             # admitted→first_token span is the prefill cost
             self.tracer.event("admitted", req.req_id, b, attrs={
-                "cached_tokens": cached, "queue_skips": queue_skips})
+                "cached_tokens": cached, "tier_pages": len(tier_keys),
+                "queue_skips": queue_skips})
 
         self._rng, rng = jax.random.split(self._rng)
+        promo = None
+        if tier_keys:
+            promo = self._begin_promotion(b, tier_keys, page_map)
         if self.prefill_chunk or cached:
             # split-fuse and/or cache-hit admission: the uncached
             # suffix is absorbed in continuation chunks starting at the
@@ -720,7 +878,7 @@ class ServingEngine:
             # absorbs prefill_bucket tokens per iteration.)
             self.slots[b] = _Slot(req=req, seq_len=cached, generated=[],
                                   rng=rng, seq_id=seq_id,
-                                  prefill_done=cached)
+                                  prefill_done=cached, promo=promo)
             self._c_admitted.inc()
             return True
 
@@ -783,8 +941,284 @@ class ServingEngine:
             page = int(self._table_host[b, slot_idx])
             if page == self.trash_page:
                 break
+            if page in self.allocator.promoting:
+                # in-flight promotion: the payload hasn't landed, so
+                # indexing this page now would serve garbage to every
+                # future match — finish_promotion publishes it
+                continue
             if self.allocator.publish(page, s.req.page_keys[slot_idx]):
                 self._c_pc_published.inc()
+
+    # ------------------------------------------------ KV tier: promote
+    def _begin_promotion(self, b: int, tier_keys: List[bytes],
+                         page_map: Dict[bytes, int]) -> _Promotion:
+        """Start streaming a tier-matched span back into the fresh HBM
+        pages just allocated for it.  The reader's group-0 reads are
+        presubmitted HERE (admission time) when the aio priority group
+        allows, so NVMe latency overlaps every step the engine runs
+        before this slot's first suffix-prefill chunk; the upload
+        itself happens in :meth:`_complete_promotion`, batched per
+        group, double-buffered against the next group's reads."""
+        from deepspeed_tpu.param_stream import TierPageReader
+
+        for key, pg in page_map.items():
+            self.allocator.begin_promotion(pg, key)
+        # pinned entries can neither drop nor spill, so a promotion
+        # whose keys are all host-resident stays channel-free: it
+        # reads through the pool's no-op-fencing host view and any
+        # number may be in flight.  Only an NVMe-backed promotion
+        # claims the single aio channel (and only it may fence or
+        # slot-toggle that channel).
+        channel = any(self._kv_pool.location(k) == "nvme"
+                      for k in tier_keys)
+        reader = TierPageReader(
+            self._kv_pool if channel else self._kv_pool.host_view(),
+            tier_keys, to_device=None,
+            group_pages=self.kv_tier.promote_group_pages,
+            registry=self.registry, tracer=self.tracer)
+        # bound late: the callback needs the reader's own group table
+        reader.to_device = lambda bufs, g: self._promote_group(
+            page_map, bufs, reader.group_keys(g))
+        promo = _Promotion(keys=list(tier_keys), page_map=page_map,
+                           reader=reader, channel=channel,
+                           t_start=time.perf_counter())
+        if channel:
+            self._promo_channel = b
+        # host-resident presubmit is pure dict lookups — never defer
+        # it on aio priority; only NVMe reads yield to weight streams
+        if not channel or self._kv_pool.may_submit():
+            promo.primed = reader.presubmit(0)
+        self._g_kvt_inflight.set(len(self.allocator.promoting))
+        return promo
+
+    def _promotion_ready(self, b: int, s: "_Slot") -> bool:
+        """Gate for the promoting slot's prefill: defer (bounded) while
+        the tier reads are still in flight — the promotion then hides
+        under other slots' compute — or while aio priority asks KV to
+        yield to layer-weight streams; once ready (or at the deferral
+        cap), drain the promotion and let prefill proceed."""
+        p = s.promo
+        if p.primed is None:
+            if self._kv_pool.may_submit() or \
+                    p.deferred >= _KV_PROMO_DEFER_CAP:
+                p.primed = p.reader.presubmit(0)
+            else:
+                p.deferred += 1
+                self._c_kvt_deferrals.inc()
+                return False
+        # only the channel owner's reads are on the aio queue — a
+        # host-resident promotion's buffers fenced for free at
+        # presubmit, so it never defers on another slot's reads
+        if p.channel and self._kv_pool.reads_pending() and \
+                p.deferred < _KV_PROMO_DEFER_CAP:
+            p.deferred += 1
+            self._c_kvt_deferrals.inc()
+            return False
+        self._complete_promotion(b, s)
+        return True
+
+    def _complete_promotion(self, b: int, s: "_Slot") -> None:
+        """Drain the slot's promotion: every group fences, dequantizes
+        and scatters into its target pages (group g+1's tier reads in
+        flight while group g uploads), then the pages publish under
+        their content keys — matchable for concurrent admissions."""
+        p = s.promo
+        for _ in p.reader.sweep(range(p.reader.n_groups),
+                                primed=p.primed):
+            pass
+        dt = time.perf_counter() - p.t_start
+        self._h_kvt_promote.observe(dt)
+        self._kv_pool.unpin(p.keys)
+        if s.req.traced:
+            self.tracer.event("kv_promote", s.req.req_id, b, attrs={
+                "pages": len(p.keys), "wait_s": round(dt, 6),
+                "deferred_steps": p.deferred})
+        s.promo = None
+        if p.channel and self._promo_channel == b:
+            self._promo_channel = None
+        self._g_kvt_inflight.set(len(self.allocator.promoting))
+
+    def _promote_group(self, page_map: Dict[bytes, int], bufs,
+                       g_keys) -> List[int]:
+        """TierPageReader ``to_device``: one fenced GROUP of spilled
+        pages → decode (dequantize cold pages) → one batched scatter
+        into the target HBM pages → publish."""
+        i = 0
+        pages, ks, vs = [], [], []
+        for key in g_keys:
+            names, _shapes, _dtypes = self._kv_pool.entry_meta(key)
+            take = bufs[i:i + len(names)]
+            i += len(names)
+            k, v = self._kv_pool.decode(key, take)
+            ks.append(k)
+            vs.append(v)
+            pages.append(page_map[key])
+        self._upload_promoted(pages, np.stack(ks, axis=2),
+                              np.stack(vs, axis=2))
+        for key, pg in zip(g_keys, pages):
+            if self.allocator.finish_promotion(pg, key):
+                self._c_pc_published.inc()
+        self._c_kvt_promoted.inc(len(g_keys))
+        return pages
+
+    def _cancel_promotion(self, s: "_Slot") -> None:
+        """Abandon a slot's in-flight promotion (preemption): fence any
+        outstanding tier reads (they target host buffers about to be
+        dropped), release the allocator quarantine, and let the pages
+        free through the normal release path.  The spill entries stay
+        — the recompute requeue will hit and promote them again."""
+        p = s.promo
+        if p is None:
+            return
+        if p.channel and p.primed is not None:
+            self._kv_pool.fence_all_reads()
+        for pg in p.page_map.values():
+            self.allocator.cancel_promotion(pg)
+        self._kv_pool.unpin(p.keys)
+        s.promo = None
+        if p.channel and self._promo_channel is not None:
+            self._promo_channel = None
+        self._g_kvt_inflight.set(len(self.allocator.promoting))
+
+    # ------------------------------------------------- KV tier: demote
+    def _fetch_idx(self, pages: List[int]):
+        """Bucket a page-id list to a power-of-two length (repeating
+        the last id) so the eager gather/scatter ops below compile a
+        BOUNDED set of shapes — a churning cache must not pay one XLA
+        compile per distinct batch size."""
+        n = len(pages)
+        cap = 1
+        while cap < n:
+            cap *= 2
+        return np.asarray(list(pages) + [pages[-1]] * (cap - n),
+                          np.int32), n
+
+    def _fetch_pages_host(self, pages: List[int]):
+        """Device→host copy of whole pages across the layer stack:
+        ``[L, KV, n, ps, Dh]`` (k, v).  The ZI engine overrides for its
+        per-layer cache tuples."""
+        idx, n = self._fetch_idx(pages)
+        k, v = jax.device_get((self.cache.k[:, :, idx],
+                               self.cache.v[:, :, idx]))
+        return np.asarray(k)[:, :, :n], np.asarray(v)[:, :, :n]
+
+    def _promote_idx(self, pages: List[int], k_host, v_host):
+        """Pad a promotion scatter to the FIXED promote group size:
+        pad lanes aim one past the page array and drop (the
+        ``write_token_pages`` trick), so every group — full, tail, or
+        short chain — runs the same compiled update."""
+        G = max(self.kv_tier.promote_group_pages, len(pages))
+        pad = G - len(pages)
+        idx = np.asarray(list(pages) + [self.trash_page + 1] * pad,
+                         np.int32)
+        if pad:
+            z = np.zeros(k_host.shape[:2] + (pad,) + k_host.shape[3:],
+                         k_host.dtype)
+            k_host = np.concatenate([k_host, z], axis=2)
+            v_host = np.concatenate([v_host, z], axis=2)
+        return jnp.asarray(idx), k_host, v_host
+
+    def _upload_promoted(self, pages: List[int], k_host, v_host) -> None:
+        """Scatter promoted payloads (``[L, KV, n, ps, Dh]``) into
+        their target pages.  One dispatch per array; jax's async
+        dispatch overlaps the H2D DMA with whatever device work is in
+        flight, and the first forward reading these pages orders after
+        the update through the value dependency."""
+        idx, k_host, v_host = self._promote_idx(pages, k_host, v_host)
+        self.cache = self.cache._replace(
+            k=self.cache.k.at[:, :, idx].set(
+                self._put(jnp.asarray(k_host)), mode="drop"),
+            v=self.cache.v.at[:, :, idx].set(
+                self._put(jnp.asarray(v_host)), mode="drop"))
+
+    def _demote_for_evict(self, page: int, key: bytes) -> bool:
+        """``PageAllocator.demote_hook``: capture an evicted warm
+        page's KV to the tier pool.  A span whose payload is already
+        spilled (promoted earlier, evicted again) re-demotes for free —
+        no device read, no copy."""
+        pool = self._kv_pool
+        if pool is None:
+            return False
+        if pool.has(key):
+            pool.touch(key)
+            self._c_kvt_demoted.inc()
+            return True
+        k, v = self._fetch_pages_host([page])
+        loc = pool.demote(key, k[:, :, 0], v[:, :, 0])
+        if loc is None:
+            return False
+        self._c_kvt_demoted.inc()
+        if self._trace_on:
+            self.tracer.event("kv_demote", attrs={
+                "key": key_hex(key)[:12], "tier": loc})
+        return True
+
+    def _demote_warm_batch(self, cands) -> None:
+        """Demote a batch of warm ``(page, key)`` candidates with ONE
+        batched device→host read (pages whose spans are already
+        spilled just refresh their age), then reclaim them to the free
+        list.  Shared by the watermark sweep and the pre-allocation
+        top-up — the per-page ``_evict_one`` hook stays only as the
+        fallback for pressure neither anticipated."""
+        al = self.allocator
+        fresh = [(p, k) for p, k in cands if not self._kv_pool.has(k)]
+        if fresh:
+            # fetch in precompiled-bucket chunks: a big watermark sweep
+            # over the whole warm pool must not trigger a fresh gather
+            # compile inside the serving step
+            cap = self._kvt_fetch_cap
+            kh_parts, vh_parts = [], []
+            for i in range(0, len(fresh), cap):
+                kc, vc = self._fetch_pages_host(
+                    [p for p, _ in fresh[i:i + cap]])
+                kh_parts.append(kc)
+                vh_parts.append(vc)
+            kh = np.concatenate(kh_parts, axis=2)
+            vh = np.concatenate(vh_parts, axis=2)
+        at = {p: i for i, (p, _) in enumerate(fresh)}
+        demoted, dropped = [], []
+        for p, key in cands:
+            if p in at:
+                i = at[p]
+                loc = self._kv_pool.demote(key, kh[:, :, i],
+                                           vh[:, :, i])
+            else:
+                loc = self._kv_pool.touch(key)
+            (demoted if loc else dropped).append(p)
+        al.reclaim_warm(demoted, demoted=True)
+        al.reclaim_warm(dropped, demoted=False)
+        if demoted:
+            self._c_kvt_demoted.inc(len(demoted))
+            if self._trace_on:
+                self.tracer.event("kv_demote", attrs={
+                    "pages": len(demoted)})
+
+    def _ensure_free(self, n: int) -> None:
+        """Top the free list up to ``n`` pages by batch-demoting the
+        oldest warm pages BEFORE an allocation dips into the warm
+        pool — one batched device read per shortfall instead of one
+        synchronous per-page copy inside each ``_evict_one``."""
+        if not self._kvt_on:
+            return
+        al = self.allocator
+        short = n - len(al.free)
+        if short <= 0:
+            return
+        cands = al.oldest_warm(short)
+        if cands:
+            self._demote_warm_batch(cands)
+
+    def _demote_watermark_sweep(self) -> None:
+        """Proactive demotion: when the warm pool fills past the
+        ``demote_watermark`` fraction of its cap, the oldest warm pages
+        demote in ONE batched device→host read — freeing HBM pages
+        ahead of allocation pressure so admissions stop paying the
+        per-eviction copy on their own critical path."""
+        al = self.allocator
+        excess = len(al.pool) - self._kvt_wm_pages
+        if excess <= 0:
+            return
+        self._demote_warm_batch(al.oldest_warm(excess))
 
     def _advance_prefill(self, b: int, s: "_Slot") -> None:
         """Absorb the next chunk of slot ``b``'s prompt (one fixed-shape
@@ -795,7 +1229,13 @@ class ServingEngine:
         Chunk size is ``prefill_chunk`` under split-fuse; a cache-hit
         admission with ``prefill_chunk=0`` absorbs its uncached suffix
         ``prefill_bucket`` tokens per iteration through the same path
-        (history = the shared cached pages)."""
+        (history = the shared cached pages).  A slot with an in-flight
+        tier promotion must not run its first chunk before the promoted
+        pages land (the chunk attends over them); it defers — bounded —
+        while the reads are still in flight, hiding the promotion under
+        the other slots' compute in the same scheduler iteration."""
+        if s.promo is not None and not self._promotion_ready(b, s):
+            return
         C = self.prefill_chunk or self.prefill_bucket
         T = len(s.req.tokens)
         done = s.prefill_done
@@ -851,6 +1291,11 @@ class ServingEngine:
         # any same-prefix request) re-admits against its own cached
         # prefix — preemption releases REFERENCES, not page contents
         self._publish_full_pages(b, s, upto=self._valid_tokens(s))
+        # promotion pages were skipped by the publish guard above; now
+        # fence + abandon the in-flight transfer before release frees
+        # them (the spill entries survive for the recompute to re-hit)
+        if s.promo is not None:
+            self._cancel_promotion(s)
         self.allocator.release(s.seq_id)
         self._table_host[b, :] = self.trash_page
         self._table_dirty = self._lens_dirty = True
@@ -889,10 +1334,17 @@ class ServingEngine:
         if not self._pending_boundary:
             return
         pend, self._pending_boundary = self._pending_boundary, []
-        rows = jnp.stack([p[1] for p in pend])
-        keys = jnp.stack([p[2] for p in pend])
-        temps = np.asarray([p[3] for p in pend], np.float32)
-        toks = np.asarray(_sample_rows(rows, keys, self._put(temps)))
+        # pad to max_batch: the pending count varies per step (1 slot
+        # finishing prefill … all of them under a cache-hit burst) and
+        # _sample_rows would compile once per distinct size — pay one
+        # fixed shape instead, row count is bounded by max_batch anyway
+        pad = self.max_batch - len(pend)
+        rows = [p[1] for p in pend] + [pend[0][1]] * pad
+        keys = [p[2] for p in pend] + [pend[0][2]] * pad
+        temps = np.zeros((self.max_batch,), np.float32)
+        temps[:len(pend)] = [p[3] for p in pend]
+        toks = np.asarray(_sample_rows(
+            jnp.stack(rows), jnp.stack(keys), self._put(temps)))
         self._c_boundary_syncs.inc()
         for (b, _, _, _), tok in zip(pend, toks):
             self._append_token(b, int(tok))
@@ -971,6 +1423,7 @@ class ServingEngine:
                         break
                 if self.slots[b] is None:
                     break
+                self._ensure_free(1)
                 pg = self.allocator.allocate(s.seq_id, 1)[0]
                 self._table_host[b, slot_idx] = pg
                 self._table_dirty = True
@@ -999,6 +1452,11 @@ class ServingEngine:
         return list(self._newly_finished)
 
     def _step_inner(self) -> None:
+        if self._kvt_wm_pages is not None:
+            # BEFORE admission: proactively demoting past the
+            # watermark frees pages the admissions below can use
+            # without paying a per-eviction device read each
+            self._demote_watermark_sweep()
         while self._admit_one():
             pass
         # split-fuse: absorb ONE chunk per pending-prefill slot, then run
@@ -1296,6 +1754,19 @@ class ServingEngine:
                 "token_hit_rate": round(
                     self._c_pc_cached_tokens.value / pt, 4) if pt
                 else 0.0,
+            },
+            "kv_tier": {
+                "enabled": self._kvt_on,
+                **(self._kv_pool.occupancy() if self._kv_pool is not None
+                   else {}),
+                "quantize_cold": self.kv_tier.quantize_cold
+                if self._kvt_on else False,
+                "demoted_lifetime": al.demoted,
+                "promoted_lifetime": al.promoted,
+                "promoting_pages": len(al.promoting),
+                "promote_stall_s": round(
+                    float(self._h_kvt_promote.sum), 6)
+                if self._kvt_on else 0.0,
             },
             "speculative": {
                 "enabled": self._spec_on,
@@ -1666,6 +2137,15 @@ def serving_engine(params, cfg, **kw):
         # to share — fail loudly, never silently serve uncached
         raise NotImplementedError(
             f"prefix_cache needs the paged-KV decode path, which "
+            f"{type(cfg).__name__} does not serve — supported: "
+            "LlamaConfig, MixtralConfig, GPT2Config")
+    kvt = kw.pop("kv_tier", None)
+    if kvt is not None and KVTierConfig.coerce(kvt).enabled:
+        # the tiered KV cache spills PAGES of the prefix pool; encoder
+        # families have neither — fail loudly, never silently drop the
+        # capacity the block was written for
+        raise NotImplementedError(
+            f"kv_tier needs the paged-KV decode path, which "
             f"{type(cfg).__name__} does not serve — supported: "
             "LlamaConfig, MixtralConfig, GPT2Config")
     if isinstance(cfg, BertConfig):

@@ -249,6 +249,20 @@ class ZeroInferenceServingEngine(ServingEngine):
             # the tracer): a slow request's trace shows WHICH layer's
             # tier fence it sat behind
             tracer=self.tracer)
+        # KV-tier promotion and the layer-weight stream share the same
+        # storage device when both tiers are NVMe: register the weight
+        # read pools ABOVE the KV pool in a cooperative priority group,
+        # so a KV promote defers (bounded by the engine's deferral cap)
+        # while layer fetches are in flight — the decode sweep's
+        # double-buffered weight reads are a whole-batch stall if
+        # starved, a deferred promotion only delays one admission
+        if self._kv_pool is not None and isinstance(self.tier, _NvmeTier):
+            from deepspeed_tpu.io.aio import AioPriorityGroup
+
+            grp = AioPriorityGroup()
+            for h in self.tier.rpools:
+                grp.register(h.pending, 1)
+            self._kv_pool.set_priority(grp, 0)
         self._stem_dev = self._place(stem, stem_specs)
         if "embed" in head and head["embed"] is stem["embed"]:
             # tied embeddings: hand head the ALREADY-PLACED table so the
@@ -427,6 +441,30 @@ class ZeroInferenceServingEngine(ServingEngine):
         cache = cache._replace(k=tuple(k_list), v=tuple(v_list),
                                seq_lens=lens + K)
         return jnp.stack(cols, axis=1), cache
+
+    # ----------------------------------------------- KV tier page moves
+    # (the base engine's demote/promote data paths assume the stacked
+    # [L, KV, P, ps, Dh] cache; this engine's cache is a per-layer
+    # TUPLE so block programs can donate one layer's pages — the tier
+    # payload layout [L, KV, n, ps, Dh] stays identical, only the
+    # gather/scatter changes)
+    def _fetch_pages_host(self, pages):
+        idx, n = self._fetch_idx(pages)
+        ks = jax.device_get(tuple(k[:, idx] for k in self.cache.k))
+        vs = jax.device_get(tuple(v[:, idx] for v in self.cache.v))
+        return (np.stack([np.asarray(k) for k in ks])[:, :, :n],
+                np.stack([np.asarray(v) for v in vs])[:, :, :n])
+
+    def _upload_promoted(self, pages, k_host, v_host) -> None:
+        idx, k_host, v_host = self._promote_idx(pages, k_host, v_host)
+        k_list, v_list = list(self.cache.k), list(self.cache.v)
+        for l in range(len(k_list)):
+            k_list[l] = k_list[l].at[:, idx].set(
+                jnp.asarray(k_host[l]), mode="drop")
+            v_list[l] = v_list[l].at[:, idx].set(
+                jnp.asarray(v_host[l]), mode="drop")
+        self.cache = self.cache._replace(k=tuple(k_list),
+                                         v=tuple(v_list))
 
     # ------------------------------------------------------- inspection
     def statusz(self) -> Dict[str, Any]:

@@ -51,6 +51,42 @@ def _ensure_lib() -> Optional[ctypes.CDLL]:
     return lib
 
 
+class AioPriorityGroup:
+    """Cooperative priority among aio users sharing one storage device.
+
+    The C++ pool has no notion of priority, so consumers that share a
+    disk coordinate host-side: each registers a non-blocking
+    ``pending_fn`` (typically ``AioHandle.pending``) with a priority,
+    and a lower-priority consumer polls :meth:`busy_above` before
+    submitting a batch — deferring while any higher-priority member has
+    ops in flight.  The ZeRO-Inference engine registers its layer-
+    weight read pools ABOVE the KV-tier promotion channel: a decode
+    sweep stalled on layer weights is a whole-batch stall, while a
+    deferred KV promotion only delays one admission's prefill — so KV
+    promotes yield, and layer fetches are never starved.  Callers must
+    bound their own deferral (the serving engine caps promotion
+    deferrals) so yielding never becomes starvation in the other
+    direction."""
+
+    def __init__(self):
+        self._members: List = []   # (pending_fn, priority)
+
+    def register(self, pending_fn, priority: int) -> None:
+        self._members.append((pending_fn, int(priority)))
+
+    def busy_above(self, priority: int) -> bool:
+        """True when any member registered above ``priority`` has
+        submitted-but-unfinished ops."""
+        for fn, prio in self._members:
+            if prio > priority:
+                try:
+                    if fn() > 0:
+                        return True
+                except Exception:
+                    continue
+        return False
+
+
 class AioHandle:
     """ref: deepspeed.ops.aio aio_handle(block_size, queue_depth, ...)."""
 

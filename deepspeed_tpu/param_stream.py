@@ -160,23 +160,46 @@ class TierLayerReader:
                 f"{prefix}_wait_seconds",
                 "time blocked on a tier fence (exposed IO cost)")
 
+    def _meta(self, l: int):
+        """``(names, shapes, dtypes, nbytes)`` of item ``l``'s tier
+        reads.  The default geometry is FIXED across items (every layer
+        shares shapes); subclasses with per-item geometry — the KV-page
+        promotion reader, whose items are groups of pages that may mix
+        quantized/plain encodings — override this one hook and inherit
+        the whole double-buffered pipeline."""
+        return self.names_fn(l), self.shapes, self.dtypes, \
+            self._layer_bytes
+
     def _submit(self, l: int):
+        names, shapes, dtypes, nbytes = self._meta(l)
         if self._trace_on:
             self._tracer.event(f"{self._prefix}_fetch_issue", attrs={
-                "layer": l, "bytes": self._layer_bytes})
+                "layer": l, "bytes": nbytes})
         return [self.tier.get_submit(n, s, d)
-                for n, s, d in zip(self.names_fn(l), self.shapes,
-                                   self.dtypes)]
+                for n, s, d in zip(names, shapes, dtypes)]
 
-    def sweep(self, order, on_wait=None):
+    def presubmit(self, l: int):
+        """Submit item ``l``'s tier reads NOW, outside the sweep
+        generator (generators are lazy — the first ``_submit`` would
+        otherwise wait for the first ``next()``), and return the
+        pending buffers; hand them to :meth:`sweep` via ``primed=`` so
+        consumption continues the pipeline.  The KV promotion path uses
+        this to start an admission's NVMe reads at admission time, so
+        they overlap every step the engine runs before the first
+        suffix-prefill chunk needs the pages."""
+        return self._submit(l)
+
+    def sweep(self, order, on_wait=None, primed=None):
         """Yield ``(l, device_tree)`` over ``order`` with the next
         layer's reads/upload in flight; ``on_wait(seconds)`` reports
-        time blocked on a fence (the exposed — non-hidden — IO cost)."""
+        time blocked on a fence (the exposed — non-hidden — IO cost).
+        ``primed``: buffers from :meth:`presubmit` of ``order[0]``."""
         order = list(order)
         if not order:
             return
         if self._nvme:
-            pending = self._submit(order[0])
+            pending = primed if primed is not None \
+                else self._submit(order[0])
             for i, l in enumerate(order):
                 hit = self.tier.reads_pending() == 0
                 if hit:
@@ -203,7 +226,7 @@ class TierLayerReader:
                             f"{self._prefix}_stall",
                             attrs={"layer": l, "wait_s": dt})
                 self.tier.next_read_slot()
-                self._c_bytes.inc(self._layer_bytes)
+                self._c_bytes.inc(self._meta(l)[3])
                 bufs = pending
                 if i + 1 < len(order):
                     pending = self._submit(order[i + 1])
@@ -219,7 +242,7 @@ class TierLayerReader:
             while idx < len(order) and len(ready) < self.depth:
                 nxt = order[idx]
                 idx += 1
-                self._c_bytes.inc(self._layer_bytes)
+                self._c_bytes.inc(self._meta(nxt)[3])
                 ready.append((nxt, self.to_device(self._submit(nxt), nxt)))
 
         pump()
@@ -227,6 +250,62 @@ class TierLayerReader:
             l, tree = ready.popleft()
             pump()            # next uploads dispatch before l's compute
             yield l, tree
+
+
+class TierPageReader(TierLayerReader):
+    """Double-buffered tier→HBM promotion pipeline for demoted KV
+    pages, sharing the :class:`TierLayerReader` core.
+
+    Where the layer reader's items are transformer layers, this
+    reader's items are GROUPS of demoted pages (``group_pages`` per
+    item): while group ``g`` is being fenced, dequantized and uploaded
+    into its freshly allocated HBM pages (the ``to_device`` callback —
+    the serving engine's batched page scatter), group ``g+1``'s tier
+    reads are already in flight — NVMe aio on the pool's alternating
+    slots, or zero-copy host arrays that fence for free.  A 100k-token
+    promoted prefix therefore streams at link speed instead of paying
+    one exposed read per page.
+
+    ``pool`` is a :class:`~deepspeed_tpu.inference.kv_tier.KVTierPool`
+    (the ``_Tier`` read interface plus per-entry geometry); per-item
+    shapes come from the pool's entry records via the ``_meta`` hook,
+    since a group may mix 2-buffer bit-exact and 4-buffer quantized
+    encodings.  ONE reader streams through a pool at a time — the
+    engine serializes admissions with tier hits."""
+
+    def __init__(self, pool, keys, to_device, group_pages: int = 8,
+                 registry=None, prefix: str = "kv_tier", tracer=None):
+        group_pages = max(1, int(group_pages))
+        self._pool = pool
+        self._groups = [list(keys[i:i + group_pages])
+                        for i in range(0, len(keys), group_pages)]
+        super().__init__(pool, names_fn=lambda g: [], shapes=(),
+                         dtypes=(), to_device=to_device, depth=1,
+                         registry=registry, prefix=prefix, tracer=tracer)
+        # always the aio-style submit/fence path: host-resident entries
+        # report zero pending reads, so they fence free and count as
+        # prefetch hits — one pipeline serves mixed host/NVMe chains
+        self._nvme = True
+        self.depth = 1
+
+    @property
+    def n_groups(self) -> int:
+        return len(self._groups)
+
+    def group_keys(self, g: int):
+        return self._groups[g]
+
+    def _meta(self, g: int):
+        names, shapes, dtypes = [], [], []
+        nbytes = 0
+        for key in self._groups[g]:
+            n, s, d = self._pool.entry_meta(key)
+            names += n
+            shapes += list(s)
+            dtypes += list(d)
+            nbytes += sum(int(np.prod(sh)) * np.dtype(dt).itemsize
+                          for sh, dt in zip(s, d))
+        return names, shapes, dtypes, int(nbytes)
 
 
 @dataclasses.dataclass

@@ -65,6 +65,7 @@ _TRACKS = (
     ("zi_", "zero_inference"),
     ("tier_", "tier_reader"),
     ("spec_", "speculative"),
+    ("kv_", "kv_tier"),
 )
 # NOTE: spec_accept is per-request (rides the request's async span as an
 # instant, with drafted/accepted attrs); the batch-level speculation
@@ -72,7 +73,12 @@ _TRACKS = (
 # "speculative" track via the prefix table above
 _SERVING_PHASES = frozenset((
     "queued", "admitted", "prefill_chunk", "first_token", "decode_batch",
-    "preempt", "requeue", "finish", "spec_accept"))
+    "preempt", "requeue", "finish", "spec_accept", "kv_promote"))
+# NOTE: kv_promote is per-request (the promotion that gated THIS
+# request's prefill rides its async span as an instant, attrs carry
+# pages + wait_s, so the waterfall shows promotion time inside TTFT);
+# batch-level demotions (kv_demote) stay on the "kv_tier" track via the
+# prefix table above
 
 # every enabled tracer registers here so a postmortem (watchdog
 # timeout, excepthook, SIGUSR1) can dump ALL live recorders without a
@@ -470,6 +476,38 @@ def attach_speculation(per: Dict[Any, Dict[str, float]],
             (srec["accepted"] + srec["sweeps"]) / srec["sweeps"], 4)
 
 
+def kv_tier_summary(kv: Dict[Any, Dict[str, float]]
+                    ) -> Optional[Dict[str, Any]]:
+    """Fleet-level KV-tier promotion totals from per-request
+    ``kv_promote`` accumulations (``{req: {pages, wait_s}}``) — shared
+    by :func:`request_breakdown` and ``tools/trace_report.py``'s Chrome
+    ingestion.  ``wait_s`` is each promotion's submit→landed latency,
+    which sits INSIDE the request's TTFT: the number that says whether
+    an evicted prefix cost a DMA or a stall."""
+    if not kv:
+        return None
+    return {
+        "promotions": len(kv),
+        "promoted_pages": int(sum(r["pages"] for r in kv.values())),
+        "promote_wait_s": round(
+            sum(r["wait_s"] for r in kv.values()), 6),
+    }
+
+
+def attach_kv_promotions(per: Dict[Any, Dict[str, float]],
+                         kv: Dict[Any, Dict[str, float]]) -> None:
+    """Fold per-request promotion accumulations into the waterfall
+    rows (``kv_promote_s``/``kv_promoted_pages``).  Requests whose
+    lifecycle edges the ring already lost are skipped, like
+    :func:`attach_speculation`."""
+    for req, krec in kv.items():
+        row = per.get(req)
+        if row is None:
+            continue
+        row["kv_promote_s"] = round(krec["wait_s"], 6)
+        row["kv_promoted_pages"] = int(krec["pages"])
+
+
 def summarize_components(per: Dict[Any, Dict[str, float]],
                          stall_s: float = 0.0) -> Dict[str, Any]:
     """p50/p95/mean summary over per-request component rows — the one
@@ -478,7 +516,7 @@ def summarize_components(per: Dict[Any, Dict[str, float]],
     summary: Dict[str, Any] = {"requests": len(per),
                                "stream_stall_s": round(stall_s, 6)}
     for comp in ("queue_wait_s", "prefill_s", "decode_s", "ttft_s",
-                 "total_s"):
+                 "total_s", "kv_promote_s"):
         vals = [r[comp] for r in per.values() if comp in r]
         if vals:
             summary[comp] = {
@@ -502,6 +540,7 @@ def request_breakdown(events: List[Event]) -> Dict[str, Any]:
     span to amortized verify sweeps."""
     edges: Dict[Any, Dict[str, int]] = {}
     spec: Dict[Any, Dict[str, int]] = {}
+    kv: Dict[Any, Dict[str, float]] = {}
     stall_s = 0.0
     for t, req, slot, phase, attrs in events:
         if phase.endswith("_stall") and attrs:
@@ -514,6 +553,11 @@ def request_breakdown(events: List[Event]) -> Dict[str, Any]:
             srec["sweeps"] += 1
             srec["drafted"] += int((attrs or {}).get("drafted", 0))
             srec["accepted"] += int((attrs or {}).get("accepted", 0))
+            continue
+        if phase == "kv_promote":
+            krec = kv.setdefault(req, {"pages": 0, "wait_s": 0.0})
+            krec["pages"] += int((attrs or {}).get("pages", 0))
+            krec["wait_s"] += float((attrs or {}).get("wait_s", 0.0))
             continue
         r = edges.setdefault(req, {})
         if phase == "finish":
@@ -538,10 +582,14 @@ def request_breakdown(events: List[Event]) -> Dict[str, Any]:
         if row:
             per[req] = row
     attach_speculation(per, spec)
+    attach_kv_promotions(per, kv)
     summary = summarize_components(per, stall_s)
     sp = speculation_summary(spec)
     if sp:
         summary["speculation"] = sp
+    kt = kv_tier_summary(kv)
+    if kt:
+        summary["kv_tier"] = kt
     return {"requests": per, "summary": summary}
 
 

@@ -64,6 +64,16 @@ def render(status: dict, health: dict | None = None) -> list:
                  f"  hit-rate {pc.get('token_hit_rate', 0.0):.3f}"
                  f"  published {pc.get('published_lifetime', 0)}"
                  f"  evicted {pc.get('evicted_lifetime', 0)}")
+    kt = status.get("kv_tier", {})
+    if kt.get("enabled"):
+        L.append(f"tier  host {kt.get('host_pages', 0)}p/"
+                 f"{kt.get('host_bytes', 0) / 1e6:.0f}MB"
+                 f"  nvme {kt.get('nvme_pages', 0)}p/"
+                 f"{kt.get('nvme_bytes', 0) / 1e6:.0f}MB"
+                 f"  demoted {kt.get('demoted_lifetime', 0)}"
+                 f"  promoted {kt.get('promoted_lifetime', 0)}"
+                 f"  stall {kt.get('promote_stall_s', 0.0):.2f}s"
+                 f"{'  int8' if kt.get('quantize_cold') else ''}")
     sp = status.get("speculative", {})
     if sp.get("enabled"):
         mal = sp.get("mean_accept_len")

@@ -55,6 +55,19 @@ timeout -k 10 900 env JAX_PLATFORMS=cpu python bench_serving.py --cpu \
   --new-tokens 96 --cpu-dim 512 --cpu-layers 4 --repeats 2 \
   --json-out "$REPO/SPEC_BENCH.json" >/dev/null 2>&1 || true
 
+# tiered-KV A/B: the eviction-churn workload (4 shared prefixes over a
+# pool holding ~1.5) served with the host/NVMe spill tier off vs on,
+# plus the no-eviction oracle row the token-identity gate compares
+# against — stamps KV_TIER_BENCH.json, best-effort like the samples.
+# --prefill-chunk 16 = split-fuse absorption, the production serving
+# mode where a re-prefill costs prefix_len/16 chunk sweeps (the whole-
+# prompt flash path is one fused dispatch and hides the cost on a CPU
+# toy); --cpu-dim 256 puts real weight reads under each chunk
+timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_serving.py --cpu \
+  --kv-tier --requests 32 --new-tokens 16 --cpu-dim 256 --cpu-layers 2 \
+  --prefill-chunk 16 --repeats 2 \
+  --json-out "$REPO/KV_TIER_BENCH.json" >/dev/null 2>&1 || true
+
 # trace selftest: a short traced serving workload, Chrome-export
 # validation (matched async spans, monotonic ts) + the trace-vs-
 # telemetry TTFT cross-check, stamped into TRACE_SAMPLE.json —

@@ -43,6 +43,7 @@ def breakdown_from_chrome(trace: dict) -> dict:
     spans = {}   # (id, name) -> [begin_ts, end_ts] in us
     truncated = set()
     spec = {}    # id -> {sweeps, drafted, accepted} from spec_accept
+    kv = {}      # id -> {pages, wait_s} from kv_promote
     for ev in trace.get("traceEvents", []):
         if ev.get("cat") != "request":
             continue
@@ -53,6 +54,12 @@ def breakdown_from_chrome(trace: dict) -> dict:
             rec["sweeps"] += 1
             rec["drafted"] += int(args.get("drafted", 0))
             rec["accepted"] += int(args.get("accepted", 0))
+            continue
+        if ev.get("ph") == "n" and ev.get("name") == "kv_promote":
+            args = ev.get("args") or {}
+            rec = kv.setdefault(ev["id"], {"pages": 0, "wait_s": 0.0})
+            rec["pages"] += int(args.get("pages", 0))
+            rec["wait_s"] += float(args.get("wait_s", 0.0))
             continue
         if ev.get("ph") not in ("b", "e"):
             continue
@@ -83,17 +90,24 @@ def breakdown_from_chrome(trace: dict) -> dict:
                 for ev in trace.get("traceEvents", [])
                 if ev.get("ph") == "X"
                 and str(ev.get("name", "")).endswith("_stall"))
-    from deepspeed_tpu.request_trace import (attach_speculation,
+    from deepspeed_tpu.request_trace import (attach_kv_promotions,
+                                             attach_speculation,
+                                             kv_tier_summary,
                                              speculation_summary,
                                              summarize_components)
 
     spec = {rid: rec for rid, rec in spec.items()
             if rid not in truncated}
+    kv = {rid: rec for rid, rec in kv.items() if rid not in truncated}
     attach_speculation(per, spec)
+    attach_kv_promotions(per, kv)
     summary = summarize_components(per, stall)
     sp = speculation_summary(spec)
     if sp:
         summary["speculation"] = sp
+    kt = kv_tier_summary(kv)
+    if kt:
+        summary["kv_tier"] = kt
     if truncated:
         summary["truncated_requests"] = sorted(str(r) for r in truncated)
     return {"requests": per, "summary": summary}
@@ -135,7 +149,7 @@ def print_report(bd: dict, limit: int = 20) -> None:
         print(f"... {len(per) - len(shown)} more requests")
     print("\ncritical path (seconds):")
     for comp in ("queue_wait_s", "prefill_s", "decode_s", "ttft_s",
-                 "total_s"):
+                 "total_s", "kv_promote_s"):
         if comp in summary:
             c = summary[comp]
             print(f"  {comp:<13} p50={c['p50']:.4f}  p95={c['p95']:.4f}  "
@@ -152,6 +166,13 @@ def print_report(bd: dict, limit: int = 20) -> None:
               f"({sp['rejected_tokens']} rolled back), "
               f"mean accept len {sp['mean_accept_len']:.2f} "
               f"tokens/sweep")
+    kt = summary.get("kv_tier")
+    if kt:
+        # promotion waits sit INSIDE prefill/TTFT: an evicted prefix
+        # that cost a DMA shows here instead of as re-prefill compute
+        print(f"  kv_tier: {kt['promotions']} promotions, "
+              f"{kt['promoted_pages']} pages streamed back, "
+              f"{kt['promote_wait_s']:.4f}s inside TTFT")
     if summary.get("truncated_requests"):
         print(f"  still in flight at export (excluded from stats): "
               f"{', '.join(summary['truncated_requests'])}")
