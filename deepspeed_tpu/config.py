@@ -79,11 +79,27 @@ class ZeroInferenceConfig:
     tier: str = "host"                   # host | nvme
     nvme_path: str = "/tmp/dstpu_nvme_swap"
     dtype: Optional[str] = None          # None (inherit) | bfloat16 | int8
+    # bounded retry for transient tier-read failures: a failed stream
+    # fence resubmits up to io_retries times (exponential backoff from
+    # io_retry_backoff_s), then falls over to a synchronous read of the
+    # tier file before raising a structured fatal with a postmortem
+    io_retries: int = 2
+    io_retry_backoff_s: float = 0.05
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ZeroInferenceConfig":
         known = {f.name for f in dataclasses.fields(cls)}
         z = cls(**{k: v for k, v in d.items() if k in known})
+        z.io_retries = int(z.io_retries)
+        z.io_retry_backoff_s = float(z.io_retry_backoff_s)
+        if z.io_retries < 0:
+            raise ValueError(
+                f"zero_inference.io_retries must be >= 0, got "
+                f"{z.io_retries}")
+        if z.io_retry_backoff_s < 0:
+            raise ValueError(
+                f"zero_inference.io_retry_backoff_s must be >= 0, got "
+                f"{z.io_retry_backoff_s}")
         if z.tier not in ("host", "nvme"):
             raise ValueError(
                 f"zero_inference.tier must be 'host' or 'nvme', got "
@@ -229,6 +245,14 @@ class KVTierConfig:
     demote_watermark: float = 1.0
     promote_group_pages: int = 8
     aio_threads: int = 4
+    # robustness knobs: bounded promote-read retry (resubmit + backoff,
+    # then a synchronous file read, before the engine falls back to
+    # re-prefill), and a circuit breaker — disable_after consecutive
+    # failed promotions disable the tier (demotes become plain
+    # evictions, tier lookups miss; 0 = never disable)
+    io_retries: int = 2
+    io_retry_backoff_s: float = 0.05
+    disable_after: int = 4
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "KVTierConfig":
@@ -238,6 +262,20 @@ class KVTierConfig:
         k.promote_group_pages = int(k.promote_group_pages)
         k.aio_threads = int(k.aio_threads)
         k.demote_watermark = float(k.demote_watermark)
+        k.io_retries = int(k.io_retries)
+        k.io_retry_backoff_s = float(k.io_retry_backoff_s)
+        k.disable_after = int(k.disable_after)
+        if k.io_retries < 0:
+            raise ValueError(
+                f"kv_tier.io_retries must be >= 0, got {k.io_retries}")
+        if k.io_retry_backoff_s < 0:
+            raise ValueError(
+                f"kv_tier.io_retry_backoff_s must be >= 0, got "
+                f"{k.io_retry_backoff_s}")
+        if k.disable_after < 0:
+            raise ValueError(
+                f"kv_tier.disable_after must be >= 0 (0 = never), got "
+                f"{k.disable_after}")
         if k.host_pool_bytes < 0:
             raise ValueError(
                 f"kv_tier.host_pool_bytes must be >= 0, got "
@@ -472,6 +510,63 @@ class SLOConfig:
             return cls.from_dict(d)
         raise TypeError(
             f"slo must be a bool, dict or SLOConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
+class FaultsConfig:
+    """Deterministic fault-injection block (robustness testing; see
+    :mod:`deepspeed_tpu.faults`).  ``rules`` is a list of rule dicts —
+    ``{"subsystem": "aio_read", "rate": 0.5, "count": 3, ...}`` — each
+    addressable by subsystem, firing rate, trigger count, skip-after
+    offset, optional ``latency_s`` (mode "latency") and ``match``
+    substring filter; ``seed`` makes the whole schedule reproducible.
+    The serving engine builds a :class:`~deepspeed_tpu.faults.
+    FaultPlan` from the block and installs it process-wide for the
+    aio/tier hook points; with the block off every hook is one branch.
+
+    This is a TEST/CHAOS facility: never enable it on a production
+    engine — the injected failures are real failures as far as the
+    degradation machinery is concerned.
+    """
+
+    enabled: bool = False
+    seed: int = 0
+    rules: list = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FaultsConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        f = cls(**{k: v for k, v in d.items() if k in known})
+        f.seed = int(f.seed)
+        if not isinstance(f.rules, (list, tuple)):
+            raise ValueError(
+                f"faults.rules must be a list of rule dicts, got "
+                f"{type(f.rules).__name__}")
+        f.rules = list(f.rules)
+        if f.enabled:
+            # deep-validate NOW (a bad rule must fail at config parse,
+            # not at the first injection opportunity); the built plan
+            # is thrown away — the engine builds its own
+            from deepspeed_tpu.faults import FaultPlan
+
+            FaultPlan(f.rules, seed=f.seed)
+        return f
+
+    @classmethod
+    def coerce(cls, obj) -> "FaultsConfig":
+        """Accept None (disabled), a dict (writing the block is the
+        opt-in, like ``kv_tier``), or a FaultsConfig."""
+        if obj is None:
+            return cls(enabled=False)
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("enabled", True)   # passing a block opts in
+            return cls.from_dict(d)
+        raise TypeError(
+            f"faults must be a dict or FaultsConfig, got "
             f"{type(obj).__name__}")
 
 
@@ -746,6 +841,8 @@ class Config:
     speculative: SpeculativeConfig = dataclasses.field(
         default_factory=SpeculativeConfig)
     slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
+    faults: FaultsConfig = dataclasses.field(
+        default_factory=FaultsConfig)
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig)
     tracing: TracingConfig = dataclasses.field(
@@ -872,6 +969,11 @@ class Config:
             # (same contract as prefix_cache / speculative above); an
             # explicit "enabled": false still disables
             c.slo = SLOConfig.coerce(d["slo"])
+        if "faults" in d:
+            # coerce, not from_dict: writing the block IS the opt-in
+            # (same contract as kv_tier / slo above); an explicit
+            # "enabled": false still disables
+            c.faults = FaultsConfig.coerce(d["faults"])
         if "telemetry" in d:
             c.telemetry = TelemetryConfig.coerce(d["telemetry"])
         if "tracing" in d:

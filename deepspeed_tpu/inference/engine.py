@@ -164,6 +164,11 @@ def init_serving(params, model_config, *, config: Any = None,
         # and goodput accounting on the engine's registry (an explicit
         # slo= kw still wins)
         kw.setdefault("slo", config.slo)
+    if config is not None and config.faults.enabled:
+        # `faults` block → deterministic fault injection for the
+        # robustness/chaos machinery (an explicit faults= kw still
+        # wins); a TEST facility — see CONFIG.md before enabling
+        kw.setdefault("faults", config.faults)
     if config is not None:
         # `telemetry` config block → the engine's MetricsRegistry (an
         # explicit telemetry= kw still wins)

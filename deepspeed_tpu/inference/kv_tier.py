@@ -44,16 +44,26 @@ from __future__ import annotations
 
 import collections
 import os
+import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from deepspeed_tpu import faults as _faults
+from deepspeed_tpu.faults import ChecksumError, retry_with_backoff
 from deepspeed_tpu.inference.prefix_cache import TierEntry, key_hex
 from deepspeed_tpu.utils.logging import logger
 
 # per-element bound of the int8 cold-page codec, RELATIVE to the row's
 # max |value| (the scale denominator): half a quantization step
 KV_TIER_QUANT_RTOL = 0.5 / 127.0
+
+
+def _crc(arr: np.ndarray) -> int:
+    """crc32 of an array's raw bytes.  Extension dtypes (bfloat16,
+    numpy type char 'E') refuse the buffer protocol, so checksum a
+    uint8 VIEW — same bytes, no copy."""
+    return zlib.crc32(np.ascontiguousarray(arr).view(np.uint8))
 
 
 # ------------------------------------------------------------ int8 codec
@@ -86,7 +96,8 @@ class _KVNvmeChannel:
     fence, so a long-lived server never accumulates one fd per page it
     ever demoted."""
 
-    def __init__(self, path: str, n_threads: int = 4):
+    def __init__(self, path: str, n_threads: int = 4, retries: int = 2,
+                 backoff_s: float = 0.05, on_retry=None):
         from deepspeed_tpu.io.aio import AioHandle
 
         os.makedirs(path, exist_ok=True)
@@ -95,6 +106,11 @@ class _KVNvmeChannel:
         self.rslot = 0
         self._rfds: List[List[int]] = [[], []]
         self._wpool = AioHandle(n_threads)
+        # bounded spill-write retry (transient aio errors must not turn
+        # a demotion into a dropped page on the first hiccup)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self._on_retry = on_retry
 
     def _path(self, name: str) -> str:
         return os.path.join(self.dir, name + ".bin")
@@ -122,22 +138,39 @@ class _KVNvmeChannel:
         self.rslot ^= 1
 
     def fence_all_reads(self) -> None:
-        """Drain BOTH slots (promotion cancel: the aio reads target
-        host buffers the caller is about to drop)."""
+        """Drain BOTH slots (promotion cancel/abandon: the aio reads
+        target host buffers the caller is about to drop).  Read errors
+        are deliberately IGNORED here — every caller is abandoning the
+        transfer, and an error raised mid-cancel would leave the
+        channel/pin/quarantine state latched forever (the hang this
+        drain exists to prevent)."""
         for s in (0, 1):
-            self.rslot = s
-            self.fence_reads()
+            pool = self.rpools[s]
+            pool.wait()
+            for fd in self._rfds[s]:
+                pool.close(fd)
+            self._rfds[s] = []
         self.rslot = 0
 
     # --------------------------------------------------------- writes
     def write(self, name: str, buf: np.ndarray) -> None:
-        """Blocking spill write (demote is already the slow path)."""
-        fd = self._wpool.open(self._path(name), write=True)
-        self._wpool.pwrite(fd, buf, 0)
-        errs = self._wpool.wait()
-        self._wpool.close(fd)
-        if errs:
-            raise IOError(f"KV-tier NVMe write of {name} failed")
+        """Blocking spill write (demote is already the slow path),
+        retried with backoff on transient errors; the LAST failure
+        propagates and the caller degrades (the entry drops instead
+        of spilling — correctness preserved, capacity lost)."""
+        def attempt():
+            fd = self._wpool.open(self._path(name), write=True)
+            try:
+                self._wpool.pwrite(fd, buf, 0)
+                errs = self._wpool.wait()
+            finally:
+                self._wpool.close(fd)
+            if errs:
+                raise IOError(f"KV-tier NVMe write of {name} failed")
+
+        retry_with_backoff(attempt, attempts=self.retries,
+                           backoff_s=self.backoff_s,
+                           on_retry=self._on_retry)
 
     def unlink(self, name: str) -> None:
         try:
@@ -183,10 +216,26 @@ class KVTierPool:
         self._order: Dict[str, "collections.OrderedDict"] = {
             "host": collections.OrderedDict(),
             "nvme": collections.OrderedDict()}
+        # degraded state: a circuit breaker (the engine calls
+        # :meth:`disable` after repeated promote failures) turns the
+        # pool inert — lookups miss, demotes become plain evictions —
+        # without touching entries an in-flight promotion still reads
+        self.disabled: Optional[str] = None
+        # write-path degradation accounting
+        self.spill_failures = 0
+        self.write_retries = 0
+
+        def _note_write_retry(_a, _e):
+            self.write_retries += 1
+            self._c_write_retries.inc()
+
         self._nvme: Optional[_KVNvmeChannel] = None
         if cfg.nvme_dir:
-            self._nvme = _KVNvmeChannel(cfg.nvme_dir,
-                                        n_threads=cfg.aio_threads)
+            self._nvme = _KVNvmeChannel(
+                cfg.nvme_dir, n_threads=cfg.aio_threads,
+                retries=getattr(cfg, "io_retries", 2),
+                backoff_s=getattr(cfg, "io_retry_backoff_s", 0.05),
+                on_retry=_note_write_retry)
         # cooperative aio priority (set by the ZI engine when KV
         # promotion shares the disk with layer-weight streams)
         self._prio_group = None
@@ -200,6 +249,7 @@ class KVTierPool:
             self._c_spill_bytes = self._c_dropped = NULL_METRIC
             self._g_host = self._g_host_b = NULL_METRIC
             self._g_nvme = self._g_nvme_b = NULL_METRIC
+            self._c_write_retries = self._c_spill_fail = NULL_METRIC
         else:
             self._c_spill_bytes = registry.counter(
                 "kv_tier_spilled_bytes",
@@ -216,6 +266,14 @@ class KVTierPool:
                 "kv_tier_nvme_pages", "demoted pages NVMe-resident")
             self._g_nvme_b = registry.gauge(
                 "kv_tier_nvme_bytes", "NVMe spill bytes in use")
+            self._c_write_retries = registry.counter(
+                "kv_tier_write_retries",
+                "spill writes retried after a transient aio error")
+            self._c_spill_fail = registry.counter(
+                "kv_tier_spill_failures",
+                "spill writes that exhausted their retries (the entry "
+                "dropped instead of spilling — capacity degradation, "
+                "never incorrectness)")
 
     # ------------------------------------------------------- accounting
     @property
@@ -240,7 +298,22 @@ class KVTierPool:
         return {"host_pages": h, "host_bytes": int(self.host_bytes),
                 "nvme_pages": n, "nvme_bytes": int(self.nvme_bytes),
                 "spilled_pages": int(self.spilled_pages),
-                "dropped_pages": int(self.dropped_pages)}
+                "dropped_pages": int(self.dropped_pages),
+                "spill_failures": int(self.spill_failures),
+                "write_retries": int(self.write_retries),
+                "disabled": self.disabled}
+
+    # --------------------------------------------------- degraded state
+    def disable(self, reason: str) -> None:
+        """Circuit-break the tier: lookups miss (``has`` → False) and
+        demotes become plain evictions, while entries stay intact for
+        any promotion already streaming them.  Idempotent; surfaced by
+        ``/healthz`` as a degraded reason."""
+        if self.disabled is None:
+            self.disabled = str(reason)
+            logger.warning("kv_tier: tier DISABLED (%s) — demotes "
+                           "become evictions, tier hits become misses",
+                           reason)
 
     # --------------------------------------------------------- priority
     def set_priority(self, group, priority: int = 0) -> None:
@@ -261,7 +334,7 @@ class KVTierPool:
 
     # ------------------------------------------------------------ index
     def has(self, key: bytes) -> bool:
-        return key in self.entries
+        return self.disabled is None and key in self.entries
 
     def location(self, key: bytes) -> Optional[str]:
         e = self.entries.get(key)
@@ -307,11 +380,16 @@ class KVTierPool:
         bufs = tuple((f"kv_{hexk}_{i}", tuple(b.shape), str(b.dtype))
                      for i, b in enumerate(data))
         self._tick += 1
+        # per-buffer crc32 recorded NOW, verified when a promotion
+        # decodes the payload back — bit rot, a torn spill write, or
+        # injected corruption all surface as ChecksumError there, and
+        # the consumer re-prefills instead of serving garbage KV
+        sums = tuple(_crc(b) for b in data)
         return TierEntry(
             key=key, location="host", quantized=self.cfg.quantize_cold,
             dtype=str(self.page_dtype), buffers=bufs,
             nbytes=int(sum(b.nbytes for b in data)), data=data,
-            tick=self._tick)
+            tick=self._tick, checksums=sums)
 
     def demote(self, key: bytes, k: np.ndarray,
                v: np.ndarray) -> Optional[str]:
@@ -321,9 +399,18 @@ class KVTierPool:
         the landing tier, or None when nothing could hold it (the page
         is then a plain eviction).  A key already resident just
         refreshes its age — re-demoting a promoted page is free."""
+        if self.disabled is not None:
+            return None             # circuit-broken: plain eviction
         if key in self.entries:
             return self.touch(key)
         entry = self._encode(key, k, v)
+        if _faults.active_plan() is not None:
+            # kv_corrupt injection: flip a payload byte AFTER the
+            # checksum was recorded — the promote-side verify must
+            # catch exactly this
+            _delay, err = _faults.poll("kv_corrupt", key_hex(key))
+            if err is not None:
+                _faults.corrupt_array(entry.data[0])
         if entry.nbytes > self.cfg.host_pool_bytes:
             # bigger than the whole host pool: straight to NVMe (the
             # entry was never host-accounted — accounted=False keeps
@@ -378,8 +465,21 @@ class KVTierPool:
             if old is None:
                 return False
             self._discard(old, count_drop=True)
-        for (name, _s, _d), buf in zip(e.buffers, e.data):
-            self._nvme.write(name, buf)
+        try:
+            for (name, _s, _d), buf in zip(e.buffers, e.data):
+                self._nvme.write(name, buf)
+        except (IOError, OSError):
+            # retries exhausted: unlink any partial files (a later
+            # same-key spill must not find a torn payload) and degrade
+            # — the entry drops instead of spilling
+            for name in e.names:
+                self._nvme.unlink(name)
+            self.spill_failures += 1
+            self._c_spill_fail.inc()
+            logger.warning("kv_tier: spill write of %s failed after "
+                           "retries — dropping the entry",
+                           key_hex(e.key)[:12])
+            return False
         if accounted and e.location == "host":
             self.host_bytes -= e.nbytes
             self._host_n -= 1
@@ -463,6 +563,20 @@ class KVTierPool:
         if self._nvme is not None:
             self._nvme.fence_all_reads()
 
+    def read_sync(self, name: str, shape, dtype) -> np.ndarray:
+        """Synchronous fallback read of one spilled buffer — the
+        degradation rung below the aio channel (``TierLayerReader``
+        falls here when a fence exhausted its retries): host entries
+        return their stored array, NVMe entries read their file through
+        the plain OS path, bypassing the aio pool entirely."""
+        hexk, i = name[len("kv_"):].rsplit("_", 1)
+        e = self.entries[bytes.fromhex(hexk)]
+        if e.location == "host":
+            _faults.inject("sync_read", key=name)
+            return e.data[int(i)]
+        return _faults.read_file_sync(self._nvme._path(name), shape,
+                                      dtype, key=name)
+
     # ----------------------------------------------------------- decode
     def _host_buffer(self, name: str) -> np.ndarray:
         """Resolve ``name`` strictly from host storage (the
@@ -480,8 +594,21 @@ class KVTierPool:
 
     def decode(self, key: bytes, bufs) -> Tuple[np.ndarray, np.ndarray]:
         """Fenced buffers → the page's (k, v) in the cache dtype
-        (dequantizing cold pages)."""
+        (dequantizing cold pages).  Verifies each buffer against the
+        checksum recorded at demote time FIRST — corrupt payloads must
+        raise :class:`~deepspeed_tpu.faults.ChecksumError` here, never
+        scatter into live HBM pages."""
         e = self.entries[key]
+        if e.checksums is not None:
+            for (name, _s, _d), buf, want in zip(e.buffers, bufs,
+                                                 e.checksums):
+                got = _crc(buf)
+                if got != want:
+                    raise ChecksumError(
+                        f"KV-tier page {key_hex(key)[:12]} buffer "
+                        f"{name}: payload checksum mismatch "
+                        f"({got:#x} != {want:#x}) — spilled copy is "
+                        "corrupt")
         if e.quantized:
             kq, ks, vq, vs = bufs
             return (dequantize_page(kq, ks, self.page_dtype),
@@ -505,6 +632,9 @@ class _HostOnlyView:
 
     def get_submit(self, name: str, shape, dtype, out=None):
         return self._pool._host_buffer(name)
+
+    def read_sync(self, name: str, shape, dtype):
+        return self._pool.read_sync(name, shape, dtype)
 
     def reads_pending(self) -> int:
         return 0

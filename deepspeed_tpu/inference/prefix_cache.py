@@ -55,6 +55,10 @@ class TierEntry:
     nbytes: int
     data: Optional[tuple] = None  # host arrays iff location == "host"
     tick: int = 0                 # age for the host->nvme->drop cascade
+    # per-buffer crc32 recorded at demote time and verified on promote:
+    # a mismatch (bit rot, torn spill write, injected corruption) drops
+    # the entry and the consumer re-prefills instead of serving garbage
+    checksums: Optional[Tuple[int, ...]] = None
 
     @property
     def names(self) -> List[str]:

@@ -70,16 +70,18 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
-import warnings
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.config import (KVTierConfig, PrefixCacheConfig,
-                                  SLOConfig, SpeculativeConfig,
-                                  TelemetryConfig, TracingConfig)
+from deepspeed_tpu import faults as faults_mod
+from deepspeed_tpu.config import (FaultsConfig, KVTierConfig,
+                                  PrefixCacheConfig, SLOConfig,
+                                  SpeculativeConfig, TelemetryConfig,
+                                  TracingConfig)
+from deepspeed_tpu.faults import ChecksumError, FaultPlan, InjectedFault
 from deepspeed_tpu.inference.kernels import PagedKVCache, PageAllocator
 from deepspeed_tpu.inference.prefix_cache import (extend_page_keys,
                                                   key_hex,
@@ -107,15 +109,43 @@ def _sample_rows(logits: jnp.ndarray, keys: jnp.ndarray,
     return jnp.where(temps == 0.0, greedy, sampled.astype(jnp.int32))
 
 
-# one-shot flag for the ServingEngine.stats deprecation warning (the
-# shim is read in loops; warning per read would drown real output)
-_stats_shim_warned = False
-
-
 def _req_key(req_id: Any) -> str:
     """Canonical string form of a request id — the /requestz?id= query
     arrives as text, so matching happens in string space."""
     return str(req_id)
+
+
+@dataclasses.dataclass
+class RequestShed:
+    """Typed admission rejection: the engine declined to serve this
+    request (queue-depth or deadline load shedding).  Lands in
+    ``engine.finished`` IN PLACE of a token list — a router retries it
+    on another replica; nothing about this request ran."""
+
+    req_id: Any
+    reason: str                        # "queue_depth" | "deadline"
+    tier: Optional[str] = None
+
+
+@dataclasses.dataclass
+class RequestFailed:
+    """Typed per-request failure: an exception in this request's slot
+    (or its admission) failed THIS request — its pages, COW refs and
+    tier pins were released, and the engine kept serving its
+    neighbors.  Lands in ``engine.finished`` in place of a token list
+    (before this existed, the exception took down the whole engine)."""
+
+    req_id: Any
+    reason: str                        # "slot_exception" | "admit_exception"
+    error: str = ""
+    tier: Optional[str] = None
+
+
+# a finished entry: the served tokens, or a typed shed/failure result
+RequestResult = Union[List[int], RequestShed, RequestFailed]
+
+# a shed inside this window marks /healthz degraded (shedding active)
+_SHED_ACTIVE_WINDOW_S = 30.0
 
 
 @dataclasses.dataclass
@@ -207,7 +237,9 @@ class ServingEngine:
                  chunk_prefill_fn=None, mesh=None, telemetry=None,
                  prefix_cache=None, admit_lookahead: int = 4,
                  tracing=None, speculative=None, drafter=None,
-                 slo=None, kv_tier=None):
+                 slo=None, kv_tier=None, faults=None,
+                 shed_queue_depth: int = 0,
+                 shed_expired_deadline: bool = False):
         # Sharded serving (ref: deepspeed/module_inject/replace_module.py
         # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
         # inference): with a mesh, params arrive pre-sharded from the
@@ -566,6 +598,73 @@ class ServingEngine:
             if self.slo_cfg.enabled else NULL_SLO_TRACKER)
         self._slo_on = self.slo_tracker.enabled
 
+        # ---- robustness: fault injection, load shedding, per-request
+        # failure isolation, and the degraded-state accounting that
+        # /healthz and /statusz surface.  A `faults` block builds a
+        # deterministic FaultPlan and installs it process-wide for the
+        # aio/tier hook points (the engine owns the install for its
+        # lifetime; `shutdown` clears it).  Shedding: queue-depth sheds
+        # reject at submit, deadline sheds drop queue entries whose SLO
+        # deadline already expired — both produce typed RequestShed
+        # results instead of letting doomed work consume the batch.
+        self.shed_queue_depth = int(shed_queue_depth)
+        if self.shed_queue_depth < 0:
+            raise ValueError(
+                f"shed_queue_depth must be >= 0 (0 = off), got "
+                f"{shed_queue_depth}")
+        self._shed_deadline = bool(shed_expired_deadline)
+        if self._shed_deadline and not self._slo_on:
+            raise ValueError(
+                "shed_expired_deadline needs the slo block — deadlines "
+                "are per-tier SLO objectives; without it there is "
+                "nothing to shed against")
+        if isinstance(faults, FaultPlan):
+            fcfg = FaultsConfig(enabled=True)
+            self._fault_plan: Optional[FaultPlan] = faults
+        else:
+            fcfg = FaultsConfig.coerce(faults)
+            self._fault_plan = (FaultPlan.from_config(fcfg)
+                                if fcfg.enabled else None)
+        self.faults_cfg = fcfg
+        self._owns_fault_plan = False
+        if self._fault_plan is not None and \
+                faults_mod.active_plan() is not self._fault_plan:
+            faults_mod.install_fault_plan(self._fault_plan)
+            self._owns_fault_plan = True
+        self._c_shed = r.counter(
+            "serving_shed_requests",
+            "requests rejected at admission by load shedding "
+            "(queue-depth or expired-deadline; typed RequestShed "
+            "results, counted per SLO tier by the tracker)")
+        self._c_failed = r.counter(
+            "serving_failed_requests",
+            "requests failed by a slot/admission exception and "
+            "released in isolation (typed RequestFailed results; the "
+            "engine kept serving)")
+        self._c_kvt_checksum = r.counter(
+            "kv_tier_checksum_failures",
+            "promotions that hit a spilled-page checksum mismatch "
+            "(entry dropped, span re-prefilled)")
+        self._c_kvt_fb_events = r.counter(
+            "kv_tier_fallback_events",
+            "promotions abandoned after an unrecoverable tier "
+            "read/checksum failure — the span fell back to re-prefill "
+            "(correctness preserved, the DMA saving lost)")
+        self._c_kvt_fb_pages = r.counter(
+            "kv_tier_fallback_pages",
+            "pages whose content was re-prefilled instead of promoted")
+        # host-side ints mirror the counters so /statusz and the leak
+        # checks work with telemetry disabled
+        self._n_submitted = 0       # arrivals (queued + shed)
+        self._n_shed = 0
+        self._n_failed = 0
+        self._shed_by_reason: Dict[str, int] = {"queue_depth": 0,
+                                                "deadline": 0}
+        self._last_shed_t: Optional[float] = None
+        self._n_kvt_fallbacks = 0
+        self._n_kvt_checksum = 0
+        self._kvt_fault_streak = 0
+
         # ---- introspection: /statusz (live engine snapshot),
         # /healthz (liveness/readiness, watchdog-fed), /requestz?id=
         # (one request's ring events) ride the telemetry HTTP server
@@ -579,38 +678,8 @@ class ServingEngine:
             self._tel_exporter.register_provider("requestz",
                                                  self.requestz)
 
-    @property
-    def stats(self) -> Dict[str, Any]:
-        """Deprecation shim over the registry — prefer
-        ``engine.registry.snapshot()``.  With telemetry disabled the
-        counters are no-ops, so this returns zeros (disabling telemetry
-        is the explicit opt-out of scheduler accounting).
-
-        Deprecated since PR 6; scheduled for removal in PR 9.  Warns
-        once per process (every reader named here has migrated —
-        bench_serving, tools, examples — so a warning means new code)."""
-        global _stats_shim_warned
-        if not _stats_shim_warned:
-            _stats_shim_warned = True
-            warnings.warn(
-                "ServingEngine.stats is a deprecated read-only shim; "
-                "read engine.registry.snapshot() instead.  The shim "
-                "will be removed in PR 9.",
-                DeprecationWarning, stacklevel=2)
-        pt = int(self._c_pc_prompt_tokens.value)
-        return {
-            "admitted": int(self._c_admitted.value),
-            "preempted": int(self._c_preempted.value),
-            "decode_steps": int(self._c_decode_steps.value),
-            "decode_syncs": int(self._c_decode_syncs.value),
-            "prefill_chunks": int(self._c_prefill_chunks.value),
-            # token-level prefix-cache hit rate (cached / admitted
-            # prompt tokens); 0.0 with the feature off or before any
-            # admission
-            "prefix_hit_rate": (
-                float(self._c_pc_cached_tokens.value) / pt if pt
-                else 0.0),
-        }
+    # (the `stats` deprecation shim from PR 2/PR 6 was removed on its
+    # announced schedule — read `engine.registry.snapshot()` instead)
 
     # -------------------------------------------------- subclass hooks
     # (the ZeRO-Inference engine swaps both: per-layer cache tuples so
@@ -668,11 +737,17 @@ class ServingEngine:
     # ------------------------------------------------------------- requests
     def submit(self, req_id, tokens, max_new_tokens: int = 32,
                temperature: float = 0.0,
-               tier: Optional[str] = None) -> None:
+               tier: Optional[str] = None) -> Optional[RequestShed]:
         """Queue a request.  ``tier`` names an SLO tier from the
         ``slo`` config block (None → the block's default tier); naming
         a tier with the block disabled raises rather than silently
-        dropping the latency objective."""
+        dropping the latency objective.
+
+        Returns None when queued.  With ``shed_queue_depth`` set and
+        the queue at capacity, the request is NOT queued: a typed
+        :class:`RequestShed` is recorded in ``finished`` and returned
+        (load shedding is a first-class outcome a router retries
+        elsewhere, never an exception)."""
         tokens = list(map(int, tokens))
         if not tokens:
             raise ValueError(f"request {req_id}: empty prompt")
@@ -687,6 +762,10 @@ class ServingEngine:
                 f"request {req_id}: needs {lifetime_pages} pages at full "
                 f"length but the pool has {usable} — it could never "
                 "complete even alone")
+        self._n_submitted += 1
+        if self.shed_queue_depth and \
+                len(self.queue) >= self.shed_queue_depth:
+            return self._shed(req_id, tier, "queue_depth")
         traced = self._trace_on and self.tracer.sampled(req_id)
         now = time.perf_counter()
         if self._slo_on or tier is not None:
@@ -707,6 +786,150 @@ class ServingEngine:
     @property
     def has_work(self) -> bool:
         return bool(self.queue) or any(s is not None for s in self.slots)
+
+    # ------------------------------------- robustness: shed / fail / leaks
+    def _shed(self, req_id, tier: Optional[str],
+              reason: str) -> RequestShed:
+        """Record a typed admission rejection: per-tier SLO shed
+        accounting, telemetry, trace event, and the degraded-state
+        clock /healthz reads.  Nothing about the request ran — there
+        is nothing to release."""
+        # validates the tier name exactly like on_submit would (an
+        # unknown tier is a caller bug even when the answer is "no")
+        self.slo_tracker.on_shed(req_id, tier)
+        res = RequestShed(req_id, reason, tier)
+        self.finished[req_id] = res
+        self._c_shed.inc()
+        self._n_shed += 1
+        self._shed_by_reason[reason] = \
+            self._shed_by_reason.get(reason, 0) + 1
+        self._last_shed_t = time.perf_counter()
+        if self._trace_on:
+            self.tracer.event("request_shed", req_id, attrs={
+                "reason": reason, "tier": tier,
+                "queue_depth": len(self.queue)})
+        self._g_queue.set(len(self.queue))
+        return res
+
+    def _shed_expired(self) -> None:
+        """Deadline shedding at admission: drop queued requests whose
+        SLO deadline has already expired — serving them would burn a
+        slot on work no client is waiting for.  Runs once per step
+        before admission."""
+        now = time.perf_counter()
+        kept: List[Request] = []
+        shed = False
+        for r in self.queue:
+            obj = self.slo_cfg.tiers.get(
+                r.tier or self.slo_cfg.default_tier)
+            dl = obj.deadline_s if obj is not None else None
+            if dl is not None and now - r.t_arrival > dl:
+                self._shed(r.req_id, r.tier, "deadline")
+                self._newly_finished.append(r.req_id)
+                shed = True
+            else:
+                kept.append(r)
+        if shed:
+            self.queue = collections.deque(kept)
+
+    def _record_failure(self, req: Request, reason: str,
+                        exc: BaseException, b: int = -1,
+                        generated: int = 0) -> None:
+        """ONE failure ledger for both the slot and admission paths:
+        the chaos soak reconciles typed results, telemetry counters,
+        per-tier SLO lifetimes and trace events against each other, so
+        the bookkeeping must never fork."""
+        self._c_failed.inc()
+        self._n_failed += 1
+        self.slo_tracker.on_fail(req.req_id)
+        self.finished[req.req_id] = RequestFailed(
+            req.req_id, reason, repr(exc), req.tier)
+        self._newly_finished.append(req.req_id)
+        if self._trace_on:
+            # always emitted (not sampling-gated): a failure is exactly
+            # what the flight recorder exists to explain
+            self.tracer.event("request_failed", req.req_id, b, attrs={
+                "error": repr(exc)[:200], "reason": reason,
+                "generated": generated})
+
+    def _fail_slot(self, b: int, exc: BaseException) -> None:
+        """Per-request failure isolation: an exception in slot ``b``'s
+        host-side work fails THAT request — its promotion is fenced
+        and cancelled, its pages/COW refs released, its pending
+        boundary sample dropped — and the engine keeps serving the
+        other slots.  The request finishes as a typed
+        :class:`RequestFailed` (before this, the exception killed the
+        whole engine)."""
+        s = self.slots[b]
+        req = s.req
+        logger.warning(
+            "serving: request %r failed in slot %d (%s) — releasing "
+            "and continuing", req.req_id, b, exc)
+        if s.promo is not None:
+            try:
+                self._cancel_promotion(s)
+            except Exception:
+                logger.exception(
+                    "serving: promotion cancel during slot failure")
+        self.allocator.release(s.seq_id)
+        self._table_host[b, :] = self.trash_page
+        self._table_dirty = self._lens_dirty = True
+        self.slots[b] = None
+        # a queued boundary sample for this slot would append a token
+        # to a dead request (or index a vacated slot) at the flush
+        self._pending_boundary = [p for p in self._pending_boundary
+                                  if p[0] != b]
+        self._record_failure(req, "slot_exception", exc, b=b,
+                             generated=len(s.generated))
+
+    def check_leaks(self) -> List[str]:
+        """Page-accounting invariants; returns violations (empty =
+        clean).  Reused by the chaos soak and the fault tests after
+        every scenario: each page must sit in exactly one of
+        {free list, warm pool, live-owned, parked}, refcounts must
+        match ownership multiplicity, and an idle engine must own
+        nothing."""
+        al = self.allocator
+        probs: List[str] = []
+        usable = self.trash_page
+        owned_flat = [p for pages in al.owned.values() for p in pages]
+        live = set(owned_flat)
+        cnt = collections.Counter(al.free)
+        cnt.update(al.pool.keys())     # keys() — a dict would be read
+        cnt.update(live)               # as a counts mapping
+        cnt.update(al._parked)
+        missing = [p for p in range(usable) if cnt[p] != 1]
+        if missing:
+            probs.append(
+                f"pages not in exactly one of free/warm/live/parked: "
+                f"{missing[:16]}")
+        for p, n in al.refs.items():
+            owners = sum(1 for pages in al.owned.values()
+                         if p in pages)
+            if n != owners:
+                probs.append(
+                    f"page {p}: refcount {n} != {owners} owners")
+        for p in al.promoting:
+            if p not in al.refs and p not in al._parked:
+                probs.append(
+                    f"page {p}: promoting but neither owned nor parked")
+        idle = not any(s is not None for s in self.slots) \
+            and not self.queue
+        if idle:
+            if al.owned:
+                probs.append(f"idle engine owns pages: {dict(al.owned)}")
+            if al.promoting:
+                probs.append(
+                    f"idle engine has promotions in flight: "
+                    f"{dict(al.promoting)}")
+            if al._parked:
+                probs.append(f"idle engine has parked pages: "
+                             f"{al._parked}")
+            if self._kv_pool is not None and self._kv_pool._pinned:
+                probs.append(
+                    f"idle engine holds tier pins: "
+                    f"{list(self._kv_pool._pinned)}")
+        return probs
 
     # ----------------------------------------------------------- scheduling
     def _upload_dirty(self) -> None:
@@ -758,7 +981,27 @@ class ServingEngine:
             return False       # no slot: nothing in the window fits
         window = min(len(self.queue), 1 + self.admit_lookahead)
         for i in range(window):
-            if self._try_admit(b, self.queue[i], queue_skips=i):
+            req = self.queue[i]
+            try:
+                admitted = self._try_admit(b, req, queue_skips=i)
+            except faults_mod.FatalStreamError:
+                # an unrecoverable WEIGHT stream is engine-fatal, not
+                # per-request: every future admission needs the same
+                # bytes.  _try_admit cleaned up (the request stays
+                # queued for a restarted engine); the structured fatal
+                # — postmortem already dumped — reaches the supervisor
+                raise
+            except Exception as e:
+                # _try_admit cleaned up after itself (pages released,
+                # promotions cancelled, pins dropped) — fail THIS
+                # request and keep the engine serving
+                logger.warning(
+                    "serving: request %r failed during admission (%s) "
+                    "— releasing and continuing", req.req_id, e)
+                del self.queue[i]
+                self._record_failure(req, "admit_exception", e)
+                return True      # progress: the queue shrank
+            if admitted:
                 del self.queue[i]
                 if i:
                     self._c_admit_skips.inc(i)
@@ -827,87 +1070,128 @@ class ServingEngine:
             return False
         seq_id = self._seq_counter
         self._seq_counter += 1
-        # share BEFORE allocate: allocation pressure must never evict a
-        # page this very admission is about to map.  (It MAY demote a
-        # warm page into the tier pool mid-allocate — the pool pins
-        # this admission's tier keys below, so the cascade can't drop
-        # the very entries about to be promoted.)
-        if tier_keys:
-            self._kv_pool.pin(tier_keys)
-        if hbm_pages:
-            self.allocator.share(seq_id, hbm_pages)
-        # batch-demote the shortfall up front: one device read for the
-        # whole admission instead of one per page inside _evict_one
-        self._ensure_free(need)
-        pages = self.allocator.allocate(seq_id, need)
-        fresh = iter(pages)
-        row: List[int] = []
-        page_map: Dict[bytes, int] = {}
-        for kind, val in matched:
-            if kind == "hbm":
-                row.append(val)
-            else:
-                pg = next(fresh)
-                page_map[val] = pg
-                row.append(pg)
-        suffix = list(fresh)
-        self._table_host[b, :] = self.trash_page
-        self._table_host[b, :cm] = row
-        self._table_host[b, cm:cm + len(suffix)] = suffix
-        self._table_dirty = self._lens_dirty = True
-        if self._pc_on:
-            (self._c_pc_hits if cm else self._c_pc_misses).inc()
-            self._c_pc_cached_tokens.inc(cached)
-            self._c_pc_prompt_tokens.inc(T)
-        if req.traced:
-            # BEFORE the prefill compute below: the trace's
-            # admitted→first_token span is the prefill cost
-            self.tracer.event("admitted", req.req_id, b, attrs={
-                "cached_tokens": cached, "tier_pages": len(tier_keys),
-                "queue_skips": queue_skips})
-
-        self._rng, rng = jax.random.split(self._rng)
         promo = None
-        if tier_keys:
-            promo = self._begin_promotion(b, tier_keys, page_map)
-        if self.prefill_chunk or cached:
-            # split-fuse and/or cache-hit admission: the uncached
-            # suffix is absorbed in continuation chunks starting at the
-            # first uncached token; the slot is not decode-ready until
-            # prefill_done reaches T.  (A hit under prefill_chunk=0
-            # absorbs prefill_bucket tokens per iteration.)
-            self.slots[b] = _Slot(req=req, seq_len=cached, generated=[],
-                                  rng=rng, seq_id=seq_id,
-                                  prefill_done=cached, promo=promo)
+        page_map: Dict[bytes, int] = {}
+        try:
+            # share BEFORE allocate: allocation pressure must never
+            # evict a page this very admission is about to map.  (It
+            # MAY demote a warm page into the tier pool mid-allocate —
+            # the pool pins this admission's tier keys below, so the
+            # cascade can't drop the very entries about to be
+            # promoted.)
+            if tier_keys:
+                self._kv_pool.pin(tier_keys)
+            if hbm_pages:
+                self.allocator.share(seq_id, hbm_pages)
+            # batch-demote the shortfall up front: one device read for
+            # the whole admission instead of one per page in _evict_one
+            self._ensure_free(need)
+            pages = self.allocator.allocate(seq_id, need)
+            fresh = iter(pages)
+            row: List[int] = []
+            for kind, val in matched:
+                if kind == "hbm":
+                    row.append(val)
+                else:
+                    pg = next(fresh)
+                    page_map[val] = pg
+                    row.append(pg)
+            suffix = list(fresh)
+            self._table_host[b, :] = self.trash_page
+            self._table_host[b, :cm] = row
+            self._table_host[b, cm:cm + len(suffix)] = suffix
+            self._table_dirty = self._lens_dirty = True
+            if self._pc_on:
+                (self._c_pc_hits if cm else self._c_pc_misses).inc()
+                self._c_pc_cached_tokens.inc(cached)
+                self._c_pc_prompt_tokens.inc(T)
+            if req.traced:
+                # BEFORE the prefill compute below: the trace's
+                # admitted→first_token span is the prefill cost
+                self.tracer.event("admitted", req.req_id, b, attrs={
+                    "cached_tokens": cached,
+                    "tier_pages": len(tier_keys),
+                    "queue_skips": queue_skips})
+
+            self._rng, rng = jax.random.split(self._rng)
+            if tier_keys:
+                promo = self._begin_promotion(b, tier_keys, page_map)
+            if self.prefill_chunk or cached:
+                # split-fuse and/or cache-hit admission: the uncached
+                # suffix is absorbed in continuation chunks starting at
+                # the first uncached token; the slot is not
+                # decode-ready until prefill_done reaches T.  (A hit
+                # under prefill_chunk=0 absorbs prefill_bucket tokens
+                # per iteration.)
+                self.slots[b] = _Slot(req=req, seq_len=cached,
+                                      generated=[], rng=rng,
+                                      seq_id=seq_id,
+                                      prefill_done=cached, promo=promo)
+                self._c_admitted.inc()
+                return True
+
+            toks = np.full((1, end), 0, np.int32)
+            toks[0, :T] = req.tokens
+            # table row from the HOST copy: a [b:b+1] device slice can
+            # alias the live table buffer (full-range slice), which
+            # prefill's cache donation would then delete out from under
+            # the decode path
+            view = PagedKVCache(
+                k=self.cache.k, v=self.cache.v,
+                table=self._put(self._table_host[b:b + 1]),
+                seq_lens=self._put(jnp.zeros((1,), jnp.int32)),
+                page_size=self.page_size)
+            logits, view = self._prefill(self.params, self._put(toks),
+                                         view)
+            self.cache = self.cache._replace(k=view.k, v=view.v)
+
+            slot = _Slot(req=req, seq_len=T, generated=[], rng=rng,
+                         seq_id=seq_id)
+            self.slots[b] = slot
             self._c_admitted.inc()
+            # the prompt's full pages are immutable from here on
+            # (decode writes only at the frontier) — make them
+            # matchable now so concurrent same-prefix requests hit
+            self._publish_full_pages(b, slot, upto=T)
+            # first generated token comes from the REAL last prompt
+            # position; sampling is deferred into the step's one
+            # batched boundary flush
+            self._queue_boundary(b, logits[0, T - 1], slot)
             return True
-
-        toks = np.full((1, end), 0, np.int32)
-        toks[0, :T] = req.tokens
-        # table row from the HOST copy: a [b:b+1] device slice can alias
-        # the live table buffer (full-range slice), which prefill's cache
-        # donation would then delete out from under the decode path
-        view = PagedKVCache(
-            k=self.cache.k, v=self.cache.v,
-            table=self._put(self._table_host[b:b + 1]),
-            seq_lens=self._put(jnp.zeros((1,), jnp.int32)),
-            page_size=self.page_size)
-        logits, view = self._prefill(self.params, self._put(toks), view)
-        self.cache = self.cache._replace(k=view.k, v=view.v)
-
-        slot = _Slot(req=req, seq_len=T, generated=[], rng=rng,
-                     seq_id=seq_id)
-        self.slots[b] = slot
-        self._c_admitted.inc()
-        # the prompt's full pages are immutable from here on (decode
-        # writes only at the frontier) — make them matchable now so
-        # concurrent same-prefix requests already hit
-        self._publish_full_pages(b, slot, upto=T)
-        # first generated token comes from the REAL last prompt
-        # position; sampling is deferred into the step's one batched
-        # boundary flush
-        self._queue_boundary(b, logits[0, T - 1], slot)
-        return True
+        except BaseException:
+            # an exception between page allocation and slot publish
+            # must not leak: fence + cancel any in-flight tier
+            # promotion, drop the pins, release every page this seq
+            # acquired (shared AND fresh), and clear the table row —
+            # then let the caller decide the request's fate
+            if self._promo_channel == b:
+                # this admission owned the NVMe channel: drain ANY
+                # reads it submitted — a presubmit that raised partway
+                # (promo never assigned, primed never set) still left
+                # in-flight aio ops targeting buffers about to be
+                # dropped, and stale fds on the shared channel slot
+                try:
+                    self._kv_pool.fence_all_reads()
+                except Exception:
+                    logger.exception(
+                        "serving: fence during admission cleanup")
+            if page_map:
+                # covers a promotion begun partway too (cancel of a
+                # never-begun page is a no-op)
+                for pg in page_map.values():
+                    self.allocator.cancel_promotion(pg)
+                if self._promo_channel == b:
+                    self._promo_channel = None
+                self._g_kvt_inflight.set(len(self.allocator.promoting))
+            if tier_keys:
+                self._kv_pool.unpin(tier_keys)
+            self.allocator.release(seq_id)
+            self._table_host[b, :] = self.trash_page
+            self._table_dirty = self._lens_dirty = True
+            self.slots[b] = None
+            self._pending_boundary = [p for p in self._pending_boundary
+                                      if p[0] != b]
+            raise
 
     def _valid_tokens(self, s: "_Slot") -> int:
         """Positions of slot ``s`` that hold REAL written KV: mid-
@@ -975,7 +1259,9 @@ class ServingEngine:
             self._kv_pool if channel else self._kv_pool.host_view(),
             tier_keys, to_device=None,
             group_pages=self.kv_tier.promote_group_pages,
-            registry=self.registry, tracer=self.tracer)
+            registry=self.registry, tracer=self.tracer,
+            retries=self.kv_tier.io_retries,
+            retry_backoff_s=self.kv_tier.io_retry_backoff_s)
         # bound late: the callback needs the reader's own group table
         reader.to_device = lambda bufs, g: self._promote_group(
             page_map, bufs, reader.group_keys(g))
@@ -1021,11 +1307,22 @@ class ServingEngine:
         """Drain the slot's promotion: every group fences, dequantizes
         and scatters into its target pages (group g+1's tier reads in
         flight while group g uploads), then the pages publish under
-        their content keys — matchable for concurrent admissions."""
+        their content keys — matchable for concurrent admissions.
+
+        Graceful degradation: the reader already retried transient aio
+        errors and tried the synchronous fallback; whatever still
+        escapes (a checksum mismatch, an unrecoverable read) abandons
+        the promotion and falls back to re-prefilling the unlanded
+        span — correctness preserved, the DMA saving lost."""
         p = s.promo
-        for _ in p.reader.sweep(range(p.reader.n_groups),
-                                primed=p.primed):
-            pass
+        try:
+            for _ in p.reader.sweep(range(p.reader.n_groups),
+                                    primed=p.primed):
+                pass
+        except Exception as e:
+            self._promotion_fallback(b, s, e)
+            return
+        self._kvt_fault_streak = 0
         dt = time.perf_counter() - p.t_start
         self._h_kvt_promote.observe(dt)
         self._kv_pool.unpin(p.keys)
@@ -1037,6 +1334,80 @@ class ServingEngine:
         if p.channel and self._promo_channel == b:
             self._promo_channel = None
         self._g_kvt_inflight.set(len(self.allocator.promoting))
+
+    def _promotion_fallback(self, b: int, s: "_Slot",
+                            exc: BaseException) -> None:
+        """Abandon a failed promotion and re-prefill the span it was
+        supposed to stream (ISSUE acceptance: promote failure or
+        checksum mismatch must cost compute, never correctness).
+
+        Groups land in page order, so landed pages (already published)
+        form a contiguous prefix; everything from the first unlanded
+        page onward rolls back: its allocator quarantine is cancelled
+        (the pages stay owned — prefill writes them now), its suspect
+        tier entries drop from the pool, and the slot's absorbed
+        prefix retreats to the first unlanded page boundary.  Repeated
+        failures trip the tier circuit breaker
+        (``kv_tier.disable_after``)."""
+        p = s.promo
+        try:
+            self._kv_pool.fence_all_reads()
+        except Exception:
+            pass                    # the channel may be the failure
+        unlanded = [(key, pg) for key, pg in p.page_map.items()
+                    if pg in self.allocator.promoting]
+        self._kv_pool.unpin(p.keys)
+        for key, pg in unlanded:
+            self.allocator.cancel_promotion(pg)
+            # the payload is suspect (failed read or corrupt) — a
+            # future admission must re-prefill, not re-promote it.
+            # UNLESS a concurrent promotion still pins the key: its
+            # reads are in flight against this entry, so it must keep
+            # resolving (it will hit the same checksum and run its own
+            # fallback, which then drops the entry)
+            if key not in self._kv_pool._pinned:
+                self._kv_pool.discard(key)
+        if unlanded:
+            # roll the absorbed prefix back to the first unlanded
+            # page: everything before it (HBM-shared + landed
+            # promotions) is intact history the continuation chunks
+            # attend over
+            row = [int(x) for x in self._table_host[b]]
+            first_bad = min(row.index(pg) for _k, pg in unlanded)
+            fb_tokens = first_bad * self.page_size
+            s.prefill_done = min(s.prefill_done, fb_tokens)
+            s.seq_len = min(s.seq_len, fb_tokens)
+        else:
+            fb_tokens = s.prefill_done
+        if isinstance(exc, ChecksumError):
+            self._c_kvt_checksum.inc()
+            self._n_kvt_checksum += 1
+        self._c_kvt_fb_events.inc()
+        self._c_kvt_fb_pages.inc(len(unlanded))
+        self._n_kvt_fallbacks += 1
+        logger.warning(
+            "serving: KV-tier promotion failed for request %r "
+            "(%s) — re-prefilling %d pages from token %d",
+            s.req.req_id, exc, len(unlanded), fb_tokens)
+        if self._trace_on:
+            self.tracer.event("kv_promote_failed", s.req.req_id, b,
+                              attrs={"error": repr(exc)[:200],
+                                     "pages": len(unlanded),
+                                     "resume_token": fb_tokens})
+        s.promo = None
+        if p.channel and self._promo_channel == b:
+            self._promo_channel = None
+        self._g_kvt_inflight.set(len(self.allocator.promoting))
+        # circuit breaker: repeated promote failures disable the tier
+        # (demotes become evictions, hits become misses) — /healthz
+        # reports degraded, the router routes around
+        self._kvt_fault_streak += 1
+        da = self.kv_tier.disable_after
+        if da and self._kvt_fault_streak >= da and \
+                self._kv_pool.disabled is None:
+            self._kv_pool.disable(
+                f"{self._kvt_fault_streak} consecutive promotion "
+                "failures")
 
     def _promote_group(self, page_map: Dict[bytes, int], bufs,
                        g_keys) -> List[int]:
@@ -1070,8 +1441,17 @@ class ServingEngine:
         p = s.promo
         if p is None:
             return
-        if p.channel and p.primed is not None:
-            self._kv_pool.fence_all_reads()
+        if p.channel:
+            # regardless of `primed`: a presubmit that raised partway
+            # may have submitted reads without ever assigning it —
+            # drain whatever is on the channel (free when nothing is)
+            try:
+                self._kv_pool.fence_all_reads()
+            except Exception:
+                # a failing drain must never abort the cancel — the
+                # quarantine/pin/channel cleanup below is what keeps
+                # the engine admitting
+                logger.exception("serving: promotion-cancel fence")
         for pg in p.page_map.values():
             self.allocator.cancel_promotion(pg)
         self._kv_pool.unpin(p.keys)
@@ -1452,6 +1832,10 @@ class ServingEngine:
         return list(self._newly_finished)
 
     def _step_inner(self) -> None:
+        if self._shed_deadline and self.queue:
+            # BEFORE admission: a request whose deadline already
+            # expired must shed, not burn a slot on unwanted work
+            self._shed_expired()
         if self._kvt_wm_pages is not None:
             # BEFORE admission: proactively demoting past the
             # watermark frees pages the admissions below can use
@@ -1459,11 +1843,31 @@ class ServingEngine:
             self._demote_watermark_sweep()
         while self._admit_one():
             pass
-        # split-fuse: absorb ONE chunk per pending-prefill slot, then run
-        # the batched decode for every ready slot in the same iteration
+        # split-fuse: absorb ONE chunk per pending-prefill slot, then
+        # run the batched decode for every ready slot in the same
+        # iteration.  Failure isolation: an exception in one slot's
+        # host-side work (including injected `slot` faults) fails THAT
+        # request and releases its resources; the others keep serving.
         for b, s in list(enumerate(self.slots)):
             if s is not None and s.prefilling:
-                self._advance_prefill(b, s)
+                try:
+                    if self._fault_plan is not None:
+                        faults_mod.inject("slot", key=s.req.req_id)
+                    self._advance_prefill(b, s)
+                except faults_mod.FatalStreamError:
+                    raise    # dead WEIGHT stream: engine-fatal, not
+                except Exception as e:       # a per-request failure
+                    self._fail_slot(b, e)
+        if self._fault_plan is not None:
+            # decode-ready slots get the same per-step injection
+            # opportunity (a request that skipped chunked prefill
+            # would otherwise be untargetable)
+            for b, s in enumerate(self.slots):
+                if s is not None and not s.prefilling:
+                    try:
+                        faults_mod.inject("slot", key=s.req.req_id)
+                    except InjectedFault as e:
+                        self._fail_slot(b, e)
         # every prompt that finished prefilling this step samples its
         # boundary token in ONE batched fetch, before the decode phase
         # reads generated[-1]
@@ -1775,10 +2179,73 @@ class ServingEngine:
                     self._c_spec_emitted.value / spec_slots, 4)
                 if spec_slots else None,
             },
-            "slo": self.slo_tracker.snapshot(now=now),
-            "metrics": self.registry.snapshot(),
         }
+        metrics = self.registry.snapshot()
+        status["slo"] = self.slo_tracker.snapshot(now=now)
+        # reuse the snapshot just taken — _robustness_status only
+        # filters its counters, and /statusz is polled on an interval
+        status["robustness"] = self._robustness_status(
+            now, counters=metrics.get("counters", {}))
+        status["metrics"] = metrics
         return status
+
+    def _degraded_state(self, now: float) -> Tuple[bool, List[str]]:
+        """Degraded = still serving, but shedding load or running with
+        a tier disabled by repeated faults.  /healthz stays 200 (a
+        degraded engine is exactly the one a router should KEEP
+        probing) with ``{"degraded": true, "reasons": [...]}``; only a
+        watchdog fire or shutdown turns readiness off (503)."""
+        reasons: List[str] = []
+        if self._last_shed_t is not None and \
+                now - self._last_shed_t < _SHED_ACTIVE_WINDOW_S:
+            reasons.append("load_shedding_active")
+        if self._kv_pool is not None and \
+                self._kv_pool.disabled is not None:
+            reasons.append(
+                f"kv_tier_disabled: {self._kv_pool.disabled}")
+        return bool(reasons), reasons
+
+    def _robustness_status(self, now: float,
+                           counters: Optional[Dict[str, float]] = None
+                           ) -> Dict[str, Any]:
+        """The /statusz ``robustness`` block: shed/failed accounting,
+        per-tier fault/retry/fallback counters, degraded state, and —
+        when a fault plan is installed — the injection ledger the
+        chaos soak reconciles against.  ``counters``: a registry
+        snapshot's counter dict, when the caller already took one
+        (statusz does — no second registry walk per poll)."""
+        degraded, reasons = self._degraded_state(now)
+        cnt = counters if counters is not None else ({}
+            if not self._tel_on
+            else self.registry.snapshot().get("counters", {}))
+        out: Dict[str, Any] = {
+            "degraded": degraded,
+            "reasons": reasons,
+            "shed_requests": self._n_shed,
+            "shed_rate": round(
+                self._n_shed / self._n_submitted, 4)
+            if self._n_submitted else 0.0,
+            "shed_by_reason": {k: v for k, v in
+                               self._shed_by_reason.items() if v},
+            "failed_requests": self._n_failed,
+            "shed_queue_depth": self.shed_queue_depth,
+            "shed_expired_deadline": self._shed_deadline,
+            "kv_tier": {
+                "fallback_events": self._n_kvt_fallbacks,
+                "checksum_failures": self._n_kvt_checksum,
+                "disabled": (self._kv_pool.disabled
+                             if self._kv_pool is not None else None),
+                "spill_failures": (self._kv_pool.spill_failures
+                                   if self._kv_pool is not None else 0),
+            },
+            "io_retries": {
+                k: int(v) for k, v in cnt.items()
+                if k.endswith(("_io_retries", "_sync_fallbacks",
+                               "_write_retries")) and v},
+        }
+        if self._fault_plan is not None:
+            out["faults"] = self._fault_plan.snapshot()
+        return out
 
     def healthz(self) -> Dict[str, Any]:
         """Liveness/readiness for a fleet supervisor probe.  ``ready``
@@ -1801,6 +2268,12 @@ class ServingEngine:
             h["watchdog"] = wd.health()
             if wd.fired:
                 h["ready"] = False
+        # degraded ≠ unready: shedding or a disabled tier keeps the
+        # 200 (the engine IS serving) and reports why it is limping —
+        # the router's shed/fail-over signal, not a kill signal
+        degraded, reasons = self._degraded_state(now)
+        h["degraded"] = degraded
+        h["reasons"] = reasons
         return h
 
     def requestz(self, req_id) -> Dict[str, Any]:
@@ -1851,6 +2324,8 @@ class ServingEngine:
         if self._closed:
             return
         self._closed = True
+        if self._owns_fault_plan:
+            faults_mod.clear_fault_plan(self._fault_plan)
         ex = self._tel_exporter
         if ex is not None:
             try:
@@ -2147,6 +2622,23 @@ def serving_engine(params, cfg, **kw):
         raise NotImplementedError(
             f"kv_tier needs the paged-KV decode path, which "
             f"{type(cfg).__name__} does not serve — supported: "
+            "LlamaConfig, MixtralConfig, GPT2Config")
+    fl = kw.pop("faults", None)
+    if fl is not None and (isinstance(fl, FaultPlan)
+                           or FaultsConfig.coerce(fl).enabled):
+        # fault injection exercises the paged scheduler's isolation/
+        # shed/fallback machinery; the encoder engines have none of it
+        # — fail loudly, never silently skip the chaos the block asked
+        # for
+        raise NotImplementedError(
+            f"the faults block needs the paged-KV decode path, which "
+            f"{type(cfg).__name__} does not serve — supported: "
+            "LlamaConfig, MixtralConfig, GPT2Config")
+    if kw.pop("shed_queue_depth", 0) or kw.pop("shed_expired_deadline",
+                                               False):
+        raise NotImplementedError(
+            f"load shedding lives in the paged-KV admission path, "
+            f"which {type(cfg).__name__} does not serve — supported: "
             "LlamaConfig, MixtralConfig, GPT2Config")
     if isinstance(cfg, BertConfig):
         from deepspeed_tpu.inference.encoder_serving import (

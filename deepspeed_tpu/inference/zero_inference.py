@@ -248,7 +248,13 @@ class ZeroInferenceServingEngine(ServingEngine):
             # flight recorder as the request lifecycle (base ctor built
             # the tracer): a slow request's trace shows WHICH layer's
             # tier fence it sat behind
-            tracer=self.tracer)
+            tracer=self.tracer,
+            # graceful stream degradation: transient read failures
+            # retry (resubmit + backoff), then fall over to synchronous
+            # tier-file reads; only an unrecoverable failure raises the
+            # structured fatal — after a flight-recorder postmortem
+            retries=zi.io_retries,
+            retry_backoff_s=zi.io_retry_backoff_s)
         # KV-tier promotion and the layer-weight stream share the same
         # storage device when both tiers are NVMe: register the weight
         # read pools ABOVE the KV pool in a cooperative priority group,
@@ -368,17 +374,8 @@ class ZeroInferenceServingEngine(ServingEngine):
     def _note_wait(self, dt: float) -> None:
         self._h_wait.observe(dt)
 
-    @property
-    def stats(self) -> Dict[str, Any]:
-        """Base shim + the streaming keys (prefer
-        ``engine.registry.snapshot()``)."""
-        s = ServingEngine.stats.fget(self)
-        s.update({
-            "layer_h2d_uploads": int(self._c_h2d.value),
-            "layer_sweeps": int(self._c_sweeps.value),
-            "prefetch_wait_s": float(self._h_wait.sum),
-        })
-        return s
+    # (the `stats` shim override was removed with the base shim on its
+    # announced PR 9 schedule — read `engine.registry.snapshot()`)
 
     # ------------------------------------------------ streamed executors
     def _run_blocks(self, phase, x, cos, sin, k_list, v_list, table,
@@ -482,6 +479,11 @@ class ZeroInferenceServingEngine(ServingEngine):
             "stream_stalls": int(self._h_wait.count),
             "stream_stall_s": round(float(self._h_wait.sum), 6),
             "h2d_bandwidth_bytes_per_s": float(self._g_bw.value),
+            # degradation accounting: retried fences and synchronous
+            # fallback reads (nonzero = the aio channel misbehaved and
+            # the stream limped on; a fatal would have postmortem'd)
+            "stream_retries": int(self._reader.io_retries),
+            "stream_sync_fallbacks": int(self._reader.sync_fallbacks),
         }
         return s
 

@@ -102,6 +102,11 @@ class _RamTier(_Tier):
     def get_submit(self, name, shape, dtype, out=None):
         return self.store[name]
 
+    def read_sync(self, name, shape, dtype):
+        """Synchronous fallback read (degradation rung below aio —
+        trivially the stored array here)."""
+        return self.store[name]
+
 
 class _NvmeTier(_Tier):
     """Flat file per leaf shard; alternating aio pools for per-slot fencing."""
@@ -148,6 +153,17 @@ class _NvmeTier(_Tier):
         behind compute.  Consumed by the ZeRO-Inference streamer's
         hit/stall accounting."""
         return self.rpools[self.rslot].pending()
+
+    def read_sync(self, name, shape, dtype):
+        """Synchronous fallback read through the plain OS path,
+        bypassing the aio pools — the degradation rung
+        ``TierLayerReader`` drops to when a fence exhausted its
+        retries (a broken aio channel must not take down a stream the
+        filesystem can still serve)."""
+        from deepspeed_tpu.faults import read_file_sync
+
+        return read_file_sync(os.path.join(self.dir, name + ".bin"),
+                              shape, dtype, key=name)
 
     def fence_reads(self):
         errs = self.rpools[self.rslot].wait()

@@ -93,6 +93,11 @@ class AioHandle:
     def __init__(self, n_threads: int = 8):
         self._lib = _ensure_lib()
         self._fds: List[int] = []
+        # fault injection (deepspeed_tpu.faults): error rules swallow
+        # the submit and surface as failed ops at the next wait();
+        # latency rules sleep at submit.  No plan installed = one
+        # branch per op.
+        self._inject_errs = 0
         if self._lib is not None:
             self._pool = self._lib.dstpu_aio_create(n_threads)
             self._exec = None
@@ -152,10 +157,31 @@ class AioHandle:
             self._fds.remove(fd)
 
     # ------------------------------------------------------------ async ops
+    def _maybe_inject(self, subsystem: str) -> bool:
+        """Consult the process-wide fault plan for one op: applies
+        latency rules, records error rules as a failed op reported by
+        the next :meth:`wait`.  Returns True when the op should NOT be
+        submitted (it is the injected failure)."""
+        from deepspeed_tpu import faults
+
+        if faults.active_plan() is None:
+            return False
+        delay, err = faults.poll(subsystem)
+        if delay:
+            import time
+
+            time.sleep(delay)
+        if err is not None:
+            self._inject_errs += 1
+            return True
+        return False
+
     def pread(self, fd: int, buf: np.ndarray, offset: int = 0) -> None:
         """Submit an async read of buf.nbytes at ``offset`` into ``buf``."""
         assert buf.flags["C_CONTIGUOUS"]
-        if self.native:
+        if self._maybe_inject("aio_read"):
+            pass                  # swallowed: wait() reports the error
+        elif self.native:
             self._lib.dstpu_aio_pread(
                 self._pool, fd, buf.ctypes.data_as(ctypes.c_void_p),
                 buf.nbytes, offset)
@@ -172,7 +198,9 @@ class AioHandle:
 
     def pwrite(self, fd: int, buf: np.ndarray, offset: int = 0) -> None:
         assert buf.flags["C_CONTIGUOUS"]
-        if self.native:
+        if self._maybe_inject("aio_write"):
+            pass                  # swallowed: wait() reports the error
+        elif self.native:
             self._lib.dstpu_aio_pwrite(
                 self._pool, fd, buf.ctypes.data_as(ctypes.c_void_p),
                 buf.nbytes, offset)
@@ -208,7 +236,10 @@ class AioHandle:
         return sum(1 for f in self._futures if not f.done())
 
     def wait(self) -> int:
-        """Block until all submitted ops complete; returns #errors."""
+        """Block until all submitted ops complete; returns #errors
+        (injected-fault ops count as errors here — the consumer's
+        retry/fallback path cannot tell them from real ones, which is
+        the point)."""
         if self.native:
             errs = int(self._lib.dstpu_aio_wait(self._pool))
         else:
@@ -219,6 +250,8 @@ class AioHandle:
                 except Exception:
                     errs += 1
             self._futures = []
+        errs += self._inject_errs
+        self._inject_errs = 0
         self._g_pending.set(0)
         if self._trace_on:
             self._tracer.event("aio_wait_complete",

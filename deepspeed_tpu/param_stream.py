@@ -114,7 +114,8 @@ class TierLayerReader:
     def __init__(self, tier: _Tier, names_fn: Callable[[int], List[str]],
                  shapes, dtypes, to_device, depth: int = 1,
                  registry=None, prefix: str = "tier_reader",
-                 tracer=None):
+                 tracer=None, retries: int = 2,
+                 retry_backoff_s: float = 0.05):
         from deepspeed_tpu import request_trace as _request_trace
         from deepspeed_tpu import telemetry as _telemetry
 
@@ -136,6 +137,15 @@ class TierLayerReader:
         # already landed when the sweep reached it (fence was free)
         self.hits = 0
         self.stalls = 0
+        # graceful degradation of the read path: a failed fence
+        # resubmits the item's reads up to `retries` times (exponential
+        # backoff), then falls over to the tier's synchronous read
+        # (bypassing aio), and only then raises a structured fatal —
+        # AFTER dumping a flight-recorder postmortem
+        self.retries = int(retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.io_retries = 0
+        self.sync_fallbacks = 0
         # optional MetricsRegistry fan-out (prefetch hit/stall counters,
         # bytes read off the tier, fence-wait distribution); with no
         # registry the handles are shared no-ops — zero branches on the
@@ -147,7 +157,15 @@ class TierLayerReader:
             null = _telemetry.NULL_METRIC
             self._c_hits = self._c_stalls = self._c_bytes = null
             self._h_wait = null
+            self._c_retries = self._c_sync_fb = null
         else:
+            self._c_retries = registry.counter(
+                f"{prefix}_io_retries",
+                "tier-read fences retried after a transient aio error")
+            self._c_sync_fb = registry.counter(
+                f"{prefix}_sync_fallbacks",
+                "tier reads served by the synchronous fallback after "
+                "aio retries exhausted (degraded but correct)")
             self._c_hits = registry.counter(
                 f"{prefix}_prefetch_hits",
                 "layer reads already landed when the sweep arrived")
@@ -177,6 +195,62 @@ class TierLayerReader:
                 "layer": l, "bytes": nbytes})
         return [self.tier.get_submit(n, s, d)
                 for n, s, d in zip(names, shapes, dtypes)]
+
+    def _fence_retry(self, l: int, pending):
+        """Fence item ``l``'s reads with graceful degradation: a
+        transient IO failure resubmits the item's reads (bounded,
+        exponential backoff); exhausted retries fall over to the
+        tier's synchronous ``read_sync`` path (aio bypassed, degraded
+        but correct); if that too fails — or the tier has no sync
+        path — a flight-recorder postmortem is dumped and a structured
+        :class:`~deepspeed_tpu.faults.FatalStreamError` raised.
+        Returns the VALID buffers (resubmits replace ``pending``)."""
+        from deepspeed_tpu.faults import FatalStreamError
+
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            try:
+                self.tier.fence_reads()
+                return pending
+            except (IOError, OSError) as e:
+                last = e
+                if attempt >= self.retries:
+                    break
+                self.io_retries += 1
+                self._c_retries.inc()
+                logger.warning(
+                    "%s: tier fence failed (%s) — retry %d/%d",
+                    self._prefix, e, attempt + 1, self.retries)
+                if self.retry_backoff_s:
+                    time.sleep(self.retry_backoff_s * (2 ** attempt))
+                pending = self._submit(l)
+        read_sync = getattr(self.tier, "read_sync", None)
+        if read_sync is not None:
+            try:
+                names, shapes, dtypes, _nb = self._meta(l)
+                bufs = [read_sync(n, s, d)
+                        for n, s, d in zip(names, shapes, dtypes)]
+                self.sync_fallbacks += 1
+                self._c_sync_fb.inc()
+                logger.warning(
+                    "%s: aio retries exhausted for item %s — served by "
+                    "synchronous fallback reads", self._prefix, l)
+                if self._trace_on:
+                    self._tracer.event(
+                        f"{self._prefix}_sync_fallback",
+                        attrs={"layer": l})
+                return bufs
+            except Exception as e:
+                last = e
+        from deepspeed_tpu import faults as _faults_mod
+
+        paths = _faults_mod.guarded_postmortem(
+            f"{self._prefix}_stream_fatal")
+        raise FatalStreamError(
+            f"{self._prefix}: tier read of item {l} failed after "
+            f"{self.retries} retries and the synchronous fallback "
+            f"({last!r}); flight-recorder postmortem: "
+            f"{paths or 'no recorder live'}", postmortem_paths=paths)
 
     def presubmit(self, l: int):
         """Submit item ``l``'s tier reads NOW, outside the sweep
@@ -209,7 +283,7 @@ class TierLayerReader:
                     self.stalls += 1
                     self._c_stalls.inc()
                 t0 = time.perf_counter()
-                self.tier.fence_reads()
+                pending = self._fence_retry(l, pending)
                 dt = time.perf_counter() - t0
                 self._h_wait.observe(dt)
                 if on_wait is not None:
@@ -274,14 +348,17 @@ class TierPageReader(TierLayerReader):
     engine serializes admissions with tier hits."""
 
     def __init__(self, pool, keys, to_device, group_pages: int = 8,
-                 registry=None, prefix: str = "kv_tier", tracer=None):
+                 registry=None, prefix: str = "kv_tier", tracer=None,
+                 retries: int = 2, retry_backoff_s: float = 0.05):
         group_pages = max(1, int(group_pages))
         self._pool = pool
         self._groups = [list(keys[i:i + group_pages])
                         for i in range(0, len(keys), group_pages)]
         super().__init__(pool, names_fn=lambda g: [], shapes=(),
                          dtypes=(), to_device=to_device, depth=1,
-                         registry=registry, prefix=prefix, tracer=tracer)
+                         registry=registry, prefix=prefix, tracer=tracer,
+                         retries=retries,
+                         retry_backoff_s=retry_backoff_s)
         # always the aio-style submit/fence path: host-resident entries
         # report zero pending reads, so they fence free and count as
         # prefetch hits — one pipeline serves mixed host/NVMe chains

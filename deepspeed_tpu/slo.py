@@ -58,7 +58,8 @@ class _TierState:
     __slots__ = ("name", "objective", "samples", "alert_active",
                  "c_attained", "c_violated", "c_tokens", "c_good_tokens",
                  "c_ttft_viol", "c_itl_viol", "c_deadline_viol",
-                 "c_alerts", "g_attainment", "g_goodput", "g_burn")
+                 "c_alerts", "g_attainment", "g_goodput", "g_burn",
+                 "c_shed", "c_failed")
 
     def __init__(self, name: str, objective: SLOTierObjective, registry,
                  burn_windows_s: Tuple[float, ...]):
@@ -93,6 +94,16 @@ class _TierState:
         self.c_alerts = r.counter(
             f"slo_{name}_burn_alerts",
             f"tier {name} multiwindow burn-rate alert trips")
+        self.c_shed = r.counter(
+            f"slo_{name}_shed_requests",
+            f"tier {name} requests load-shed at admission (typed "
+            "rejection — never ran, not counted violated; the "
+            "router's retry-elsewhere signal)")
+        self.c_failed = r.counter(
+            f"slo_{name}_failed_requests",
+            f"tier {name} requests failed by a slot/admission "
+            "exception (counted violated too — a failure IS a missed "
+            "objective)")
         self.g_attainment = r.gauge(
             f"slo_{name}_attainment",
             f"tier {name} rolling-window attained fraction "
@@ -197,6 +208,51 @@ class SLOTracker:
         """Drop a record without classifying (cancelled request)."""
         with self._lock:
             self._live.pop(req_id, None)
+
+    def on_shed(self, req_id: Any, tier: Optional[str] = None) -> None:
+        """A load-shed admission rejection: counted per tier but NOT
+        as a violation — the request never ran, and a router retries
+        it elsewhere (polluting attainment with sheds would make
+        shedding look like failing, inverting the incentive)."""
+        if not self.enabled:
+            if tier is not None:
+                raise ValueError(
+                    f"request {req_id!r} names SLO tier {tier!r} but "
+                    "the slo block is disabled — enable it to "
+                    "classify tiers")
+            return
+        tier = tier or self.cfg.default_tier
+        ts = self._tiers.get(tier)
+        if ts is None:
+            raise ValueError(
+                f"request {req_id!r}: unknown SLO tier {tier!r} "
+                f"(declared: {sorted(self._tiers)})")
+        with self._lock:
+            self._live.pop(req_id, None)
+        ts.c_shed.inc()
+
+    def on_fail(self, req_id: Any,
+                now: Optional[float] = None) -> None:
+        """A per-request failure (slot/admission exception): counted
+        failed AND violated — the user got nothing, which is the
+        strongest possible objective miss — and entered into the
+        rolling window so burn rates see failure storms."""
+        if not self.enabled:
+            return
+        with self._lock:
+            rec = self._live.pop(req_id, None)
+        if rec is None:
+            return
+        now = self._clock() if now is None else now
+        ts = rec[0]
+        ts.c_failed.inc()
+        ts.c_violated.inc()
+        ts.c_tokens.inc(rec[5])
+        with self._lock:
+            ts.samples.append((now, False, rec[5]))
+            *_, alert = self._refresh_tier(ts, now)
+        if alert is not None:
+            self._fire_alert(ts.name, alert)
 
     def on_finish(self, req_id: Any,
                   now: Optional[float] = None) -> Optional[bool]:
@@ -374,6 +430,8 @@ class SLOTracker:
                         "deadline_violations": int(
                             ts.c_deadline_viol.value),
                         "burn_alerts": int(ts.c_alerts.value),
+                        "shed": int(ts.c_shed.value),
+                        "failed": int(ts.c_failed.value),
                     },
                     "in_flight": sum(
                         1 for rec in self._live.values()
@@ -403,6 +461,15 @@ class _NullSLOTracker:
 
     def on_finish(self, req_id, now=None):
         return None
+
+    def on_shed(self, req_id, tier=None):
+        if tier is not None:
+            raise ValueError(
+                f"request {req_id!r} names SLO tier {tier!r} but the "
+                "slo block is disabled — enable it to classify tiers")
+
+    def on_fail(self, req_id, now=None):
+        pass
 
     def forget(self, req_id):
         pass

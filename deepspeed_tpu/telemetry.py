@@ -467,7 +467,10 @@ class TelemetryExporter:
         self.monitor = monitor
         self.prometheus_path = prometheus_path
         self.interval_s = max(float(interval_s), 0.0)
-        self._last = 0.0                      # first call always exports
+        # None, not 0.0: monotonic() is time-since-boot, so on a host
+        # up for less than interval_s a 0.0 sentinel would suppress
+        # the first export entirely
+        self._last: Optional[float] = None    # first call always exports
         self._step = 0
         self._httpd = None
         self._http_thread = None
@@ -490,7 +493,8 @@ class TelemetryExporter:
         if not self.registry.enabled:
             return False
         now = time.monotonic()
-        if not force and now - self._last < self.interval_s:
+        if not force and self._last is not None and \
+                now - self._last < self.interval_s:
             return False
         self._last = now
         self._step = self._step + 1 if step is None else int(step)

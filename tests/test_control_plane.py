@@ -8,7 +8,6 @@ import os
 import sys
 import urllib.error
 import urllib.request
-import warnings
 
 import numpy as np
 import pytest
@@ -595,22 +594,15 @@ class TestIntrospection:
 
 
 # -------------------------------------------------------- stats shim
-class TestStatsShimDeprecation:
-    def test_stats_warns_once(self, gpt2_model, devices):
-        import deepspeed_tpu.inference.serving as serving_mod
-
+class TestStatsShimRemoved:
+    def test_stats_attribute_gone(self, gpt2_model, devices):
+        """The PR 6 deprecation shim was removed on its announced PR 9
+        schedule: reading .stats is now an AttributeError, not a
+        warning — readers must use engine.registry.snapshot()."""
         cfg, params = gpt2_model
         eng = _engine(cfg, params)
-        serving_mod._stats_shim_warned = False
-        with warnings.catch_warnings(record=True) as w:
-            warnings.simplefilter("always")
-            eng.stats           # first read warns
-            eng.stats           # second is silent
-        dep = [x for x in w if issubclass(x.category,
-                                          DeprecationWarning)
-               and "ServingEngine.stats" in str(x.message)]
-        assert len(dep) == 1
-        assert "PR 9" in str(dep[0].message)
+        with pytest.raises(AttributeError):
+            eng.stats
 
 
 # -------------------------------------------------------- bench gate

@@ -91,7 +91,9 @@ class TestServing:
         eng.submit("x", [5, 9, 2], max_new_tokens=12)
         eng.submit("y", [17, 3, 3], max_new_tokens=12)
         outs = eng.run()
-        assert eng.stats["preempted"] >= 1, "pool never pressured"
+        cnt = eng.registry.snapshot()["counters"]
+        assert cnt["serving_preempted_requests"] >= 1, \
+            "pool never pressured"
         assert outs["x"] == offline_expected(cfg, params, [5, 9, 2], 12)
         assert outs["y"] == offline_expected(cfg, params, [17, 3, 3], 12)
 
@@ -209,9 +211,11 @@ class TestDecodeChunk:
         # 15 decode tokens (1 comes from prefill): K=1 needs 15 syncs,
         # K=8 needs ceil(15/8)=2 — the K-fold round-trip reduction is
         # the measured quantity, not device step count
-        assert e1.stats["decode_syncs"] == 15
-        assert e8.stats["decode_syncs"] == 2
-        assert e8.stats["decode_steps"] == 16
+        c1 = e1.registry.snapshot()["counters"]
+        c8 = e8.registry.snapshot()["counters"]
+        assert c1["serving_decode_syncs"] == 15
+        assert c8["serving_decode_syncs"] == 2
+        assert c8["serving_decode_steps"] == 16
 
     def test_chunked_with_more_requests_than_slots(self, model, devices):
         cfg, params = model
@@ -276,7 +280,8 @@ class TestChunkedPrefill:
             max_seq=64, prefill_chunk=8)
         eng.submit("long", prompt, max_new_tokens=5)
         outs = eng.run()
-        assert eng.stats["prefill_chunks"] == 5   # ceil(37/8)
+        assert eng.registry.snapshot()["counters"][
+            "serving_prefill_chunks"] == 5        # ceil(37/8)
         assert outs["long"] == offline_chunked_expected(
             cfg, params, prompt, 5, C=8)
 

@@ -81,7 +81,8 @@ class TestMixtralServing:
         outs = eng.run()
         assert outs["long"] == offline_expected(cfg, params, long_prompt, 5)
         assert outs["a"] == offline_expected(cfg, params, *PROMPTS["a"])
-        assert eng.stats["prefill_chunks"] >= 6
+        assert eng.registry.snapshot()["counters"][
+            "serving_prefill_chunks"] >= 6
 
     @pytest.mark.slow
     def test_int8_serving_keeps_router_exact(self, model, devices):

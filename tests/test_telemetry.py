@@ -302,10 +302,7 @@ class TestServingTelemetry:
         assert 0.0 <= gauges["serving_kv_page_utilization"] <= 1.0
         # the step span feeds both the histogram and a TraceAnnotation
         assert hists["serving_step_seconds"]["count"] >= 5
-        # deprecation shim mirrors the registry
-        assert eng.stats["admitted"] == 4
-        assert eng.stats["decode_steps"] == \
-            int(cnt["serving_decode_steps"])
+        assert cnt["serving_admitted_requests"] == 4
 
     def test_tokens_identical_with_telemetry_disabled(self, gpt2_model,
                                                       devices):
@@ -405,14 +402,12 @@ class TestStreamingTelemetry:
             cnt["zi_layer_sweeps"] * zi.plan["n_streamed"]
         assert cnt["zi_bytes_uploaded"] > 0
         assert cnt["zi_stream_bytes_read"] > 0   # TierLayerReader fan-in
-        assert zi.stats["layer_h2d_uploads"] == \
-            int(cnt["zi_layer_h2d_uploads"])
-        assert zi.stats["prefetch_wait_s"] == pytest.approx(
-            snap["histograms"]["zi_prefetch_wait_seconds"]["sum"])
+        assert snap["histograms"][
+            "zi_prefetch_wait_seconds"]["count"] >= 0
 
-    def test_zero_inference_disabled_stats_reads_zeros(self, devices):
-        """The stats shim must not raise with telemetry off (null
-        metrics answer .sum/.value)."""
+    def test_zero_inference_serves_with_telemetry_disabled(self, devices):
+        """The streamed engine must serve with telemetry off (null
+        metrics answer .sum/.value on every streaming hot path)."""
         from deepspeed_tpu.inference.zero_inference import (
             zero_inference_serving_engine)
         from deepspeed_tpu.models import llama
@@ -425,9 +420,10 @@ class TestStreamingTelemetry:
             family="llama", max_batch=2, page_size=8, num_pages=16,
             max_seq=32, prefill_bucket=8, telemetry=False)
         zi.submit("a", [5, 9], max_new_tokens=3)
-        zi.run()
-        assert zi.stats["layer_h2d_uploads"] == 0
-        assert zi.stats["prefetch_wait_s"] == 0.0
+        outs = zi.run()
+        assert len(outs["a"]) == 5               # prompt + 3 generated
+        assert not zi.registry.enabled
+        assert zi.registry.snapshot()["counters"] == {}
 
 
 class TestAioTelemetry:

@@ -92,8 +92,9 @@ class TestZeroInferenceServing:
         out_z = _serve(zi)
         assert out_z == out_r
         # every decode/prefill sweep re-streamed the non-resident suffix
-        assert zi.stats["layer_h2d_uploads"] >= \
-            zi.plan["n_streamed"] * zi.stats["layer_sweeps"]
+        cnt = zi.registry.snapshot()["counters"]
+        assert cnt["zi_layer_h2d_uploads"] >= \
+            zi.plan["n_streamed"] * cnt["zi_layer_sweeps"]
 
     def test_partial_residency_pins_leading_layers(self, devices):
         # 5 layers so the budget interval [floor + 1 layer, image - 1]

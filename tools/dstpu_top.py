@@ -44,6 +44,8 @@ def render(status: dict, health: dict | None = None) -> list:
            f"  step age {status.get('last_step_age_s')}s")
     if health is not None:
         hdr += ("  READY" if health.get("ready") else "  NOT-READY")
+        if health.get("degraded"):
+            hdr += "  DEGRADED"
         wd = health.get("watchdog")
         if wd:
             hdr += (f"  wd {'FIRED' if wd['fired'] else 'ok'} "
@@ -80,6 +82,22 @@ def render(status: dict, health: dict | None = None) -> list:
         L.append(f"spec  sweeps {sp.get('verify_sweeps', 0)}"
                  f"  mean accept "
                  f"{mal if mal is not None else '-'}")
+    rb = status.get("robustness", {})
+    rkt = rb.get("kv_tier", {})
+    if rb and (rb.get("degraded") or rb.get("shed_requests")
+               or rb.get("failed_requests")
+               or rkt.get("fallback_events")):
+        reasons = " ".join(sorted(f"{k}={v}" for k, v in
+                                  rb.get("shed_by_reason", {}).items()))
+        L.append(f"rbst  shed {rb.get('shed_requests', 0)}"
+                 f"/{100 * rb.get('shed_rate', 0.0):.0f}%"
+                 f"{' (' + reasons + ')' if reasons else ''}"
+                 f"  failed {rb.get('failed_requests', 0)}"
+                 f"  tier-fallback {rkt.get('fallback_events', 0)}"
+                 f"  cksum {rkt.get('checksum_failures', 0)}"
+                 f"{'  TIER-DISABLED' if rkt.get('disabled') else ''}"
+                 + ("  DEGRADED: " + ",".join(rb.get("reasons", []))
+                    if rb.get("degraded") else ""))
     zi = status.get("zero_inference")
     if zi:
         L.append(f"zi    streamed {zi['plan'].get('n_streamed', 0)}/"

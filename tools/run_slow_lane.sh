@@ -76,6 +76,16 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/trace_report.py \
   --selftest --cpu --json-out "$REPO/TRACE_SAMPLE.json" \
   >/dev/null 2>&1 || true
 
+# chaos soak: serve traffic under a seeded injected-fault schedule
+# (aio failures, spilled-page corruption, slot exceptions, a queue
+# burst) and assert graceful degradation — completed requests token-
+# identical to a fault-free oracle, no watchdog fire, clean drain,
+# zero page leaks, and shed/failed counts reconciling across
+# telemetry, SLO and trace exports.  Stamps CHAOS_SOAK.json, gated by
+# bench_gate below.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py \
+  --cpu --json-out "$REPO/CHAOS_SOAK.json" >/dev/null 2>&1 || true
+
 # bench regression gate: AFTER the stamps above, diff the evidence
 # files against the committed BENCH_BASELINE.json and leave a verdict
 # in BENCH_GATE.json — the perf trajectory as an enforced contract.
