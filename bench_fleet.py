@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Open-loop fleet bench: goodput-vs-load and failover-recovery curves
+for the replicated serving front end (``deepspeed_tpu/fleet.py``).
+
+Open-loop means arrivals come from a Poisson process whose rate does
+NOT slow down when the fleet saturates — the regime a million-user
+front end actually lives in, and the one closed-loop benches (submit →
+wait → submit) structurally cannot show: past saturation a closed loop
+self-throttles, while an open loop keeps offering load and the fleet
+must shed it.  Two stamps:
+
+- **goodput vs load** (``load_curve``): sweep arrival rates past
+  saturation; per rate record offered vs completed throughput, goodput
+  (SLO-attained tokens/s from the fleet rollup), attainment, shed
+  rate, and the affinity hit rate.  The headline shape: throughput
+  plateaus at saturation while goodput holds (shedding keeps accepted
+  work inside its deadlines) — if goodput collapses instead, admission
+  control is mis-tuned.
+- **failover recovery** (``failover``): at a fixed mid-saturation
+  rate, kill one of the replicas mid-traffic and record the completion
+  throughput in 0.5 s buckets around the kill, plus ``recovery_s`` —
+  the time until every request salvaged off the dead replica reached a
+  terminal result.
+
+    python bench_fleet.py --cpu --json-out FLEET_BENCH.json
+    python bench_fleet.py --cpu --rates 2,5,10 --duration 4
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+MAX_NEW = 8
+WALL_CAP_S = 120.0
+
+
+def build_prompts(vocab, n_users: int, seed: int):
+    """Shared-prefix workload: ``n_users`` system prompts, each request
+    = one of them + a unique tail (the affinity router's case)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(1, vocab, 16).tolist()
+                for _ in range(n_users)]
+
+    def make(i: int):
+        return prefixes[i % n_users] + \
+            rng.integers(1, vocab, 3).tolist()
+
+    return make
+
+
+def poisson_arrivals(rate_per_s: float, duration_s: float, seed: int):
+    """Cumulative Poisson arrival times within [0, duration_s)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    out, t = [], 0.0
+    while True:
+        t += float(rng.exponential(1.0 / rate_per_s))
+        if t >= duration_s:
+            return out
+        out.append(t)
+
+
+def build_router(params, cfg, args, seed: int):
+    from deepspeed_tpu.fleet import fleet_router
+
+    return fleet_router(
+        params, cfg,
+        fleet={"replicas": args.replicas, "retry_budget": 2,
+               "shed_queue_depth": args.fleet_shed,
+               "digest_refresh_steps": 2},
+        prefix_cache=True,
+        slo={"tiers": {"interactive": {
+            "ttft_s": args.slo_ttft_s,
+            "deadline_s": args.slo_deadline_s}},
+            "default_tier": "interactive"},
+        shed_queue_depth=args.replica_shed,
+        max_batch=args.slots, page_size=8,
+        num_pages=args.num_pages, max_seq=64, prefill_bucket=8,
+        seed=seed)
+
+
+def drive_open_loop(router, arrivals, make_prompt, *, kill=None,
+                    bucket_s: float = 0.5):
+    """Submit arrivals on their schedule while stepping the fleet;
+    returns (stats dict, per-bucket completion counts).  ``kill`` =
+    (t_offset_s, replica_id) fires a replica death mid-run."""
+    t0 = time.perf_counter()
+    next_i = 0
+    buckets = {}
+    killed_at = None
+    salvaged = set()
+    recovery_s = None
+    submitted = 0
+    first_tok = {}
+    while True:
+        now = time.perf_counter() - t0
+        while next_i < len(arrivals) and arrivals[next_i] <= now:
+            router.submit(f"b{next_i:04d}", make_prompt(next_i),
+                          max_new_tokens=MAX_NEW)
+            submitted += 1
+            next_i += 1
+        if kill is not None and killed_at is None and \
+                now >= kill[0]:
+            router.kill(kill[1], error="bench kill")
+            killed_at = time.perf_counter() - t0
+            # the router's failover ledger names exactly the salvage
+            # set — resubmit counts would also catch shed retries
+            fo = router.last_failover
+            salvaged = set(fo["resubmitted"]) if fo else set()
+        done = router.step()
+        if done:
+            b = int((time.perf_counter() - t0) / bucket_s)
+            buckets[b] = buckets.get(b, 0) + len(done)
+        if killed_at is not None and recovery_s is None and \
+                all(k in router.finished for k in salvaged):
+            recovery_s = (time.perf_counter() - t0) - killed_at
+        if next_i >= len(arrivals) and not router.has_work:
+            break
+        if now > WALL_CAP_S:
+            break
+    elapsed = time.perf_counter() - t0
+    return {"submitted": submitted, "elapsed_s": elapsed,
+            "killed_at_s": killed_at, "recovery_s": recovery_s,
+            "salvaged": len(salvaged)}, buckets
+
+
+def summarize(router, drove, rate):
+    from deepspeed_tpu.inference.serving import (RequestFailed,
+                                                 RequestShed)
+
+    fin = router.finished
+    completed = [v for v in fin.values() if isinstance(v, list)]
+    shed = sum(1 for v in fin.values() if isinstance(v, RequestShed))
+    failed = sum(1 for v in fin.values()
+                 if isinstance(v, RequestFailed))
+    slo = router.statusz()["slo"]
+    # generated-token numerators from the SLO rollup for BOTH rates, so
+    # goodput/throughput compare like for like (completed lists carry
+    # prompt tokens too — counting those would inflate throughput)
+    life = {"attained": 0, "violated": 0, "tokens": 0,
+            "goodput_tokens": 0}
+    if slo.get("enabled"):
+        for t in slo["tiers"].values():
+            for k in life:
+                life[k] += t["lifetime"].get(k, 0)
+    tokens = life["tokens"]
+    n_class = life["attained"] + life["violated"]
+    aff = router.statusz()["fleet"]["affinity"]
+    el = max(drove["elapsed_s"], 1e-9)
+    return {
+        "rate_per_s": rate,
+        "offered": drove["submitted"],
+        "completed": len(completed),
+        "shed": shed,
+        "failed": failed,
+        "shed_rate": round(shed / max(drove["submitted"], 1), 4),
+        "tokens_per_s": round(tokens / el, 2),
+        "goodput_tokens_per_s": round(
+            life["goodput_tokens"] / el, 2),
+        "attainment": round(life["attained"] / n_class, 4)
+        if n_class else 1.0,
+        "affinity_hit_rate": aff["hit_rate"],
+        "elapsed_s": round(el, 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--num-pages", type=int, default=12)
+    ap.add_argument("--rates", default="2,6,14",
+                    help="comma-separated arrival rates (req/s); make "
+                         "the last one sit past saturation")
+    ap.add_argument("--duration", type=float, default=4.0,
+                    help="offered-traffic window per rate (s)")
+    ap.add_argument("--users", type=int, default=4,
+                    help="distinct shared prefixes (affinity targets)")
+    ap.add_argument("--fleet-shed", type=int, default=24,
+                    help="fleet-level aggregate queue-depth shed")
+    ap.add_argument("--replica-shed", type=int, default=8,
+                    help="per-replica queue-depth shed")
+    ap.add_argument("--slo-ttft-s", type=float, default=3.0)
+    ap.add_argument("--slo-deadline-s", type=float, default=20.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json-out",
+                    default=os.path.join(REPO, "FLEET_BENCH.json"))
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.utils.evidence import atomic_write_json
+
+    t_start = time.perf_counter()
+    cfg = gpt2.GPT2Config.tiny(dim=64, n_layers=2, n_heads=4,
+                               max_seq_len=128)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    make_prompt = build_prompts(cfg.vocab_size, args.users, args.seed)
+    rates = [float(r) for r in args.rates.split(",") if r]
+
+    # warmup: compile the serving programs outside the timed windows
+    router = build_router(params, cfg, args, seed=args.seed)
+    router.submit("warm", make_prompt(0), max_new_tokens=4)
+    router.run()
+    router.shutdown()
+
+    load_curve = []
+    for rate in rates:
+        router = build_router(params, cfg, args, seed=args.seed)
+        arrivals = poisson_arrivals(rate, args.duration,
+                                    args.seed + int(rate * 1000))
+        drove, _ = drive_open_loop(router, arrivals, make_prompt)
+        row = summarize(router, drove, rate)
+        load_curve.append(row)
+        print(json.dumps(row), flush=True)
+        router.shutdown()
+
+    # failover recovery at the middle rate: kill one replica a third
+    # of the way into the offered window
+    mid = rates[len(rates) // 2]
+    router = build_router(params, cfg, args, seed=args.seed)
+    arrivals = poisson_arrivals(mid, args.duration, args.seed + 7)
+    drove, buckets = drive_open_loop(
+        router, arrivals, make_prompt,
+        kill=(args.duration / 3.0, "r1"))
+    fo_row = summarize(router, drove, mid)
+    failover = {
+        **fo_row,
+        "killed_replica": "r1",
+        "killed_at_s": round(drove["killed_at_s"], 3)
+        if drove["killed_at_s"] is not None else None,
+        "recovery_s": round(drove["recovery_s"], 3)
+        if drove["recovery_s"] is not None else None,
+        "salvaged_requests": drove["salvaged"],
+        "orphaned_requests": len(router.orphaned()),
+        "leak_count": len(router.check_leaks()),
+        "throughput_buckets": [
+            {"t_s": round(b * 0.5, 1), "completed": n}
+            for b, n in sorted(buckets.items())],
+    }
+    print(json.dumps({k: v for k, v in failover.items()
+                      if k != "throughput_buckets"}), flush=True)
+    router.shutdown()
+
+    out = {
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(),
+        "model": "gpt2-tiny",
+        "replicas": args.replicas,
+        "duration_per_rate_s": args.duration,
+        "load_curve": load_curve,
+        "failover": failover,
+        "duration_s": round(time.perf_counter() - t_start, 2),
+    }
+    atomic_write_json(out, args.json_out)
+    print("→", args.json_out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

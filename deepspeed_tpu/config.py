@@ -571,6 +571,91 @@ class FaultsConfig:
 
 
 @dataclasses.dataclass
+class FleetConfig:
+    """Replicated serving fleet block (the multi-replica front end of
+    ROADMAP open item 2; consumed by :class:`~deepspeed_tpu.fleet.
+    FleetRouter`).  A fleet spreads open-loop traffic across
+    ``replicas`` in-process :class:`~deepspeed_tpu.inference.serving.
+    ServingEngine` replicas: routing is prefix-cache-affine when
+    ``affinity`` is on (the router matches a prompt's chained page keys
+    against each replica's published-key digest and sends the request
+    where its prefix is warm) with least-loaded fallback; per-replica
+    health (watchdog, degraded flags, kv-tier breaker, shed activity)
+    feeds a HEALTHY → DEGRADED → QUARANTINED → DRAINING → DEAD state
+    machine with hysteresis; a dead or fatally-stalled replica fails
+    over — its queued and zero-token in-flight requests re-submit to
+    survivors under ``retry_budget``, requests that already emitted
+    tokens fail typed (never double-generate).
+
+    ``quarantine_after``: consecutive degraded health polls before a
+    DEGRADED replica stops receiving new admissions (QUARANTINED);
+    ``recover_after``: consecutive healthy polls to step back one state
+    (the hysteresis that stops flapping).  ``shed_queue_depth``: fleet-
+    level admission shedding — aggregate queued requests across
+    routable replicas at or past this depth return a typed
+    ``RequestShed`` from ``submit`` (0 = off; per-replica
+    ``shed_queue_depth`` still applies underneath).
+    ``digest_refresh_steps``: router steps between published-key digest
+    refreshes (the affinity lookup's staleness bound).
+    ``fatal_stall_s``: a replica stalled longer than this is treated as
+    dead (failover) rather than waited out.
+    """
+
+    replicas: int = 2
+    affinity: bool = True
+    retry_budget: int = 2
+    quarantine_after: int = 3
+    recover_after: int = 2
+    shed_queue_depth: int = 0
+    digest_refresh_steps: int = 8
+    fatal_stall_s: float = 5.0
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FleetConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        f = cls(**{k: v for k, v in d.items() if k in known})
+        f.replicas = int(f.replicas)
+        if f.replicas < 1:
+            raise ValueError(
+                f"fleet.replicas must be >= 1, got {f.replicas}")
+        f.affinity = bool(f.affinity)
+        f.retry_budget = int(f.retry_budget)
+        if f.retry_budget < 0:
+            raise ValueError(
+                f"fleet.retry_budget must be >= 0, got {f.retry_budget}")
+        for name, lo in (("quarantine_after", 1), ("recover_after", 1),
+                         ("shed_queue_depth", 0),
+                         ("digest_refresh_steps", 1)):
+            v = int(getattr(f, name))
+            setattr(f, name, v)
+            if v < lo:
+                raise ValueError(
+                    f"fleet.{name} must be >= {lo}, got {v}")
+        f.fatal_stall_s = float(f.fatal_stall_s)
+        if f.fatal_stall_s <= 0:
+            raise ValueError(
+                f"fleet.fatal_stall_s must be positive, got "
+                f"{f.fatal_stall_s}")
+        return f
+
+    @classmethod
+    def coerce(cls, obj) -> "FleetConfig":
+        """Accept None (defaults), an int (replica count), a dict, or a
+        FleetConfig."""
+        if obj is None:
+            return cls()
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, int) and not isinstance(obj, bool):
+            return cls.from_dict({"replicas": obj})
+        if isinstance(obj, dict):
+            return cls.from_dict(obj)
+        raise TypeError(
+            f"fleet must be an int, dict or FleetConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class TelemetryConfig:
     """Runtime telemetry block (no single reference analogue — it
     unifies the reference's monitor/comms-logger/flops-profiler
@@ -843,6 +928,7 @@ class Config:
     slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
     faults: FaultsConfig = dataclasses.field(
         default_factory=FaultsConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig)
     tracing: TracingConfig = dataclasses.field(
@@ -974,6 +1060,8 @@ class Config:
             # (same contract as kv_tier / slo above); an explicit
             # "enabled": false still disables
             c.faults = FaultsConfig.coerce(d["faults"])
+        if "fleet" in d:
+            c.fleet = FleetConfig.coerce(d["fleet"])
         if "telemetry" in d:
             c.telemetry = TelemetryConfig.coerce(d["telemetry"])
         if "tracing" in d:

@@ -42,6 +42,16 @@ module provides both halves of proving that:
   burst       no engine hook: consumed by the chaos soak's traffic
               generator to trigger admission bursts (queue pressure →
               load shedding).
+  replica     the :class:`~deepspeed_tpu.fleet.FleetRouter`'s per-
+              replica poll (one opportunity per replica per router
+              step; ``match=`` targets a replica id).  Mode ``error``
+              KILLS the replica (the router fails it over), mode
+              ``latency`` STALLS it for ``latency_s`` (a stall past
+              the fleet's ``fatal_stall_s`` is treated as a death),
+              and the replica-only mode ``degrade`` forces its health
+              to degraded for ``latency_s`` seconds (default 30) —
+              quarantine/hysteresis exercise without breaking
+              anything.
   ========== ===========================================================
 
 - **Degradation helpers**: :func:`retry_with_backoff` (the bounded
@@ -94,12 +104,12 @@ class FatalStreamError(RuntimeError):
 
 
 SUBSYSTEMS = ("aio_read", "aio_write", "kv_corrupt", "slot",
-              "sync_read", "burst")
-MODES = ("error", "latency")
+              "sync_read", "burst", "replica")
+MODES = ("error", "latency", "degrade")
 # subsystems whose opportunities carry a key a `match` filter can test
 # (aio ops and bursts are anonymous — a match there would validate
 # fine and silently never fire, so it is rejected at rule build)
-_KEYED_SUBSYSTEMS = ("kv_corrupt", "slot", "sync_read")
+_KEYED_SUBSYSTEMS = ("kv_corrupt", "slot", "sync_read", "replica")
 
 
 @dataclasses.dataclass
@@ -154,6 +164,11 @@ class FaultRule:
         if self.mode == "latency" and self.latency_s == 0.0:
             raise ValueError(
                 "faults rule mode 'latency' needs latency_s > 0")
+        if self.mode == "degrade" and self.subsystem != "replica":
+            raise ValueError(
+                "faults rule mode 'degrade' only applies to the "
+                "'replica' subsystem — other hook points have no "
+                "degraded state to force")
         if self.match is not None and \
                 self.subsystem not in _KEYED_SUBSYSTEMS:
             raise ValueError(
@@ -283,6 +298,17 @@ def active_plan() -> Optional[FaultPlan]:
     return _PLAN
 
 
+def ensure_installed(plan: Optional[FaultPlan]) -> bool:
+    """Install ``plan`` process-wide unless it already is the active
+    plan; returns True when THIS call installed it (the caller then
+    owns the matching :func:`clear_fault_plan`).  The shared
+    install-once-own-once step every engine/router lifecycle runs."""
+    if plan is None or active_plan() is plan:
+        return False
+    install_fault_plan(plan)
+    return True
+
+
 def poll(subsystem: str, key: Any = None
          ) -> Tuple[float, Optional[FaultRule]]:
     """Hook-side check WITHOUT side effects beyond stream advance:
@@ -300,6 +326,19 @@ def poll(subsystem: str, key: Any = None
         elif err is None:
             err = rule
     return delay, err
+
+
+def poll_replica(replica_id: Any) -> List[FaultRule]:
+    """Fleet-router hook: one opportunity for the ``replica``
+    subsystem (key = the replica id; ``match`` filters on it).
+    Returns the fired rules raw — the router interprets mode
+    ``error`` as kill, ``latency`` as stall-for ``latency_s``, and
+    ``degrade`` as force-degrade (unlike :func:`poll`, which folds
+    modes into a (delay, error) pair no router could act on)."""
+    plan = _PLAN
+    if plan is None:
+        return []
+    return plan.fire("replica", replica_id)
 
 
 def inject(subsystem: str, key: Any = None) -> bool:

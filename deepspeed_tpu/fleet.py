@@ -1,0 +1,1038 @@
+"""Replicated serving fleet: health-aware routing, failover, and
+graceful drain (ROADMAP open item 2 — the multi-replica front end for
+millions-of-users traffic).
+
+Everything through the chaos-hardened single engine (PR 9) made ONE
+:class:`~deepspeed_tpu.inference.serving.ServingEngine` degrade
+predictably: typed ``RequestShed``/``RequestFailed`` results, a
+degraded-but-serving ``/healthz``, per-tier shed accounting, and clean
+page-leak invariants.  This module is the layer that contract was built
+for: a :class:`FleetRouter` spreads open-loop traffic across N
+in-process replicas — each potentially a ZeRO-Infinity-style weight-
+streamed engine serving a >HBM model (arXiv:2104.07857), so the fleet
+is also how streamed serving reaches aggregate throughput — and makes
+the FLEET robust where PR 9 made the engine robust:
+
+- **prefix-cache-affine routing**: the content-addressed page keys of
+  PR 3 make "which replica has this prompt warm" a set lookup against
+  per-replica published-key digests (HBM index + spilled tier entries);
+  a warm match routes there, everything else goes least-loaded.
+- **health state machine with hysteresis**: each replica's existing
+  signals (watchdog ``health()``, degraded ``/healthz`` reasons, the
+  kv-tier circuit breaker, shed activity) feed
+  HEALTHY → DEGRADED → QUARANTINED → DRAINING → DEAD; a replica must
+  stay clean for ``recover_after`` consecutive polls to step back one
+  state, so a flapping replica cannot oscillate in and out of the
+  routing set.
+- **failover with bounded retry and idempotent req_ids**: a dead or
+  fatally-stalled replica's queued and zero-token in-flight requests
+  re-submit to survivors (each hop charges the request's
+  ``retry_budget``); a request that already emitted tokens fails typed
+  (``RequestFailed(reason="replica_failed", generated=n)``) rather
+  than double-generating, and NO request is ever silently dropped —
+  salvage falls back to typed failure for anything it cannot re-route.
+- **fleet-level admission shedding**: when the aggregate queue depth
+  across routable replicas says the survivors cannot absorb the load,
+  ``submit`` returns a typed ``RequestShed`` instead of queueing doomed
+  work (the same first-class outcome the per-replica shedding
+  produces).
+- **graceful drain + rejoin** (the rolling-restart primitive):
+  :meth:`FleetRouter.drain` stops new admissions to a replica, re-routes
+  its queued work, lets in-flight requests finish, and republishes its
+  warm prefix digest to its affinity successor so the shared-prefix
+  traffic follows the warmth; :meth:`FleetRouter.rejoin` brings the
+  replica (or a fresh replacement engine for a dead slot) back into
+  rotation and restores its affinity from its actual warm pool.
+
+Chaos composes: the ``faults`` plan's ``replica`` rules (kill /
+stall-for / force-degrade, ``match=`` a replica id) fire through the
+router's per-step poll, so the soak can kill one of three replicas
+mid-traffic and assert every accepted request still resolves token-
+identical or typed (``tools/chaos_soak.py --fleet``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu import faults as faults_mod
+from deepspeed_tpu.config import (FaultsConfig, FleetConfig,
+                                  TelemetryConfig, TracingConfig)
+from deepspeed_tpu.faults import FaultPlan, InjectedFault
+from deepspeed_tpu.inference.prefix_cache import (matchable_pages,
+                                                  page_keys)
+from deepspeed_tpu.inference.serving import (EngineClosed, RequestFailed,
+                                             RequestShed, RequestResult)
+from deepspeed_tpu.request_trace import RequestTracer
+from deepspeed_tpu.slo import fleet_rollup
+from deepspeed_tpu.telemetry import MetricsRegistry, TelemetryExporter
+from deepspeed_tpu.utils.logging import logger
+
+# ------------------------------------------------------ replica states
+HEALTHY = "healthy"          # full routing weight
+DEGRADED = "degraded"        # still admits (deprioritized vs HEALTHY)
+QUARANTINED = "quarantined"  # no new admissions; in-flight continues
+DRAINING = "draining"        # planned drain: no admissions, finishing
+DEAD = "dead"                # failed over; engine shut down
+
+# states a new admission may route to (HEALTHY preferred on ties)
+_ROUTABLE = (HEALTHY, DEGRADED)
+# forced-degrade fault rules with no explicit window last this long
+_FORCED_DEGRADE_DEFAULT_S = 30.0
+
+
+@dataclasses.dataclass
+class _FleetReq:
+    """Router-side ledger entry: everything needed to re-submit the
+    request to a survivor (failover/drain) plus the retry budget that
+    bounds how often that may happen."""
+
+    req_id: Any
+    tokens: List[int]
+    max_new_tokens: int
+    temperature: float
+    tier: Optional[str]
+    t_arrival: float
+    retries_left: int
+    keys: Optional[List[bytes]] = None   # chained page keys (affinity)
+    replica: Optional[str] = None        # current assignment
+    resubmits: int = 0
+
+
+class Replica:
+    """One engine plus its router-side state machine and digest."""
+
+    def __init__(self, rid: str, engine):
+        self.id = rid
+        self.engine = engine
+        self.state = HEALTHY
+        self.digest: frozenset = frozenset()
+        self.assigned: set = set()       # req_ids routed here, live
+        self.degraded_streak = 0
+        self.healthy_streak = 0
+        # digest keys inherited from a drained predecessor: a routing
+        # hint the periodic refresh must not wipe (the successor does
+        # not hold these pages yet — they drop out one by one as the
+        # real warm pool catches up, or wholesale on rejoin/death)
+        self.inherited: frozenset = frozenset()
+        self.health_reasons: List[str] = []
+        self.stall_started = 0.0
+        self.stall_until = 0.0
+        self.forced_degrade_until = 0.0
+        self.affinity_hits = 0
+        self.state_since = time.perf_counter()
+
+    @property
+    def routable(self) -> bool:
+        return self.state in _ROUTABLE
+
+    def load(self) -> int:
+        """Routing load signal: queued + active slots."""
+        e = self.engine
+        return len(e.queue) + sum(1 for s in e.slots if s is not None)
+
+    def set_state(self, state: str) -> None:
+        if state != self.state:
+            self.state = state
+            self.state_since = time.perf_counter()
+
+
+class FleetRouter:
+    """Route open-loop traffic across N in-process serving replicas.
+
+    ``engines``: homogeneous :class:`~deepspeed_tpu.inference.serving.
+    ServingEngine` replicas (same model, same page_size/max_seq — the
+    router re-submits requests between them, so a request valid on one
+    must be valid on all).  Build them with ``replica_id=`` so their
+    trace streams are attributable; :func:`fleet_router` does all of
+    this from a model config.
+
+    Surface mirrors the engine: :meth:`submit` → :meth:`step`/
+    :meth:`run` → ``finished`` (token lists or typed
+    ``RequestShed``/``RequestFailed``), plus the fleet verbs
+    :meth:`drain`, :meth:`rejoin`, :meth:`kill`, and the introspection
+    providers :meth:`statusz`/:meth:`healthz`.
+    """
+
+    def __init__(self, engines, *, fleet=None, telemetry=None,
+                 faults=None):
+        self.cfg = FleetConfig.coerce(fleet)
+        if not engines:
+            raise ValueError("FleetRouter needs at least one engine")
+        self.replicas: "collections.OrderedDict[str, Replica]" = \
+            collections.OrderedDict()
+        for i, eng in enumerate(engines):
+            rid = eng.replica_id or f"r{i}"
+            if eng.replica_id is None:
+                # late tag: statusz/healthz attribution still works
+                # (trace binding needs replica_id at engine build)
+                eng.replica_id = rid
+            if rid in self.replicas:
+                raise ValueError(f"duplicate replica id {rid!r}")
+            self.replicas[rid] = Replica(rid, eng)
+        r0 = engines[0]
+        self.page_size = r0.page_size
+        self._affinity = self.cfg.affinity and \
+            any(rep.engine._pc_on for rep in self.replicas.values())
+
+        # ---- fault plan: the router owns the process-wide install for
+        # `replica` rules (engines passed the SAME plan instance see it
+        # already active and do not re-own it)
+        if isinstance(faults, FaultPlan):
+            self._fault_plan: Optional[FaultPlan] = faults
+        else:
+            fcfg = FaultsConfig.coerce(faults)
+            self._fault_plan = (FaultPlan.from_config(fcfg)
+                                if fcfg.enabled else None)
+        self._owns_fault_plan = faults_mod.ensure_installed(
+            self._fault_plan)
+
+        # ---- fleet rollup registry (per-replica registries stay on
+        # the engines; this one carries only fleet-level aggregates)
+        if isinstance(telemetry, MetricsRegistry):
+            self.registry = telemetry
+            tcfg = None
+        else:
+            tcfg = TelemetryConfig.coerce(telemetry)
+            self.registry = MetricsRegistry(enabled=tcfg.enabled)
+        r = self.registry
+        self._c_submitted = r.counter(
+            "fleet_submitted_requests", "requests offered to the fleet")
+        self._c_completed = r.counter(
+            "fleet_completed_requests",
+            "requests that finished with tokens on some replica")
+        self._c_failed = r.counter(
+            "fleet_failed_requests",
+            "requests surfaced as typed RequestFailed at the fleet "
+            "(replica death mid-generation, retry budget exhausted, "
+            "or an unretried per-replica failure)")
+        self._c_shed = r.counter(
+            "fleet_shed_requests",
+            "requests surfaced as typed RequestShed at the fleet "
+            "(fleet queue-depth admission shed, no routable replica, "
+            "or an unretried per-replica shed)")
+        self._c_affinity = r.counter(
+            "fleet_affinity_routed",
+            "admissions routed by a warm prefix-digest match")
+        self._c_least_loaded = r.counter(
+            "fleet_least_loaded_routed",
+            "admissions routed by least-loaded fallback (no warm "
+            "match, or affinity off)")
+        self._c_resubmits = r.counter(
+            "fleet_resubmitted_requests",
+            "re-submissions to a survivor (failover salvage or a "
+            "retried per-replica shed/failure; each charges the "
+            "request's retry budget)")
+        self._c_drain_reroutes = r.counter(
+            "fleet_drain_rerouted_requests",
+            "queued requests re-routed off a draining replica "
+            "(planned movement — does NOT charge retry budget)")
+        self._c_failovers = r.counter(
+            "fleet_failovers", "replica deaths failed over")
+        self._c_drains = r.counter(
+            "fleet_drains", "planned drains started")
+        self._c_rejoins = r.counter(
+            "fleet_rejoins", "replicas rejoined after drain/death")
+        self._c_replica_sheds = r.counter(
+            "fleet_replica_shed_returns",
+            "typed sheds returned by a replica to the router "
+            "(retried elsewhere when budget allows)")
+        self._g_queue = r.gauge(
+            "fleet_queue_depth",
+            "aggregate queued requests across routable replicas")
+        self._g_active = r.gauge(
+            "fleet_active_slots",
+            "aggregate active slots across live replicas")
+        self._g_routable = r.gauge(
+            "fleet_routable_replicas",
+            "replicas currently accepting new admissions")
+
+        # host-side accounting (works with telemetry disabled; the
+        # soak reconciles these against typed results and the registry)
+        self._n_submitted = 0
+        self._n_completed = 0
+        self._n_failed = 0
+        self._n_shed = 0
+        self._shed_by_reason: Dict[str, int] = {}
+        self._n_resubmits = 0
+
+        self.requests: Dict[Any, _FleetReq] = {}    # live ledger
+        self.finished: Dict[Any, RequestResult] = {}
+        # ledger of the most recent failover: which requests the
+        # salvage re-placed vs failed typed — the soak and the bench
+        # measure recovery against exactly this set (inferring it from
+        # resubmit counts would also catch unrelated shed retries)
+        self.last_failover: Optional[Dict[str, Any]] = None
+        self._newly_finished: List[Any] = []
+        self._steps = 0
+        self._t_start = time.perf_counter()
+
+        self._tel_exporter = None
+        if tcfg is not None and self.registry.enabled and (
+                tcfg.prometheus_path or tcfg.http_port is not None):
+            self._tel_exporter = TelemetryExporter(
+                self.registry, prometheus_path=tcfg.prometheus_path,
+                interval_s=tcfg.interval_s, http_port=tcfg.http_port)
+            self._tel_exporter.register_provider("statusz", self.statusz)
+            self._tel_exporter.register_provider("healthz", self.healthz)
+            # one scrape = rollup + every replica's family (collision-
+            # free when replicas carry per-id namespaces, as
+            # fleet_router builds them)
+            for rep in self.replicas.values():
+                self._tel_exporter.add_source(rep.engine.registry)
+        self._closed = False
+
+    # ------------------------------------------------------- submission
+    def submit(self, req_id, tokens, max_new_tokens: int = 32,
+               temperature: float = 0.0,
+               tier: Optional[str] = None) -> Optional[RequestShed]:
+        """Route one request into the fleet.  Returns None when placed
+        on a replica, or a typed :class:`RequestShed` (also recorded in
+        ``finished``) when fleet-level admission shedding rejected it.
+        ``req_id`` must be fleet-unique — the id is the idempotency key
+        failover re-submission relies on, so reusing a live or finished
+        id raises."""
+        if self._closed:
+            raise EngineClosed(
+                f"request {req_id!r} submitted after fleet shutdown")
+        if req_id in self.requests or req_id in self.finished:
+            raise ValueError(
+                f"request {req_id!r} already known to the fleet — "
+                "req_ids are the idempotency keys of failover "
+                "re-submission and must be unique")
+        freq = _FleetReq(
+            req_id, list(map(int, tokens)), int(max_new_tokens),
+            float(temperature), tier, time.perf_counter(),
+            retries_left=self.cfg.retry_budget)
+        if self.cfg.shed_queue_depth:
+            depth = sum(len(rep.engine.queue)
+                        for rep in self.replicas.values()
+                        if rep.routable)
+            if depth >= self.cfg.shed_queue_depth:
+                self._c_submitted.inc()
+                self._n_submitted += 1
+                return self._finish_shed(freq, "fleet_queue_depth")
+        self.requests[req_id] = freq
+        try:
+            res = self._place(freq)
+        except BaseException:
+            # a validation error out of engine.submit (empty prompt,
+            # too long for the pool) is the CALLER's error, not a
+            # fleet outcome — surface it without leaving a ledger
+            # entry (or a submitted count no outcome will ever match)
+            self.requests.pop(req_id, None)
+            raise
+        # counted only once the request has a real disposition (placed
+        # or typed-shed): the accounting invariant is submitted ==
+        # completed + failed + shed + live, and a caller error above
+        # must not break it
+        self._c_submitted.inc()
+        self._n_submitted += 1
+        return res
+
+    def _route(self, freq: _FleetReq,
+               exclude: frozenset = frozenset()
+               ) -> Tuple[Optional[Replica], bool]:
+        """Pick a replica for ``freq``: warm-digest affinity first
+        (longest matched page-key prefix wins, load breaks ties), then
+        least-loaded.  HEALTHY replicas are preferred over DEGRADED
+        ones.  Returns ``(replica_or_None, was_affinity_hit)``."""
+        cands = [rep for rep in self.replicas.values()
+                 if rep.routable and rep.id not in exclude]
+        if not cands:
+            return None, False
+        healthy = [rep for rep in cands if rep.state == HEALTHY]
+        pool = healthy or cands
+        if self._affinity:
+            if freq.keys is None:
+                freq.keys = page_keys(freq.tokens, self.page_size)[
+                    :matchable_pages(len(freq.tokens), self.page_size)]
+            best, best_score = None, 0
+            for rep in pool:
+                score = 0
+                for k in freq.keys:
+                    if k not in rep.digest:
+                        break
+                    score += 1
+                if score > best_score or (
+                        score == best_score and score > 0 and
+                        best is not None and rep.load() < best.load()):
+                    best, best_score = rep, score
+            if best is not None and best_score > 0:
+                return best, True
+        return min(pool, key=lambda rep: rep.load()), False
+
+    def _place(self, freq: _FleetReq,
+               exclude: frozenset = frozenset()
+               ) -> Optional[RequestShed]:
+        """Submit ``freq`` to a routable replica, absorbing replica-
+        level sheds (retry elsewhere while budget allows) and replicas
+        that die under our feet.  Terminal outcomes land in
+        ``finished``; returns the typed shed when that was the
+        outcome, else None."""
+        while True:
+            rep, hit = self._route(freq, exclude)
+            if rep is None:
+                return self._finish_shed(freq, "no_replica")
+            try:
+                res = rep.engine.submit(
+                    freq.req_id, freq.tokens, freq.max_new_tokens,
+                    freq.temperature, tier=freq.tier,
+                    arrival=freq.t_arrival)
+            except EngineClosed as e:
+                # raced a death the health poll has not seen yet
+                self._fail_replica(rep, e)
+                exclude = exclude | {rep.id}
+                continue
+            if res is None:
+                freq.replica = rep.id
+                rep.assigned.add(freq.req_id)
+                if hit:
+                    rep.affinity_hits += 1
+                    self._c_affinity.inc()
+                else:
+                    self._c_least_loaded.inc()
+                return None
+            # replica-level shed (queue depth): the router's
+            # retry-elsewhere signal — exactly what RequestShed is for
+            rep.engine.finished.pop(freq.req_id, None)
+            self._c_replica_sheds.inc()
+            if freq.retries_left <= 0:
+                return self._finish_shed(freq, res.reason)
+            freq.retries_left -= 1
+            freq.resubmits += 1
+            self._c_resubmits.inc()
+            self._n_resubmits += 1
+            exclude = exclude | {rep.id}
+
+    # -------------------------------------------------- typed outcomes
+    def _finish(self, req_id, result: RequestResult) -> None:
+        self.finished[req_id] = result
+        self._newly_finished.append(req_id)
+        freq = self.requests.pop(req_id, None)
+        if freq is not None and freq.replica is not None:
+            rep = self.replicas.get(freq.replica)
+            if rep is not None:
+                rep.assigned.discard(req_id)
+
+    def _finish_shed(self, freq: _FleetReq, reason: str) -> RequestShed:
+        res = RequestShed(freq.req_id, reason, freq.tier)
+        self._c_shed.inc()
+        self._n_shed += 1
+        self._shed_by_reason[reason] = \
+            self._shed_by_reason.get(reason, 0) + 1
+        self._finish(freq.req_id, res)
+        return res
+
+    def _finish_failed(self, freq: _FleetReq, reason: str,
+                       error: str = "", generated: int = 0) -> None:
+        self._c_failed.inc()
+        self._n_failed += 1
+        self._finish(freq.req_id, RequestFailed(
+            freq.req_id, reason, error, freq.tier, generated=generated))
+
+    def _retry_or_fail(self, freq: _FleetReq, reason: str,
+                       error: str = "", generated: int = 0,
+                       exclude: frozenset = frozenset(),
+                       charge: bool = True) -> None:
+        """Failover disposition for one salvaged/failed request: a
+        request that already emitted tokens fails typed (never
+        double-generate); otherwise re-place on a survivor while the
+        retry budget lasts."""
+        if generated > 0:
+            self._finish_failed(freq, reason, error, generated)
+            return
+        if charge:
+            if freq.retries_left <= 0:
+                self._finish_failed(freq, "retry_exhausted", error)
+                return
+            freq.retries_left -= 1
+            freq.resubmits += 1
+            self._c_resubmits.inc()
+            self._n_resubmits += 1
+        freq.replica = None
+        self._place(freq, exclude)
+
+    # --------------------------------------------------------- failover
+    def kill(self, replica_id: str, error: str = "killed") -> None:
+        """Declare a replica dead NOW (a supervisor's hard-kill verb;
+        the ``replica`` fault rules call this path too) and fail its
+        work over to the survivors."""
+        self._fail_replica(self.replicas[replica_id],
+                           RuntimeError(error))
+
+    def _fail_replica(self, rep: Replica, exc: BaseException) -> None:
+        """Failover: salvage everything the dead replica held —
+        completed results harvest, queued and zero-token in-flight
+        requests re-submit to survivors under their retry budgets,
+        token-bearing in-flight requests fail typed — then shut the
+        engine down.  Anything salvage cannot reach still resolves
+        typed: no request is silently dropped."""
+        if rep.state == DEAD:
+            return
+        logger.warning(
+            "fleet: replica %s failed (%s) — failing over %d assigned "
+            "requests", rep.id, exc, len(rep.assigned))
+        rep.set_state(DEAD)
+        self._c_failovers.inc()
+        tracer = rep.engine.tracer
+        if tracer.enabled:
+            tracer.event("replica_dead", attrs={
+                "replica": rep.id, "error": repr(exc)[:200],
+                "assigned": len(rep.assigned)})
+        exclude = frozenset({rep.id})
+        # completed work first: results that already exist must never
+        # be re-generated or lost
+        try:
+            self._harvest(rep)
+        except Exception:
+            logger.exception("fleet: harvest during failover (%s)",
+                             rep.id)
+        # the salvage set, captured before any disposition: everything
+        # this replica still held after its finished results harvested
+        candidates = sorted(rep.assigned, key=str)
+        try:
+            queued = rep.engine.take_queued()
+        except Exception:
+            logger.exception("fleet: queue salvage failed (%s)", rep.id)
+            queued = []
+        try:
+            inflight = rep.engine.abandon_inflight()
+        except Exception:
+            logger.exception("fleet: slot salvage failed (%s)", rep.id)
+            inflight = []
+        for q in queued:
+            freq = self.requests.get(q.req_id)
+            if freq is not None:
+                rep.assigned.discard(q.req_id)
+                self._retry_or_fail(freq, "replica_failed",
+                                    repr(exc), 0, exclude)
+        for q, generated in inflight:
+            freq = self.requests.get(q.req_id)
+            if freq is not None:
+                rep.assigned.discard(q.req_id)
+                self._retry_or_fail(freq, "replica_failed",
+                                    repr(exc), generated, exclude)
+        # anything still assigned was unreachable by salvage (the
+        # engine is that broken): typed failure, never a silent drop
+        for req_id in list(rep.assigned):
+            freq = self.requests.get(req_id)
+            rep.assigned.discard(req_id)
+            if freq is not None and req_id not in self.finished:
+                self._finish_failed(freq, "replica_failed", repr(exc))
+        self.last_failover = {
+            "replica": rep.id,
+            "t": time.perf_counter(),
+            "error": repr(exc)[:200],
+            "resubmitted": [r for r in candidates
+                            if r in self.requests
+                            and r not in self.finished],
+            "failed_typed": [r for r in candidates
+                             if r in self.finished],
+        }
+        rep.digest = rep.inherited = frozenset()
+        try:
+            rep.engine.shutdown()
+        except Exception:
+            logger.exception("fleet: shutdown of dead replica %s",
+                             rep.id)
+
+    # ---------------------------------------------------- drain / rejoin
+    def drain(self, replica_id: str) -> None:
+        """Planned drain: stop new admissions, re-route the replica's
+        queued requests (no retry-budget charge — this is scheduled
+        movement, not failure), let in-flight requests finish in
+        place, and republish its warm prefix digest to its affinity
+        successor so shared-prefix traffic follows the warmth.  The
+        replica stays DRAINING (steppable, unroutable) until
+        :meth:`rejoin`."""
+        rep = self.replicas[replica_id]
+        if rep.state in (DEAD, DRAINING):
+            raise ValueError(
+                f"replica {replica_id} is {rep.state} — drain needs a "
+                "live replica")
+        rep.set_state(DRAINING)
+        self._c_drains.inc()
+        succ = self._affinity_successor(rep)
+        if succ is not None:
+            # routing hint, deliberately optimistic: the successor does
+            # not hold these pages yet, but same-prefix traffic landing
+            # there warms them once and then hits — without the
+            # handoff it would spray across the fleet and warm
+            # nothing.  Recorded as `inherited` so the periodic digest
+            # refresh keeps the hint alive until the successor's own
+            # warm pool covers it.
+            donated = rep.engine.warm_keys()
+            succ.inherited = frozenset(succ.inherited | donated)
+            succ.digest = frozenset(succ.digest | donated)
+        tracer = rep.engine.tracer
+        if tracer.enabled:
+            tracer.event("replica_drain", attrs={
+                "replica": rep.id,
+                "successor": succ.id if succ is not None else None})
+        for q in rep.engine.take_queued():
+            freq = self.requests.get(q.req_id)
+            if freq is not None:
+                rep.assigned.discard(q.req_id)
+                self._c_drain_reroutes.inc()
+                self._retry_or_fail(freq, "replica_draining",
+                                    exclude=frozenset({rep.id}),
+                                    charge=False)
+        rep.digest = frozenset()
+
+    def _affinity_successor(self, rep: Replica) -> Optional[Replica]:
+        """Next routable replica in ring order after ``rep``."""
+        ring = list(self.replicas.values())
+        i = ring.index(rep)
+        for j in range(1, len(ring)):
+            cand = ring[(i + j) % len(ring)]
+            if cand.routable:
+                return cand
+        return None
+
+    def drained(self, replica_id: str) -> bool:
+        """True once a DRAINING replica finished its in-flight work."""
+        rep = self.replicas[replica_id]
+        return rep.state == DRAINING and not rep.engine.has_work
+
+    def rejoin(self, replica_id: str, engine=None) -> None:
+        """Bring a drained (or dead, with a fresh ``engine``) replica
+        back into rotation: state resets to HEALTHY with clean
+        hysteresis streaks, and its digest refreshes from the engine's
+        actual warm pool — a drained replica that kept its pages gets
+        its affinity back immediately."""
+        rep = self.replicas[replica_id]
+        if rep.state == DEAD and engine is None:
+            raise ValueError(
+                f"replica {replica_id} is dead (engine shut down) — "
+                "rejoin needs a replacement engine")
+        if engine is not None:
+            if engine.replica_id is None:
+                engine.replica_id = replica_id
+            rep.engine = engine
+            if self._tel_exporter is not None:
+                self._tel_exporter.add_source(engine.registry)
+        rep.set_state(HEALTHY)
+        rep.degraded_streak = rep.healthy_streak = 0
+        rep.stall_until = rep.stall_started = 0.0
+        rep.forced_degrade_until = 0.0
+        rep.health_reasons = []
+        rep.inherited = frozenset()
+        rep.digest = rep.engine.warm_keys()
+        self._c_rejoins.inc()
+        tracer = rep.engine.tracer
+        if tracer.enabled:
+            tracer.event("replica_rejoin", attrs={"replica": rep.id})
+
+    # ------------------------------------------------------------ health
+    def _poll_faults(self, now: float) -> None:
+        if self._fault_plan is None:
+            return
+        for rep in list(self.replicas.values()):
+            if rep.state == DEAD:
+                continue
+            for rule in faults_mod.poll_replica(rep.id):
+                if rule.mode == "error":
+                    self._fail_replica(rep, InjectedFault(
+                        f"injected replica kill ({rep.id})"))
+                    break
+                if rule.mode == "latency":
+                    rep.stall_started = now
+                    rep.stall_until = now + rule.latency_s
+                    if rule.latency_s >= self.cfg.fatal_stall_s:
+                        # a stall past the fatal bound IS a death: the
+                        # router fails over now instead of letting the
+                        # fleet's tail latency absorb the wait
+                        self._fail_replica(rep, InjectedFault(
+                            f"fatal stall {rule.latency_s:.1f}s >= "
+                            f"{self.cfg.fatal_stall_s:.1f}s "
+                            f"({rep.id})"))
+                        break
+                elif rule.mode == "degrade":
+                    rep.forced_degrade_until = now + (
+                        rule.latency_s or _FORCED_DEGRADE_DEFAULT_S)
+
+    def _poll_health(self, now: float) -> None:
+        """Pull each live replica's health into the state machine.
+        DEAD is terminal; DRAINING keeps its state (only rejoin moves
+        it) but still runs the DEATH checks — a draining replica that
+        hangs or goes unready must fail over like any other, or its
+        in-flight requests would never resolve.  Everything else walks
+        HEALTHY ↔ DEGRADED ↔ QUARANTINED one step per threshold with
+        hysteresis."""
+        for rep in self.replicas.values():
+            if rep.state == DEAD:
+                continue
+            # a stall that outlives the fatal bound is a hang, not a
+            # blip — failover rather than waiting it out
+            if rep.stall_until > now and \
+                    now - rep.stall_started >= self.cfg.fatal_stall_s:
+                self._fail_replica(rep, RuntimeError(
+                    f"replica {rep.id} stalled past fatal_stall_s"))
+                continue
+            try:
+                h = rep.engine.healthz()
+            except Exception as e:
+                self._fail_replica(rep, e)
+                continue
+            if not h.get("ready", False):
+                # watchdog fired or engine closed: terminally unready
+                self._fail_replica(rep, RuntimeError(
+                    f"replica {rep.id} unready: "
+                    f"watchdog={h.get('watchdog')}"))
+                continue
+            if rep.state == DRAINING:
+                # alive and draining: no hysteresis transitions — the
+                # only exits are the death checks above and rejoin()
+                continue
+            reasons = list(h.get("reasons", []))
+            if now < rep.forced_degrade_until:
+                reasons.append("forced_degrade")
+            if now < rep.stall_until:
+                reasons.append("stalled")
+            rep.health_reasons = reasons
+            if reasons or h.get("degraded"):
+                rep.degraded_streak += 1
+                rep.healthy_streak = 0
+            else:
+                rep.healthy_streak += 1
+                rep.degraded_streak = 0
+            if rep.state == HEALTHY and rep.degraded_streak >= 1:
+                rep.set_state(DEGRADED)
+            elif rep.state == DEGRADED:
+                if rep.degraded_streak >= self.cfg.quarantine_after:
+                    rep.set_state(QUARANTINED)
+                elif rep.healthy_streak >= self.cfg.recover_after:
+                    rep.set_state(HEALTHY)
+            elif rep.state == QUARANTINED and \
+                    rep.healthy_streak >= self.cfg.recover_after:
+                # one step at a time: QUARANTINED recovers to DEGRADED
+                # and must stay clean another recover_after polls for
+                # HEALTHY — the hysteresis that stops flapping
+                rep.set_state(DEGRADED)
+                rep.healthy_streak = 0
+
+    # -------------------------------------------------------------- step
+    def _harvest(self, rep: Replica) -> List[Any]:
+        """Move terminal results for our assigned requests off the
+        replica: token lists complete, typed sheds/failures go through
+        the retry-or-surface disposition."""
+        done = [rid for rid in rep.assigned
+                if rid in rep.engine.finished]
+        out: List[Any] = []
+        for rid in done:
+            res = rep.engine.finished.pop(rid)
+            rep.assigned.discard(rid)
+            freq = self.requests.get(rid)
+            if freq is None:
+                continue
+            if isinstance(res, RequestFailed):
+                # per-request failure in isolation: the replica kept
+                # serving — retry only a request that never emitted
+                self._retry_or_fail(
+                    freq, res.reason, res.error, res.generated,
+                    exclude=frozenset({rep.id}))
+            elif isinstance(res, RequestShed):
+                # deadline sheds land here (queue-depth sheds return
+                # at submit): the deadline is just as expired on every
+                # other replica — surface, never bounce
+                self._c_shed.inc()
+                self._n_shed += 1
+                self._shed_by_reason[res.reason] = \
+                    self._shed_by_reason.get(res.reason, 0) + 1
+                self._finish(rid, res)
+            else:
+                self._c_completed.inc()
+                self._n_completed += 1
+                self._finish(rid, res)
+            out.append(rid)
+        return out
+
+    def refresh_digests(self) -> None:
+        """Re-pull every routable replica's published-key digest (the
+        affinity lookup's source of truth; also refreshed on the
+        ``digest_refresh_steps`` cadence inside :meth:`step`).  Keys
+        inherited from a drained predecessor survive the refresh —
+        each drops out only once the replica's own warm pool holds it
+        (the hint did its job) — so the drain handoff is not wiped by
+        the very next refresh tick."""
+        for rep in self.replicas.values():
+            if rep.state not in (DEAD, DRAINING):
+                warm = rep.engine.warm_keys()
+                rep.inherited = rep.inherited - warm
+                rep.digest = warm | rep.inherited
+
+    def step(self) -> List[Any]:
+        """One fleet iteration: fault poll → health poll → step every
+        steppable replica (failures here ARE replica deaths) → harvest
+        terminal results.  Returns req_ids that reached a terminal
+        result this step."""
+        self._newly_finished = []
+        self._steps += 1
+        now = time.perf_counter()
+        self._poll_faults(now)
+        self._poll_health(now)
+        for rep in list(self.replicas.values()):
+            if rep.state == DEAD or rep.stall_until > now:
+                continue
+            if not rep.engine.has_work:
+                continue
+            try:
+                rep.engine.step()
+            except Exception as e:
+                # an exception out of step() is engine-fatal by the
+                # PR 9 contract (per-request failures were absorbed
+                # inside) — the fleet's answer is failover
+                self._fail_replica(rep, e)
+                continue
+            self._harvest(rep)
+        if self._steps % self.cfg.digest_refresh_steps == 0:
+            self.refresh_digests()
+        self._update_gauges()
+        if self._tel_exporter is not None:
+            self._tel_exporter.maybe_export()
+        return list(self._newly_finished)
+
+    def _update_gauges(self) -> None:
+        if not self.registry.enabled:
+            return
+        routable = [rep for rep in self.replicas.values()
+                    if rep.routable]
+        self._g_routable.set(len(routable))
+        self._g_queue.set(sum(len(rep.engine.queue)
+                              for rep in routable))
+        self._g_active.set(sum(
+            1 for rep in self.replicas.values()
+            if rep.state != DEAD
+            for s in rep.engine.slots if s is not None))
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.requests) or any(
+            rep.engine.has_work for rep in self.replicas.values()
+            if rep.state != DEAD)
+
+    def run(self, max_steps: int = 10_000) -> Dict[Any, RequestResult]:
+        """Drive until every submitted request reached a terminal
+        result (tokens, typed shed, or typed failure)."""
+        steps = 0
+        while self.has_work:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError("fleet loop did not converge")
+        return dict(self.finished)
+
+    def drain_finished(self) -> Dict[Any, RequestResult]:
+        out, self.finished = self.finished, {}
+        return out
+
+    # ------------------------------------------------------- accounting
+    def orphaned(self) -> List[Any]:
+        """Requests that can never resolve: a ledger entry with no
+        terminal result whose replica is gone (or never tracked it).
+        Zero ALWAYS — failover and drain both guarantee every salvaged
+        request either re-places or fails typed; the soak gates this
+        at 0."""
+        out = []
+        for rid, freq in self.requests.items():
+            if rid in self.finished:
+                continue
+            rep = (self.replicas.get(freq.replica)
+                   if freq.replica is not None else None)
+            if rep is None or rep.state == DEAD or \
+                    rid not in rep.assigned:
+                out.append(rid)
+        return out
+
+    def check_leaks(self) -> List[str]:
+        """Union of every replica's page-accounting violations,
+        replica-tagged; DEAD replicas are included — failover salvage
+        must leave them leak-free too."""
+        probs: List[str] = []
+        for rep in self.replicas.values():
+            for p in rep.engine.check_leaks():
+                probs.append(f"{rep.id}: {p}")
+        return probs
+
+    # ---------------------------------------------------- introspection
+    def statusz(self) -> Dict[str, Any]:
+        """Fleet snapshot: per-replica state/queue/shed/affinity rows,
+        fleet totals, and the cross-replica SLO rollup.  Host-side
+        bookkeeping only — safe to poll (``dstpu_top`` renders it)."""
+        now = time.perf_counter()
+        reps = []
+        states: Dict[str, int] = {}
+        for rep in self.replicas.values():
+            states[rep.state] = states.get(rep.state, 0) + 1
+            e = rep.engine
+            n_aff = rep.affinity_hits
+            row = {
+                "replica": rep.id,
+                "state": rep.state,
+                "state_age_s": round(now - rep.state_since, 3),
+                "queue_depth": len(e.queue),
+                "active_slots": sum(1 for s in e.slots
+                                    if s is not None),
+                "assigned": len(rep.assigned),
+                "shed": e._n_shed,
+                "failed": e._n_failed,
+                "shed_rate": round(
+                    e._n_shed / e._n_submitted, 4)
+                if e._n_submitted else 0.0,
+                "affinity_hits": n_aff,
+                "digest_pages": len(rep.digest),
+                "reasons": rep.health_reasons,
+            }
+            if rep.stall_until > now:
+                row["stalled_for_s"] = round(rep.stall_until - now, 3)
+            reps.append(row)
+        routed = self._c_affinity.value + self._c_least_loaded.value
+        fleet: Dict[str, Any] = {
+            "replicas": reps,
+            "states": states,
+            "submitted": self._n_submitted,
+            "completed": self._n_completed,
+            "failed": self._n_failed,
+            "shed": self._n_shed,
+            "shed_by_reason": dict(self._shed_by_reason),
+            "resubmits": self._n_resubmits,
+            "failovers": int(self._c_failovers.value),
+            "drains": int(self._c_drains.value),
+            "rejoins": int(self._c_rejoins.value),
+            "affinity": {
+                "enabled": self._affinity,
+                "affinity_routed": int(self._c_affinity.value),
+                "least_loaded_routed": int(
+                    self._c_least_loaded.value),
+                "hit_rate": round(self._c_affinity.value / routed, 4)
+                if routed else 0.0,
+            },
+            "queue_depth": sum(len(rep.engine.queue)
+                               for rep in self.replicas.values()
+                               if rep.state != DEAD),
+            "in_flight": len(self.requests),
+            "orphaned": len(self.orphaned()),
+        }
+        status = {
+            "schema_version": 1,
+            "engine": "FleetRouter",
+            "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "uptime_s": round(now - self._t_start, 3),
+            "steps": self._steps,
+            "fleet": fleet,
+            # DEAD replicas included: their trackers are host-side and
+            # outlive shutdown, and dropping them would make the fleet
+            # "lifetime" counters shrink at every failover
+            "slo": fleet_rollup([
+                rep.engine.slo_tracker.snapshot(now=now)
+                for rep in self.replicas.values()]),
+            "metrics": self.registry.snapshot(),
+        }
+        if self._fault_plan is not None:
+            status["faults"] = self._fault_plan.snapshot()
+        return status
+
+    def healthz(self) -> Dict[str, Any]:
+        """Fleet readiness: ready while ANY replica is routable;
+        degraded while ready but not every replica is HEALTHY."""
+        states = {rep.id: rep.state
+                  for rep in self.replicas.values()}
+        ready = any(rep.routable for rep in self.replicas.values())
+        degraded = ready and any(
+            rep.state != HEALTHY for rep in self.replicas.values())
+        reasons = [f"{rep.id}:{rep.state}"
+                   for rep in self.replicas.values()
+                   if rep.state != HEALTHY]
+        return {"alive": True, "ready": ready, "degraded": degraded,
+                "reasons": reasons, "replicas": states,
+                "in_flight": len(self.requests)}
+
+    # --------------------------------------------------------- lifecycle
+    def shutdown(self) -> None:
+        """Idempotent teardown: every replica engine, the rollup
+        exporter, and the fault plan (if this router installed it)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._owns_fault_plan:
+            faults_mod.clear_fault_plan(self._fault_plan)
+        for rep in self.replicas.values():
+            try:
+                rep.engine.shutdown()
+            except Exception:
+                logger.exception("fleet: replica %s shutdown", rep.id)
+        ex = self._tel_exporter
+        if ex is not None:
+            try:
+                ex.maybe_export(force=True)
+            except Exception:
+                pass
+            ex.close()
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
+def fleet_router(params, cfg, *, fleet=None, telemetry=None,
+                 tracing=None, faults=None, engine_builder=None,
+                 **engine_kw) -> FleetRouter:
+    """Build a fleet of homogeneous replicas over one model + config.
+
+    Each replica is built through :func:`~deepspeed_tpu.inference.
+    serving.serving_engine` (or ``engine_builder(params, cfg,
+    replica_id=..., tracing=..., faults=..., **engine_kw)`` when
+    given) with ``replica_id="r{i}"``; all replicas share ONE flight
+    recorder — their events carry the replica tag — and one fault
+    plan, installed by the router for its lifetime.  ``telemetry``
+    configures the ROUTER's rollup registry/exporter (give replicas
+    their own telemetry via ``engine_kw``; avoid fixed http ports
+    there — N replicas cannot share one)."""
+    fc = FleetConfig.coerce(fleet)
+    tracer = RequestTracer.from_config(TracingConfig.coerce(tracing))
+    if isinstance(faults, FaultPlan):
+        plan: Optional[FaultPlan] = faults
+    else:
+        fcfg = FaultsConfig.coerce(faults)
+        plan = FaultPlan.from_config(fcfg) if fcfg.enabled else None
+    build = engine_builder
+    if build is None:
+        from deepspeed_tpu.inference.serving import serving_engine
+        build = serving_engine
+    # install the plan BEFORE any engine sees it: ownership must land
+    # on the ROUTER, not on replica 0 — otherwise killing replica 0
+    # (its shutdown clears owned plans) would silently disarm the
+    # chaos schedule for the survivors
+    installed_here = faults_mod.ensure_installed(plan)
+    engines = []
+    try:
+        for i in range(fc.replicas):
+            kw_i = dict(engine_kw)
+            # per-replica metric namespace (dstpu_r0, dstpu_r1, …):
+            # the fleet exporter serves every replica's family on one
+            # /metrics scrape without name collisions
+            kw_i.setdefault("telemetry", MetricsRegistry(
+                namespace=f"dstpu_r{i}"))
+            engines.append(build(
+                params, cfg, replica_id=f"r{i}", tracing=tracer,
+                faults=plan, **kw_i))
+        router = FleetRouter(engines, fleet=fc, telemetry=telemetry,
+                             faults=plan)
+    except Exception:
+        for e in engines:
+            try:
+                e.shutdown()
+            except Exception:
+                pass
+        if installed_here:
+            faults_mod.clear_fault_plan(plan)
+        raise
+    if installed_here:
+        router._owns_fault_plan = True
+    return router

@@ -89,7 +89,8 @@ from deepspeed_tpu.inference.prefix_cache import (extend_page_keys,
                                                   page_keys)
 from deepspeed_tpu.inference.speculative import (build_drafter,
                                                  verify_accept)
-from deepspeed_tpu.request_trace import RequestTracer, event_to_dict
+from deepspeed_tpu.request_trace import (BoundTracer, RequestTracer,
+                                          event_to_dict)
 from deepspeed_tpu.slo import NULL_SLO_TRACKER, SLOTracker
 from deepspeed_tpu.telemetry import (LATENCY_BUCKETS_S, MetricsRegistry,
                                      Span, TelemetryExporter)
@@ -115,6 +116,14 @@ def _req_key(req_id: Any) -> str:
     return str(req_id)
 
 
+class EngineClosed(RuntimeError):
+    """``submit`` after ``shutdown()``: the engine is torn down and can
+    never serve this request.  Typed (rather than whatever downstream
+    error the dead telemetry/scheduler state would eventually raise) so
+    a fleet router's DEAD-replica path is deterministic — catch, mark
+    the replica dead, re-route."""
+
+
 @dataclasses.dataclass
 class RequestShed:
     """Typed admission rejection: the engine declined to serve this
@@ -136,9 +145,15 @@ class RequestFailed:
     (before this existed, the exception took down the whole engine)."""
 
     req_id: Any
-    reason: str                        # "slot_exception" | "admit_exception"
+    reason: str          # "slot_exception" | "admit_exception" |
+    #                      "replica_failed" (router: the whole replica
+    #                      died mid-generation)
     error: str = ""
     tier: Optional[str] = None
+    # tokens this request had generated when it failed: a router may
+    # safely re-submit only when this is 0 — a request that already
+    # emitted tokens must fail typed, never double-generate
+    generated: int = 0
 
 
 # a finished entry: the served tokens, or a typed shed/failure result
@@ -239,7 +254,8 @@ class ServingEngine:
                  tracing=None, speculative=None, drafter=None,
                  slo=None, kv_tier=None, faults=None,
                  shed_queue_depth: int = 0,
-                 shed_expired_deadline: bool = False):
+                 shed_expired_deadline: bool = False,
+                 replica_id: Optional[str] = None):
         # Sharded serving (ref: deepspeed/module_inject/replace_module.py
         # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
         # inference): with a mesh, params arrive pre-sharded from the
@@ -497,11 +513,17 @@ class ServingEngine:
         # existing RequestTracer to share one recorder across engines.
         # _trace_on guards every emit site; the disabled tracer is the
         # shared no-op singleton (no clock, no lock, no ring).
-        if isinstance(tracing, RequestTracer):
+        if isinstance(tracing, (RequestTracer, BoundTracer)):
             self.tracer = tracing
         else:
             self.tracer = RequestTracer.from_config(
                 TracingConfig.coerce(tracing))
+        # fleet replica identity: every trace event this engine emits
+        # carries the replica id (the fleet's flight recorder is shared
+        # across replicas — untagged events would be unattributable)
+        self.replica_id = None if replica_id is None else str(replica_id)
+        if self.replica_id is not None:
+            self.tracer = self.tracer.bind(replica=self.replica_id)
         self._trace_on = self.tracer.enabled
 
         # ---- tiered KV cache (ZeRO-Infinity tiering for the prefix
@@ -737,17 +759,28 @@ class ServingEngine:
     # ------------------------------------------------------------- requests
     def submit(self, req_id, tokens, max_new_tokens: int = 32,
                temperature: float = 0.0,
-               tier: Optional[str] = None) -> Optional[RequestShed]:
+               tier: Optional[str] = None,
+               arrival: Optional[float] = None) -> Optional[RequestShed]:
         """Queue a request.  ``tier`` names an SLO tier from the
         ``slo`` config block (None → the block's default tier); naming
         a tier with the block disabled raises rather than silently
-        dropping the latency objective.
+        dropping the latency objective.  ``arrival`` carries an
+        earlier ``perf_counter`` arrival time through a router's
+        failover re-submit, so SLO deadlines and TTFT judge the user's
+        real clock, not the re-route.
 
         Returns None when queued.  With ``shed_queue_depth`` set and
         the queue at capacity, the request is NOT queued: a typed
         :class:`RequestShed` is recorded in ``finished`` and returned
         (load shedding is a first-class outcome a router retries
-        elsewhere, never an exception)."""
+        elsewhere, never an exception).  Raises :class:`EngineClosed`
+        after :meth:`shutdown` — a dead engine must reject
+        deterministically, not fail downstream."""
+        if self._closed:
+            raise EngineClosed(
+                f"request {req_id!r} submitted after shutdown"
+                + (f" (replica {self.replica_id})"
+                   if self.replica_id else ""))
         tokens = list(map(int, tokens))
         if not tokens:
             raise ValueError(f"request {req_id}: empty prompt")
@@ -767,7 +800,7 @@ class ServingEngine:
                 len(self.queue) >= self.shed_queue_depth:
             return self._shed(req_id, tier, "queue_depth")
         traced = self._trace_on and self.tracer.sampled(req_id)
-        now = time.perf_counter()
+        now = time.perf_counter() if arrival is None else float(arrival)
         if self._slo_on or tier is not None:
             # BEFORE the queue append: an unknown tier must reject the
             # request, not classify it later under a KeyError
@@ -843,7 +876,8 @@ class ServingEngine:
         self._n_failed += 1
         self.slo_tracker.on_fail(req.req_id)
         self.finished[req.req_id] = RequestFailed(
-            req.req_id, reason, repr(exc), req.tier)
+            req.req_id, reason, repr(exc), req.tier,
+            generated=generated)
         self._newly_finished.append(req.req_id)
         if self._trace_on:
             # always emitted (not sampling-gated): a failure is exactly
@@ -930,6 +964,69 @@ class ServingEngine:
                     f"idle engine holds tier pins: "
                     f"{list(self._kv_pool._pinned)}")
         return probs
+
+    # ------------------------------------------- fleet handoff hooks
+    # (consumed by deepspeed_tpu.fleet.FleetRouter: drain re-routes a
+    # replica's queued work, failover salvages a dead replica's whole
+    # request set; both are pure host bookkeeping — no device work, so
+    # they stay callable on an engine whose compute path is wedged)
+    def take_queued(self) -> List[Request]:
+        """Pop and return every queued (not-yet-admitted) request —
+        the drain/failover queue handoff.  Each request's SLO record
+        is forgotten here (the destination replica re-announces it;
+        carry ``t_arrival`` through ``submit(arrival=)`` so the user's
+        clock survives the hop)."""
+        taken, self.queue = list(self.queue), collections.deque()
+        for r in taken:
+            self.slo_tracker.forget(r.req_id)
+        self._g_queue.set(0)
+        if taken and self._trace_on:
+            self.tracer.event("queue_handoff",
+                              attrs={"requests": len(taken)})
+        return taken
+
+    def abandon_inflight(self) -> List[Tuple[Request, int]]:
+        """Release every active slot WITHOUT finishing its request:
+        promotions fenced and cancelled, pages/COW refs freed, pending
+        boundary samples dropped, SLO records forgotten.  Returns
+        ``[(request, tokens_generated)]`` so a router can decide per
+        request: zero tokens → safe to re-submit elsewhere; any tokens
+        → must fail typed (re-running would double-generate).  The
+        failover half of the fleet handoff; leaves ``check_leaks``
+        clean on this engine."""
+        out: List[Tuple[Request, int]] = []
+        for b, s in enumerate(self.slots):
+            if s is None:
+                continue
+            if s.promo is not None:
+                try:
+                    self._cancel_promotion(s)
+                except Exception:
+                    logger.exception(
+                        "serving: promotion cancel during abandon")
+            self.allocator.release(s.seq_id)
+            self._table_host[b, :] = self.trash_page
+            self.slots[b] = None
+            self.slo_tracker.forget(s.req.req_id)
+            if self._trace_on:
+                self.tracer.event("abandoned", s.req.req_id, b, attrs={
+                    "generated": len(s.generated)})
+            out.append((s.req, len(s.generated)))
+        if out:
+            self._table_dirty = self._lens_dirty = True
+            self._pending_boundary = []
+        return out
+
+    def warm_keys(self) -> frozenset:
+        """The replica's published-key digest: every content key
+        matchable at admission — the HBM prefix-cache index plus (when
+        the tier is live) the spilled host/NVMe entries.  The fleet
+        router diffs these digests to answer "which replica has this
+        prompt warm" without touching any page payloads."""
+        keys = set(self.allocator.index)
+        if self._kv_pool is not None and self._kv_pool.disabled is None:
+            keys |= set(self._kv_pool.entries)
+        return frozenset(keys)
 
     # ----------------------------------------------------------- scheduling
     def _upload_dirty(self) -> None:
@@ -2122,6 +2219,7 @@ class ServingEngine:
         status: Dict[str, Any] = {
             "schema_version": 1,
             "engine": type(self).__name__,
+            "replica": self.replica_id,
             "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "uptime_s": round(now - self._t_start, 3),
             "last_step_age_s": (
@@ -2255,6 +2353,7 @@ class ServingEngine:
         h: Dict[str, Any] = {
             "alive": True,
             "ready": not self._closed,
+            "replica": self.replica_id,
             "uptime_s": round(now - self._t_start, 3),
             "last_step_age_s": (
                 round(now - self._last_step_t, 3)

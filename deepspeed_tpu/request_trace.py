@@ -195,6 +195,15 @@ class RequestTracer:
         self.recorder.append(
             (time.monotonic_ns(), req, slot, phase, attrs))
 
+    def bind(self, **attrs) -> "RequestTracer":
+        """A view of this tracer stamping ``attrs`` onto every event —
+        how a fleet replica's engine tags its whole trace stream with
+        its replica id without threading the id through every emit
+        site.  Disabled tracers (and empty binds) return ``self``."""
+        if not self.enabled or not attrs:
+            return self
+        return BoundTracer(self, attrs)
+
     # ---------------------------------------------------------- fan-in
     def fold_comms(self, comms_logger=None) -> None:
         """Delta-fold a :class:`~deepspeed_tpu.utils.trace.CommsLogger`
@@ -233,6 +242,31 @@ class RequestTracer:
         write_jsonl(self.recorder.events(), path, reason=reason,
                     dropped=self.recorder.dropped)
         return path
+
+
+class BoundTracer:
+    """Attr-stamping view over a :class:`RequestTracer` (see
+    :meth:`RequestTracer.bind`).  Everything but ``event`` and
+    ``bind`` delegates to the base tracer, so the ring, sampling
+    decisions and exports stay shared — only the emitted attrs
+    change."""
+
+    def __init__(self, base, attrs: Dict[str, Any]):
+        self._base = base
+        self._attrs = dict(attrs)
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+    def bind(self, **attrs) -> "BoundTracer":
+        return BoundTracer(self._base, {**self._attrs, **attrs})
+
+    def event(self, phase: str, req: Any = None, slot: int = -1,
+              attrs: Optional[Dict[str, Any]] = None) -> None:
+        merged = dict(self._attrs)
+        if attrs:
+            merged.update(attrs)
+        self._base.event(phase, req, slot, merged)
 
 
 # shared no-op: `event` returns at the `enabled` check, `sampled` is

@@ -443,6 +443,62 @@ class SLOTracker:
                 "tiers": tiers}
 
 
+def fleet_rollup(snapshots) -> Dict[str, Any]:
+    """Aggregate per-replica :meth:`SLOTracker.snapshot` dicts into one
+    fleet view (the multi-replica router's ``/statusz`` ``slo``
+    section).  Per tier across replicas: lifetime counters sum, the
+    rolling window re-derives attainment from summed
+    finished/attained, goodput sums (each replica's window tokens/s
+    add), burn rates take the MAX (the alert question is "is ANY
+    replica burning its budget", not the average that would let one
+    sick replica hide behind two healthy ones), and ``alert_active``
+    ORs.  Disabled snapshots pass through; zero-traffic tiers keep the
+    1.0-attainment contract."""
+    snaps = [s for s in snapshots if s and s.get("enabled")]
+    if not snaps:
+        return {"enabled": False}
+    tiers: Dict[str, Dict[str, Any]] = {}
+    for s in snaps:
+        for name, t in s.get("tiers", {}).items():
+            agg = tiers.get(name)
+            if agg is None:
+                agg = {
+                    "objective": dict(t.get("objective", {})),
+                    "target": t.get("target"),
+                    "window_s": t.get("window_s"),
+                    "window_finished": 0,
+                    "window_attained": 0,
+                    "goodput_tokens_per_s": 0.0,
+                    "burn_rates": {},
+                    "burn_threshold": t.get("burn_threshold"),
+                    "alert_active": False,
+                    "lifetime": {},
+                    "in_flight": 0,
+                    "replicas": 0,
+                }
+                tiers[name] = agg
+            agg["replicas"] += 1
+            agg["window_finished"] += int(t.get("window_finished", 0))
+            agg["window_attained"] += int(t.get("window_attained", 0))
+            agg["goodput_tokens_per_s"] = round(
+                agg["goodput_tokens_per_s"]
+                + float(t.get("goodput_tokens_per_s", 0.0)), 3)
+            for w, b in t.get("burn_rates", {}).items():
+                agg["burn_rates"][w] = max(
+                    agg["burn_rates"].get(w, 0.0), float(b))
+            agg["alert_active"] = (agg["alert_active"]
+                                   or bool(t.get("alert_active")))
+            for k, v in t.get("lifetime", {}).items():
+                agg["lifetime"][k] = agg["lifetime"].get(k, 0) + int(v)
+            agg["in_flight"] += int(t.get("in_flight", 0))
+    for agg in tiers.values():
+        n = agg["window_finished"]
+        agg["attainment"] = agg["window_attained"] / n if n else 1.0
+    return {"enabled": True,
+            "default_tier": snaps[0].get("default_tier"),
+            "replicas": len(snaps), "tiers": tiers}
+
+
 class _NullSLOTracker:
     """Shared no-op stand-in when the ``slo`` block is off: every hook
     is one early return, mirroring telemetry's null metrics."""

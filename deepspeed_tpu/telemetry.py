@@ -480,6 +480,11 @@ class TelemetryExporter:
         # callable taking the request id ("requestz").  Read via a dict
         # lookup per GET — registration order and timing are free.
         self._providers: Dict[str, Any] = {}
+        # additional registries appended to the /metrics exposition —
+        # the fleet router registers each replica engine's registry
+        # here (distinct namespaces keep the families collision-free),
+        # so ONE scrape carries the rollup plus every per-replica view
+        self._sources: List[MetricsRegistry] = []
         if http_port is not None and registry.enabled:
             self._start_http(int(http_port))
         # postmortem flushing: the watchdog's timeout path (and any
@@ -506,6 +511,15 @@ class TelemetryExporter:
         return True
 
     # ---------------------------------------------------- introspection
+    def add_source(self, registry: MetricsRegistry) -> None:
+        """Append another registry to the ``/metrics`` exposition
+        (idempotent per registry).  Collision discipline is the
+        caller's: give each source its own ``namespace`` — the fleet
+        router uses ``dstpu_r0``, ``dstpu_r1``, … per replica."""
+        if registry is not self.registry and \
+                all(registry is not s for s in self._sources):
+            self._sources.append(registry)
+
     def register_provider(self, name: str, fn) -> None:
         """Attach an introspection provider: ``statusz``/``healthz``
         take no args and return a JSON dict (healthz may include
@@ -524,6 +538,7 @@ class TelemetryExporter:
 
         registry = self.registry
         providers = self._providers
+        sources = self._sources      # live list: add_source visible
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def _send(self, code: int, body: bytes,
@@ -548,8 +563,11 @@ class TelemetryExporter:
                 route = u.path.rstrip("/") or "/metrics"
                 try:
                     if route == "/metrics":
-                        self._send(200, registry.prometheus_text()
-                                   .encode(),
+                        text = "".join(
+                            [registry.prometheus_text()]
+                            + [s.prometheus_text() for s in sources
+                               if s.enabled])
+                        self._send(200, text.encode(),
                                    "text/plain; version=0.0.4")
                     elif route == "/statusz" and "statusz" in providers:
                         self._send_json(providers["statusz"]())

@@ -1,0 +1,738 @@
+"""Replicated serving fleet (ISSUE 10): prefix-affine routing, the
+health state machine with hysteresis, failover with bounded retry
+budgets and idempotent req_ids, fleet-level shedding, graceful drain +
+rejoin, the `replica` fault rules, and the accounting/leak invariants
+every scenario must leave behind.
+
+Correctness oracle throughout: a single fault-free engine — whatever
+the fleet does (route, fail over, re-submit, drain), a COMPLETED
+request's tokens must be identical to the single-replica run (greedy
+decode is a pure function of the prompt)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu import faults
+from deepspeed_tpu.config import FleetConfig
+from deepspeed_tpu.faults import FaultPlan, FaultRule
+from deepspeed_tpu.fleet import (DEAD, DEGRADED, DRAINING, HEALTHY,
+                                 QUARANTINED, FleetRouter, fleet_router)
+from deepspeed_tpu.inference.serving import (EngineClosed, RequestFailed,
+                                             RequestShed, serving_engine)
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.slo import fleet_rollup
+
+KW = dict(max_batch=2, page_size=8, num_pages=12, max_seq=64,
+          prefill_bucket=8)
+
+
+@pytest.fixture(scope="module")
+def gpt2_model():
+    cfg = gpt2.GPT2Config.tiny(dim=64, n_layers=2, n_heads=4,
+                               max_seq_len=128)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear_fault_plan()
+    yield
+    faults.clear_fault_plan()
+
+
+def prompts(vocab, n=6, seed=0, length=12):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab, length).tolist() for _ in range(n)]
+
+
+def shared_prefix_prompts(vocab, n=4, seed=1):
+    rng = np.random.default_rng(seed)
+    pref = rng.integers(1, vocab, 16).tolist()
+    return [pref + rng.integers(1, vocab, 3).tolist()
+            for _ in range(n)]
+
+
+def oracle_outputs(params, cfg, ps, max_new=4):
+    eng = serving_engine(params, cfg, prefix_cache=True, **KW)
+    for i, p in enumerate(ps):
+        eng.submit(f"o{i}", p, max_new_tokens=max_new)
+    out = eng.run()
+    eng.shutdown()
+    return [out[f"o{i}"] for i in range(len(ps))]
+
+
+def make_fleet(params, cfg, n=2, **over):
+    kw = dict(KW)
+    kw.update(over.pop("engine_kw", {}))
+    return fleet_router(params, cfg, fleet={"replicas": n, **over},
+                        prefix_cache=True, **kw)
+
+
+def assert_clean(router):
+    assert router.check_leaks() == []
+    assert router.orphaned() == []
+
+
+# ------------------------------------------------------------- config
+def test_fleet_config_validation():
+    c = FleetConfig.coerce({"replicas": 3, "retry_budget": 1})
+    assert c.replicas == 3 and c.retry_budget == 1
+    assert FleetConfig.coerce(4).replicas == 4
+    assert FleetConfig.coerce(None).replicas == 2
+    with pytest.raises(ValueError):
+        FleetConfig.coerce({"replicas": 0})
+    with pytest.raises(ValueError):
+        FleetConfig.coerce({"retry_budget": -1})
+    with pytest.raises(ValueError):
+        FleetConfig.coerce({"quarantine_after": 0})
+    with pytest.raises(ValueError):
+        FleetConfig.coerce({"fatal_stall_s": 0})
+    with pytest.raises(TypeError):
+        FleetConfig.coerce("3")
+
+
+def test_replica_fault_rule_validation():
+    FaultRule(subsystem="replica", mode="error", match="r1")
+    FaultRule(subsystem="replica", mode="degrade", latency_s=1.0)
+    with pytest.raises(ValueError):
+        FaultRule(subsystem="slot", mode="degrade")
+    with pytest.raises(ValueError):
+        FaultRule(subsystem="aio_read", match="x")  # keyless subsystem
+
+
+def test_engine_closed_typed(gpt2_model):
+    cfg, params = gpt2_model
+    eng = serving_engine(params, cfg, **KW)
+    eng.submit("a", [1, 2, 3], max_new_tokens=2)
+    eng.run()
+    eng.shutdown()
+    with pytest.raises(EngineClosed):
+        eng.submit("b", [1, 2, 3], max_new_tokens=2)
+    # idempotent shutdown keeps raising the same typed error
+    eng.shutdown()
+    with pytest.raises(EngineClosed):
+        eng.submit("c", [1, 2, 3], max_new_tokens=2)
+
+
+# ------------------------------------------------------------ routing
+def test_fleet_serves_token_identical(gpt2_model):
+    cfg, params = gpt2_model
+    ps = prompts(cfg.vocab_size)
+    want = oracle_outputs(params, cfg, ps)
+    router = make_fleet(params, cfg, n=2)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=4)
+    out = router.run()
+    assert [out[f"q{i}"] for i in range(len(ps))] == want
+    # work actually spread across replicas
+    counts = router.statusz()["fleet"]["affinity"]
+    assert counts["affinity_routed"] + \
+        counts["least_loaded_routed"] == len(ps)
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_affinity_routes_to_warm_replica(gpt2_model):
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=3, digest_refresh_steps=1)
+    ps = shared_prefix_prompts(cfg.vocab_size)
+    router.submit("w0", ps[0], max_new_tokens=4)
+    router.run()
+    router.refresh_digests()
+    warm = [r.id for r in router.replicas.values() if r.digest]
+    assert len(warm) == 1
+    # every same-prefix follower routes to the warm replica
+    for i, p in enumerate(ps[1:], 1):
+        router.submit(f"w{i}", p, max_new_tokens=4)
+        rep = router.replicas[warm[0]]
+        assert f"w{i}" in rep.assigned
+        router.run()
+    assert router.replicas[warm[0]].affinity_hits == len(ps) - 1
+    assert router.statusz()["fleet"]["affinity"]["hit_rate"] > 0
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_unique_req_ids_enforced(gpt2_model):
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2)
+    router.submit("dup", [1, 2, 3], max_new_tokens=2)
+    with pytest.raises(ValueError):
+        router.submit("dup", [1, 2, 3], max_new_tokens=2)
+    router.run()
+    with pytest.raises(ValueError):       # finished ids stay reserved
+        router.submit("dup", [1, 2, 3], max_new_tokens=2)
+    # a caller error (prompt too long for the pool) surfaces without
+    # leaving a ledger entry that could never resolve
+    with pytest.raises(ValueError):
+        router.submit("toolong", list(range(1, 60)),
+                      max_new_tokens=32)
+    assert "toolong" not in router.requests
+    assert_clean(router)
+    router.shutdown()
+
+
+# ----------------------------------------------------------- failover
+def test_failover_resubmits_queued_token_identical(gpt2_model):
+    cfg, params = gpt2_model
+    ps = prompts(cfg.vocab_size, n=4, seed=2)
+    want = oracle_outputs(params, cfg, ps)
+    router = make_fleet(params, cfg, n=2)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=4)
+    victim = next(r.id for r in router.replicas.values() if r.assigned)
+    router.kill(victim)                   # before any step: all queued
+    out = router.run()
+    assert router.replicas[victim].state == DEAD
+    assert [out[f"q{i}"] for i in range(len(ps))] == want
+    assert router._n_resubmits > 0
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_midgeneration_failure_is_typed_not_duplicated(gpt2_model):
+    cfg, params = gpt2_model
+    ps = prompts(cfg.vocab_size, n=4, seed=3)
+    router = make_fleet(params, cfg, n=2)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=6)
+    router.step()                          # slots now hold generated
+    victim = next(r for r in router.replicas.values() if r.assigned)
+    in_slot = [s.req.req_id for s in victim.engine.slots
+               if s is not None and s.generated]
+    assert in_slot
+    router.kill(victim.id)
+    out = router.run()
+    for rid_ in in_slot:
+        res = out[rid_]
+        assert isinstance(res, RequestFailed)
+        assert res.reason == "replica_failed"
+        assert res.generated > 0           # typed, never re-generated
+    # nothing was silently dropped: every submit has a terminal result
+    assert set(out) == {f"q{i}" for i in range(len(ps))}
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_retry_budget_exhaustion_fails_typed(gpt2_model):
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2, retry_budget=0)
+    ps = prompts(cfg.vocab_size, n=2, seed=4)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=4)
+    victim = next(r.id for r in router.replicas.values() if r.assigned)
+    router.kill(victim)
+    out = router.run()
+    kinds = {type(v).__name__ for v in out.values()}
+    assert "RequestFailed" in kinds
+    failed = [v for v in out.values() if isinstance(v, RequestFailed)]
+    assert all(v.reason == "retry_exhausted" for v in failed)
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_step_exception_is_replica_fatal(gpt2_model):
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2)
+    ps = prompts(cfg.vocab_size, n=2, seed=5)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=4)
+    victim = next(r for r in router.replicas.values() if r.assigned)
+
+    def boom():
+        raise RuntimeError("wedged scheduler")
+
+    victim.engine.step = boom
+    out = router.run()
+    assert victim.state == DEAD
+    assert set(out) == {f"q{i}" for i in range(len(ps))}
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_all_replicas_dead_sheds_typed(gpt2_model):
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2, retry_budget=4)
+    router.submit("a", [1, 2, 3, 4], max_new_tokens=2)
+    for rid_ in list(router.replicas):
+        router.kill(rid_)
+    out = router.run()
+    res = out["a"]
+    # salvaged but nowhere to go: typed shed (reason no_replica) or
+    # typed failure — never a hang, never a silent drop
+    assert isinstance(res, (RequestShed, RequestFailed))
+    with_none = router.submit("b", [1, 2], max_new_tokens=2)
+    assert isinstance(with_none, RequestShed)
+    assert with_none.reason == "no_replica"
+    assert_clean(router)
+    router.shutdown()
+
+
+# ------------------------------------------------------ drain / rejoin
+def test_drain_finishes_inflight_blocks_admissions(gpt2_model):
+    cfg, params = gpt2_model
+    ps = prompts(cfg.vocab_size, n=4, seed=6)
+    want = oracle_outputs(params, cfg, ps)
+    router = make_fleet(params, cfg, n=2)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=4)
+    router.step()
+    victim = next(r for r in router.replicas.values()
+                  if any(s is not None for s in r.engine.slots))
+    inflight = [s.req.req_id for s in victim.engine.slots
+                if s is not None]
+    router.drain(victim.id)
+    assert victim.state == DRAINING
+    # queued work left the drained replica...
+    assert len(victim.engine.queue) == 0
+    # ...new admissions never land there...
+    router.submit("post", ps[0][::-1], max_new_tokens=2)
+    assert "post" not in victim.assigned
+    out = router.run()
+    # ...and its in-flight requests finished IN PLACE, correctly
+    for rid_ in inflight:
+        assert isinstance(out[rid_], list)
+    assert [out[f"q{i}"] for i in range(len(ps))] == want
+    assert router.drained(victim.id)
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_drain_republishes_digest_to_successor(gpt2_model):
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=3, digest_refresh_steps=1)
+    ps = shared_prefix_prompts(cfg.vocab_size, n=3, seed=7)
+    router.submit("w0", ps[0], max_new_tokens=4)
+    router.run()
+    router.refresh_digests()
+    warm = next(r for r in router.replicas.values() if r.digest)
+    keys_before = set(warm.digest)
+    router.drain(warm.id)
+    succ = router._affinity_successor(warm)
+    assert succ is not None
+    # the successor inherited the warm digest: same-prefix traffic
+    # follows it rather than spraying across the fleet
+    assert keys_before <= set(succ.digest)
+    router.submit("w1", ps[1], max_new_tokens=4)
+    assert "w1" in succ.assigned
+    router.run()
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_rejoin_restores_affinity(gpt2_model):
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2, digest_refresh_steps=1)
+    ps = shared_prefix_prompts(cfg.vocab_size, n=3, seed=8)
+    router.submit("w0", ps[0], max_new_tokens=4)
+    router.run()
+    router.refresh_digests()
+    warm = next(r for r in router.replicas.values() if r.digest)
+    router.drain(warm.id)
+    assert router.drained(warm.id)
+    with pytest.raises(ValueError):       # double drain rejects
+        router.drain(warm.id)
+    router.rejoin(warm.id)
+    assert warm.state == HEALTHY
+    # the drained replica kept its warm pool: rejoin re-pulled the
+    # digest from the engine, so affinity routing resumes immediately
+    assert warm.digest
+    router.submit("w1", ps[1], max_new_tokens=4)
+    assert "w1" in warm.assigned
+    router.run()
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_rejoin_dead_needs_engine(gpt2_model):
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2)
+    router.kill("r0")
+    with pytest.raises(ValueError):
+        router.rejoin("r0")
+    fresh = serving_engine(params, cfg, prefix_cache=True,
+                           replica_id="r0", **KW)
+    router.rejoin("r0", engine=fresh)
+    assert router.replicas["r0"].state == HEALTHY
+    router.submit("a", [5, 6, 7], max_new_tokens=2)
+    out = router.run()
+    assert isinstance(out["a"], list)
+    assert_clean(router)
+    router.shutdown()
+
+
+# ----------------------------------------------------- health machine
+def test_health_state_machine_hysteresis(gpt2_model):
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2, quarantine_after=2,
+                        recover_after=2)
+    rep = router.replicas["r0"]
+    now = time.perf_counter()
+    rep.forced_degrade_until = now + 1e6   # pin degraded
+    router._poll_health(time.perf_counter())
+    assert rep.state == DEGRADED
+    router._poll_health(time.perf_counter())
+    assert rep.state == QUARANTINED
+    # quarantined replicas receive no new work
+    router.submit("a", [1, 2, 3], max_new_tokens=2)
+    assert "a" not in rep.assigned
+    router.run()
+    # recovery is stepwise: recover_after clean polls back to
+    # DEGRADED, another recover_after back to HEALTHY
+    rep.forced_degrade_until = 0.0
+    router._poll_health(time.perf_counter())
+    assert rep.state == QUARANTINED
+    router._poll_health(time.perf_counter())
+    assert rep.state == DEGRADED
+    router._poll_health(time.perf_counter())
+    router._poll_health(time.perf_counter())
+    assert rep.state == HEALTHY
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_replica_fault_kill_and_degrade(gpt2_model):
+    cfg, params = gpt2_model
+    rules = [
+        {"subsystem": "replica", "mode": "error", "match": "r1",
+         "count": 1},
+        {"subsystem": "replica", "mode": "degrade", "match": "r0",
+         "latency_s": 1e6, "count": 1},
+    ]
+    router = fleet_router(params, cfg, fleet={"replicas": 2},
+                          prefix_cache=True,
+                          faults={"rules": rules}, **KW)
+    ps = prompts(cfg.vocab_size, n=2, seed=9)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=2)
+    router.step()
+    assert router.replicas["r1"].state == DEAD
+    assert router.replicas["r0"].state == DEGRADED
+    assert "forced_degrade" in router.replicas["r0"].health_reasons
+    out = router.run()
+    assert set(out) == {f"q{i}" for i in range(len(ps))}
+    snap = router._fault_plan.snapshot()
+    assert snap["injected"] == 2
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_replica_fatal_stall_fails_over(gpt2_model):
+    cfg, params = gpt2_model
+    rules = [{"subsystem": "replica", "mode": "latency", "match": "r0",
+              "latency_s": 99.0, "count": 1}]
+    router = fleet_router(params, cfg,
+                          fleet={"replicas": 2, "fatal_stall_s": 1.0},
+                          prefix_cache=True,
+                          faults={"rules": rules}, **KW)
+    router.submit("a", [1, 2, 3, 4], max_new_tokens=2)
+    out = router.run()
+    # a stall past fatal_stall_s is a death, not a wait
+    assert router.replicas["r0"].state == DEAD
+    assert set(out) == {"a"}
+    assert_clean(router)
+    router.shutdown()
+
+
+# ----------------------------------------------------------- shedding
+def test_fleet_shed_accounting_reconciles(gpt2_model):
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2, shed_queue_depth=3,
+                        retry_budget=0,
+                        engine_kw={"shed_queue_depth": 2})
+    ps = prompts(cfg.vocab_size, n=12, seed=10, length=8)
+    results = [router.submit(f"q{i}", p, max_new_tokens=2)
+               for i, p in enumerate(ps)]
+    submit_sheds = [r for r in results if r is not None]
+    assert submit_sheds, "burst past both shed layers must shed"
+    out = router.run()
+    completed = {k for k, v in out.items() if isinstance(v, list)}
+    shed = {k: v for k, v in out.items()
+            if isinstance(v, RequestShed)}
+    failed = {k for k, v in out.items()
+              if isinstance(v, RequestFailed)}
+    # typed partition covers every submit
+    assert len(out) == len(ps)
+    assert len(completed) + len(shed) + len(failed) == len(ps)
+    # router host counts == typed results == rollup registry counters
+    assert router._n_shed == len(shed)
+    assert router._n_completed == len(completed)
+    cnt = router.registry.snapshot()["counters"]
+    assert int(cnt["fleet_shed_requests"]) == len(shed)
+    assert int(cnt["fleet_completed_requests"]) == len(completed)
+    by_reason = router._shed_by_reason
+    assert sum(by_reason.values()) == len(shed)
+    # both shed layers visible: fleet-level and surfaced replica-level
+    assert set(by_reason) <= {"fleet_queue_depth", "queue_depth",
+                              "no_replica"}
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_fleet_rollup_aggregates_slo(gpt2_model):
+    cfg, params = gpt2_model
+    slo = {"tiers": {"interactive": {"ttft_s": 60.0}},
+           "default_tier": "interactive"}
+    router = fleet_router(params, cfg, fleet={"replicas": 2},
+                          prefix_cache=True, slo=slo, **KW)
+    ps = prompts(cfg.vocab_size, n=4, seed=11)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=2,
+                      tier="interactive")
+    router.run()
+    roll = router.statusz()["slo"]
+    assert roll["enabled"] and roll["replicas"] == 2
+    t = roll["tiers"]["interactive"]
+    assert t["lifetime"]["attained"] + t["lifetime"]["violated"] == 4
+    # per-replica lifetimes sum into the rollup
+    per = [r.engine.slo_tracker.snapshot()["tiers"]["interactive"]
+           ["lifetime"]["attained"] for r in router.replicas.values()]
+    assert sum(per) == t["lifetime"]["attained"]
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_fleet_rollup_unit():
+    assert fleet_rollup([]) == {"enabled": False}
+    assert fleet_rollup([{"enabled": False}]) == {"enabled": False}
+    a = {"enabled": True, "default_tier": "t", "tiers": {"t": {
+        "objective": {}, "target": 0.9, "window_s": 60.0,
+        "window_finished": 4, "window_attained": 2,
+        "goodput_tokens_per_s": 10.0, "burn_rates": {"60s": 1.0},
+        "burn_threshold": 2.0, "alert_active": False,
+        "lifetime": {"attained": 2, "violated": 2}, "in_flight": 1}}}
+    b = {"enabled": True, "default_tier": "t", "tiers": {"t": {
+        "objective": {}, "target": 0.9, "window_s": 60.0,
+        "window_finished": 6, "window_attained": 6,
+        "goodput_tokens_per_s": 5.0, "burn_rates": {"60s": 3.0},
+        "burn_threshold": 2.0, "alert_active": True,
+        "lifetime": {"attained": 6, "violated": 0}, "in_flight": 0}}}
+    r = fleet_rollup([a, b])
+    t = r["tiers"]["t"]
+    assert t["window_finished"] == 10 and t["window_attained"] == 8
+    assert t["attainment"] == pytest.approx(0.8)
+    assert t["goodput_tokens_per_s"] == pytest.approx(15.0)
+    assert t["burn_rates"]["60s"] == 3.0    # max, not mean
+    assert t["alert_active"] is True
+    assert t["lifetime"]["attained"] == 8
+
+
+# ------------------------------------------------------ introspection
+def test_statusz_and_dstpu_top_render(gpt2_model):
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2)
+    ps = prompts(cfg.vocab_size, n=2, seed=12)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=2)
+    router.run()
+    router.kill("r1")
+    s = router.statusz()
+    assert s["engine"] == "FleetRouter"
+    assert s["fleet"]["states"] == {"healthy": 1, "dead": 1}
+    rows = {r["replica"]: r for r in s["fleet"]["replicas"]}
+    assert rows["r1"]["state"] == DEAD
+    assert {"queue_depth", "active_slots", "shed_rate",
+            "affinity_hits", "digest_pages"} <= set(rows["r0"])
+    h = router.healthz()
+    assert h["ready"] and h["degraded"]
+    assert "r1:dead" in h["reasons"]
+    # dstpu_top renders the fleet frame from the same snapshot
+    import importlib
+    top = importlib.import_module("tools.dstpu_top")
+    lines = top.render(s, h)
+    text = "\n".join(lines)
+    assert "FleetRouter" in text and "r1" in text and "dead" in text
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_fleet_http_statusz_roundtrip(gpt2_model):
+    cfg, params = gpt2_model
+    import json
+    import urllib.request
+
+    router = fleet_router(params, cfg, fleet={"replicas": 2},
+                          prefix_cache=True,
+                          telemetry={"http_port": 0}, **KW)
+    router.submit("a", [1, 2, 3, 4], max_new_tokens=2)
+    router.run()
+    port = router._tel_exporter.port
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/statusz", timeout=5) as r:
+        s = json.loads(r.read().decode())
+    assert s["engine"] == "FleetRouter"
+    assert len(s["fleet"]["replicas"]) == 2
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+        h = json.loads(r.read().decode())
+    assert h["ready"]
+    # one scrape carries the rollup AND every replica's namespaced
+    # family (dstpu_r0_*, dstpu_r1_*) — no metric-name collisions
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    assert "dstpu_fleet_submitted_requests" in text
+    assert "dstpu_r0_serving_admitted_requests" in text
+    assert "dstpu_r1_serving_admitted_requests" in text
+    router.shutdown()
+
+
+def test_replica_tagged_traces(gpt2_model):
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2)
+    ps = prompts(cfg.vocab_size, n=3, seed=13)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=2)
+    router.run()
+    # one shared ring; every engine-emitted event carries its replica
+    ring = router.replicas["r0"].engine.tracer.recorder.events()
+    tagged = [e for e in ring if e[4] and "replica" in e[4]]
+    assert tagged
+    seen = {e[4]["replica"] for e in tagged}
+    assert seen <= {"r0", "r1"} and len(seen) == 2
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_draining_replica_that_hangs_fails_over(gpt2_model):
+    """Review regression: a DRAINING replica still runs the death
+    checks — one that goes unready mid-drain must fail over (else its
+    in-flight requests never resolve)."""
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2)
+    ps = prompts(cfg.vocab_size, n=4, seed=15)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=6)
+    router.step()
+    victim = next(r for r in router.replicas.values()
+                  if any(s is not None for s in r.engine.slots))
+    router.drain(victim.id)
+    # simulate the engine wedging terminally mid-drain
+    victim.engine._closed = True       # healthz -> ready: False
+    out = router.run()
+    assert victim.state == DEAD
+    assert set(out) == {f"q{i}" for i in range(len(ps))}
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_rollup_keeps_dead_replica_lifetimes(gpt2_model):
+    """Review regression: failover must not make the fleet SLO
+    lifetime counters shrink — dead replicas' trackers stay in the
+    rollup."""
+    cfg, params = gpt2_model
+    slo = {"tiers": {"interactive": {"ttft_s": 60.0}},
+           "default_tier": "interactive"}
+    router = fleet_router(params, cfg, fleet={"replicas": 2},
+                          prefix_cache=True, slo=slo, **KW)
+    ps = prompts(cfg.vocab_size, n=4, seed=16)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=2)
+    router.run()
+    before = router.statusz()["slo"]["tiers"]["interactive"][
+        "lifetime"]["attained"]
+    assert before == 4
+    router.kill("r0")
+    after = router.statusz()["slo"]["tiers"]["interactive"][
+        "lifetime"]["attained"]
+    assert after == before
+    router.shutdown()
+
+
+def test_inherited_digest_survives_refresh(gpt2_model):
+    """Review regression: the drain handoff's donated keys must
+    survive the periodic digest refresh until the successor's own
+    warm pool covers them."""
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2, digest_refresh_steps=1)
+    ps = shared_prefix_prompts(cfg.vocab_size, n=3, seed=17)
+    router.submit("w0", ps[0], max_new_tokens=4)
+    router.run()
+    router.refresh_digests()
+    warm = next(r for r in router.replicas.values() if r.digest)
+    donated = set(warm.digest)
+    router.drain(warm.id)
+    succ = router._affinity_successor(warm)
+    router.refresh_digests()              # must NOT wipe the hint
+    assert donated <= set(succ.digest)
+    # same-prefix traffic lands on the successor, warms it for real…
+    router.submit("w1", ps[1], max_new_tokens=4)
+    assert "w1" in succ.assigned
+    router.run()
+    router.refresh_digests()
+    # …after which the hint retires into the successor's own digest
+    assert succ.inherited < frozenset(donated)
+    assert donated <= set(succ.digest)
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_submit_caller_error_not_counted(gpt2_model):
+    """Review regression: a validation error out of submit must not
+    bump the submitted counter (submitted == completed+failed+shed
+    is the gated invariant)."""
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2)
+    with pytest.raises(ValueError):
+        router.submit("bad", list(range(1, 60)), max_new_tokens=32)
+    assert router._n_submitted == 0
+    assert int(router._c_submitted.value) == 0
+    router.submit("ok", [1, 2, 3], max_new_tokens=2)
+    router.run()
+    assert router._n_submitted == 1
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_last_failover_ledger(gpt2_model):
+    """Review regression: the router records exactly which requests a
+    failover re-placed vs failed typed (the soak/bench recovery
+    metric reads this, not resubmit-count inference)."""
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2)
+    ps = prompts(cfg.vocab_size, n=4, seed=18)
+    for i, p in enumerate(ps):
+        router.submit(f"q{i}", p, max_new_tokens=6)
+    router.step()
+    victim = next(r for r in router.replicas.values() if r.assigned)
+    held = set(victim.assigned)
+    router.kill(victim.id)
+    fo = router.last_failover
+    assert fo is not None and fo["replica"] == victim.id
+    assert set(fo["resubmitted"]) | set(fo["failed_typed"]) == held
+    assert not (set(fo["resubmitted"]) & set(fo["failed_typed"]))
+    out = router.run()
+    for rid_ in fo["resubmitted"]:
+        assert rid_ in out
+    for rid_ in fo["failed_typed"]:
+        assert isinstance(out[rid_], (RequestFailed, RequestShed))
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_every_scenario_leak_free_per_replica(gpt2_model):
+    """The umbrella invariant: kill + drain + rejoin + reroute in one
+    run, then every replica's page accounting (dead one included) is
+    clean and the typed partition covers every submit."""
+    cfg, params = gpt2_model
+    ps = prompts(cfg.vocab_size, n=6, seed=14)
+    router = make_fleet(params, cfg, n=3)
+    for i, p in enumerate(ps[:4]):
+        router.submit(f"q{i}", p, max_new_tokens=4)
+    router.step()
+    router.kill("r0")
+    router.drain("r1")
+    for i, p in enumerate(ps[4:], 4):
+        router.submit(f"q{i}", p, max_new_tokens=4)
+    out = router.run()
+    router.rejoin("r1")
+    assert set(out) == {f"q{i}" for i in range(len(ps))}
+    for rep in router.replicas.values():
+        assert rep.engine.check_leaks() == [], rep.id
+    assert_clean(router)
+    router.shutdown()

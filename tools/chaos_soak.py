@@ -90,15 +90,238 @@ FAULT_RULES = [
 ]
 
 
+FLEET_FAULT_RULES = [
+    # kill replica r1 on its 4th router-step poll — mid-traffic, with
+    # requests queued and in flight there (failover: queued and
+    # zero-token work re-submits to survivors, token-bearing slots
+    # fail typed)
+    {"subsystem": "replica", "mode": "error", "match": "r1",
+     "count": 1, "after": 3},
+    # one queue-pressure burst (consumed by the traffic generator):
+    # aggregate depth past the fleet shed threshold → fleet-level
+    # typed sheds on top of any per-replica ones
+    {"subsystem": "burst", "rate": 1.0, "count": 1},
+]
+
+
+def fleet_main(args) -> int:
+    """--fleet: the 3-replica soak (ISSUE 10 acceptance).  A seeded
+    schedule kills one replica mid-traffic while the script drains and
+    rejoins another; asserts every accepted request completes token-
+    identical to a single-replica oracle or returns typed, zero leaks
+    on every replica (dead one included), zero orphans, bounded
+    failover recovery, and fleet accounting that reconciles across
+    typed results, router counters and the rollup registry.  Stamps
+    FLEET_SOAK.json, gated by tools/bench_gate.py."""
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from deepspeed_tpu import faults
+    from deepspeed_tpu.fleet import DEAD, DRAINING, fleet_router
+    from deepspeed_tpu.inference.serving import (RequestFailed,
+                                                 RequestShed,
+                                                 serving_engine)
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.utils.evidence import atomic_write_json
+
+    t_start = time.perf_counter()
+    cfg = gpt2.GPT2Config.tiny(dim=64, n_layers=2, n_heads=4,
+                               max_seq_len=128)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    waves, burst, expired = build_traffic(cfg.vocab_size)
+    kw = dict(max_batch=2, page_size=8, num_pages=12, max_seq=64,
+              prefill_bucket=8)
+
+    # ---- single-replica fault-free oracle
+    oracle_eng = serving_engine(params, cfg, prefix_cache=True, **kw)
+    distinct, seen = [], set()
+    for p in [p for w in waves for p in w] + burst + expired:
+        t = tuple(p)
+        if t not in seen:
+            seen.add(t)
+            distinct.append(p)
+    for i, p in enumerate(distinct):
+        oracle_eng.submit(f"o{i}", p, max_new_tokens=MAX_NEW)
+    oracle_out = oracle_eng.run()
+    oracle = {tuple(p): oracle_out[f"o{i}"]
+              for i, p in enumerate(distinct)}
+    oracle_eng.shutdown()
+
+    router = fleet_router(
+        params, cfg,
+        fleet={"replicas": 3, "retry_budget": 2, "shed_queue_depth": 10,
+               "digest_refresh_steps": 2},
+        prefix_cache=True,
+        slo={"tiers": {
+            "interactive": {"ttft_s": 60.0, "deadline_s": 300.0},
+            "expired": {"deadline_s": 0.001, "target": 0.5}},
+            "default_tier": "interactive"},
+        tracing={"ring_capacity": 65536},
+        faults={"seed": args.seed, "rules": FLEET_FAULT_RULES},
+        shed_queue_depth=4, shed_expired_deadline=True, **kw)
+
+    prompts_by_id = {}
+    rid = 0
+
+    def submit(p, tier=None):
+        nonlocal rid
+        req_id = f"r{rid:02d}"
+        rid += 1
+        prompts_by_id[req_id] = p
+        router.submit(req_id, p, max_new_tokens=MAX_NEW, tier=tier)
+        return req_id
+
+    t_kill = None
+    salvaged = set()
+    recovery_s = None
+
+    def drive():
+        nonlocal t_kill, salvaged, recovery_s
+        steps = 0
+        while router.has_work:
+            router.step()
+            if t_kill is None and router.last_failover is not None:
+                # failover just ran inside this step: the router's
+                # ledger names exactly the requests salvage re-placed
+                # (inferring from resubmit counts would also catch
+                # unrelated shed retries)
+                t_kill = router.last_failover["t"]
+                salvaged = set(router.last_failover["resubmitted"])
+            if t_kill is not None and recovery_s is None and \
+                    all(k in router.finished for k in salvaged):
+                recovery_s = time.perf_counter() - t_kill
+            steps += 1
+            if steps > STEP_CAP or \
+                    time.perf_counter() - t_start > WALL_CAP_S:
+                return False
+        return True
+
+    hang = False
+    drain_ok = True
+    for w, wave in enumerate(waves):
+        for p in wave:
+            submit(p)
+        _delay, fire = faults.poll("burst")
+        if fire is not None:
+            for p in burst:
+                submit(p)
+        hang = hang or not drive()
+        if w == 1:
+            # planned drain + rejoin of r2 between waves (the rolling-
+            # restart primitive), while r1's kill rule is arming
+            router.drain("r2")
+            hang = hang or not drive()
+            drain_ok = drain_ok and router.drained("r2") and \
+                router.replicas["r2"].state == DRAINING
+            router.rejoin("r2")
+            drain_ok = drain_ok and \
+                router.replicas["r2"].state == "healthy"
+    for p in expired:
+        submit(p, tier="expired")
+    time.sleep(0.05)
+    hang = hang or not drive()
+    if recovery_s is None and t_kill is not None:
+        recovery_s = time.perf_counter() - t_kill
+
+    # ---- reconcile
+    finished = dict(router.finished)
+    completed = {k: v for k, v in finished.items()
+                 if isinstance(v, list)}
+    failed = {k: v for k, v in finished.items()
+              if isinstance(v, RequestFailed)}
+    shed = {k: v for k, v in finished.items()
+            if isinstance(v, RequestShed)}
+    mismatched = [k for k, v in completed.items()
+                  if v != oracle[tuple(prompts_by_id[k])]]
+    leaks = router.check_leaks()
+    orphaned = router.orphaned()
+    cnt = router.registry.snapshot()["counters"]
+    status = router.statusz()
+    ring = router.replicas["r0"].engine.tracer.recorder.events()
+    checks = {
+        "typed_results_partition":
+            len(finished) == rid and
+            len(completed) + len(failed) + len(shed) == rid,
+        "router_counts":
+            router._n_completed == len(completed) and
+            router._n_failed == len(failed) and
+            router._n_shed == len(shed),
+        "registry_counters":
+            int(cnt.get("fleet_completed_requests", 0)) ==
+            len(completed) and
+            int(cnt.get("fleet_failed_requests", 0)) == len(failed)
+            and int(cnt.get("fleet_shed_requests", 0)) == len(shed),
+        "failover_happened":
+            router.replicas["r1"].state == DEAD and
+            int(cnt.get("fleet_failovers", 0)) == 1,
+        "trace_replica_events":
+            sum(1 for e in ring if e[3] == "replica_dead") == 1 and
+            sum(1 for e in ring if e[3] == "replica_drain") == 1 and
+            sum(1 for e in ring if e[3] == "replica_rejoin") == 1,
+        "drain_rejoin": drain_ok,
+    }
+    plan_snap = router._fault_plan.snapshot()
+    router.shutdown()
+    ok = (not mismatched and not hang and not leaks and not orphaned
+          and all(checks.values()) and plan_snap["injected"] > 0
+          and recovery_s is not None and recovery_s < 60.0)
+    stamp = {
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(),
+        "model": "gpt2-tiny",
+        "seed": args.seed,
+        "replicas": 3,
+        "ok": ok,
+        "submitted": rid,
+        "completed": len(completed),
+        "failed": len(failed),
+        "shed": len(shed),
+        "shed_by_reason": dict(router._shed_by_reason),
+        "resubmits": router._n_resubmits,
+        "mismatched_requests": len(mismatched),
+        "mismatched_ids": mismatched[:8],
+        "hang": int(hang),
+        "leak_count": len(leaks),
+        "leaks": leaks[:8],
+        "orphaned_requests": len(orphaned),
+        "recovery_s": round(recovery_s, 3)
+        if recovery_s is not None else None,
+        "accounting_ok": int(all(checks.values())),
+        "accounting": checks,
+        "fleet": {k: v for k, v in status["fleet"].items()
+                  if k != "replicas"},
+        "replica_states": {r["replica"]: r["state"]
+                           for r in status["fleet"]["replicas"]},
+        "injected": plan_snap,
+        "duration_s": round(time.perf_counter() - t_start, 2),
+    }
+    atomic_write_json(stamp, args.json_out)
+    print(json.dumps({k: v for k, v in stamp.items()
+                      if k not in ("injected", "fleet")},
+                     indent=1, sort_keys=True))
+    print("→", args.json_out)
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend in-process")
     ap.add_argument("--seed", type=int, default=0,
                     help="fault-plan seed (same seed = same schedule)")
-    ap.add_argument("--json-out",
-                    default=os.path.join(REPO, "CHAOS_SOAK.json"))
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the 3-replica fleet soak (replica kill + "
+                         "drain/rejoin) instead of the single-engine "
+                         "soak; stamps FLEET_SOAK.json by default")
+    ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
+    if args.json_out is None:
+        args.json_out = os.path.join(
+            REPO, "FLEET_SOAK.json" if args.fleet else "CHAOS_SOAK.json")
+    if args.fleet:
+        return fleet_main(args)
 
     import jax
 

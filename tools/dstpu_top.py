@@ -37,8 +37,73 @@ def _bar(frac: float, width: int = 20) -> str:
     return "[" + "#" * n + "." * (width - n) + f"] {100 * frac:5.1f}%"
 
 
+def render_fleet(status: dict, health: dict | None = None) -> list:
+    """One frame for a FleetRouter /statusz snapshot: fleet totals +
+    one row per replica (state, queue, shed rate, affinity hit rate)
+    + the cross-replica SLO rollup."""
+    L = []
+    fl = status.get("fleet", {})
+    states = " ".join(f"{k}={v}" for k, v in
+                      sorted(fl.get("states", {}).items()))
+    hdr = (f"FleetRouter  up {status.get('uptime_s', 0):.0f}s"
+           f"  replicas {states}")
+    if health is not None:
+        hdr += ("  READY" if health.get("ready") else "  NOT-READY")
+        if health.get("degraded"):
+            hdr += "  DEGRADED"
+    L.append(hdr)
+    L.append("-" * 78)
+    aff = fl.get("affinity", {})
+    L.append(f"fleet submitted {fl.get('submitted', 0)}"
+             f"  completed {fl.get('completed', 0)}"
+             f"  failed {fl.get('failed', 0)}"
+             f"  shed {fl.get('shed', 0)}"
+             f"  resubmits {fl.get('resubmits', 0)}"
+             f"  failovers {fl.get('failovers', 0)}"
+             f"  drains {fl.get('drains', 0)}")
+    L.append(f"route affinity {aff.get('affinity_routed', 0)}"
+             f"/{aff.get('affinity_routed', 0) + aff.get('least_loaded_routed', 0)}"
+             f"  hit-rate {aff.get('hit_rate', 0.0):.3f}"
+             f"  queue {fl.get('queue_depth', 0)}"
+             f"  in-flight {fl.get('in_flight', 0)}"
+             f"  orphaned {fl.get('orphaned', 0)}")
+    L.append("-" * 78)
+    L.append(f"{'replica':<9}{'state':<13}{'queue':>6}{'slots':>6}"
+             f"{'shed%':>7}{'failed':>7}{'aff':>5}{'digest':>7}"
+             f"  reasons")
+    for r in fl.get("replicas", []):
+        reasons = ",".join(r.get("reasons", []))[:24]
+        if r.get("stalled_for_s"):
+            reasons = (reasons + f" stall {r['stalled_for_s']:.1f}s"
+                       ).strip()
+        L.append(f"{r['replica']:<9}{r['state']:<13}"
+                 f"{r.get('queue_depth', 0):>6}"
+                 f"{r.get('active_slots', 0):>6}"
+                 f"{100 * r.get('shed_rate', 0.0):>6.1f}%"
+                 f"{r.get('failed', 0):>7}"
+                 f"{r.get('affinity_hits', 0):>5}"
+                 f"{r.get('digest_pages', 0):>7}"
+                 f"  {reasons}")
+    slo = status.get("slo", {})
+    if slo.get("enabled"):
+        L.append("-" * 78)
+        L.append(f"{'tier (fleet)':<14}{'attain':>8}{'target':>8}"
+                 f"{'goodput t/s':>13}  {'max burn':<22}{'alert':>6}")
+        for name, t in sorted(slo.get("tiers", {}).items()):
+            burns = " ".join(f"{w}={b:.1f}"
+                             for w, b in sorted(t["burn_rates"].items()))
+            L.append(f"{name:<14}{t['attainment']:>8.3f}"
+                     f"{t['target']:>8.3f}"
+                     f"{t['goodput_tokens_per_s']:>13.1f}  "
+                     f"{burns:<22}"
+                     f"{'FIRE' if t.get('alert_active') else '-':>6}")
+    return L
+
+
 def render(status: dict, health: dict | None = None) -> list:
     """One frame of text lines from a /statusz snapshot."""
+    if status.get("engine") == "FleetRouter" or "fleet" in status:
+        return render_fleet(status, health)
     L = []
     hdr = (f"{status.get('engine', '?')}  up {status.get('uptime_s', 0):.0f}s"
            f"  step age {status.get('last_step_age_s')}s")

@@ -86,6 +86,20 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/trace_report.py \
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py \
   --cpu --json-out "$REPO/CHAOS_SOAK.json" >/dev/null 2>&1 || true
 
+# fleet soak: the 3-replica router under a seeded schedule that kills
+# one replica mid-traffic while the script drains and rejoins another
+# — completed requests token-identical to a single-replica oracle,
+# typed results for everything else, zero leaks/orphans, bounded
+# failover recovery.  Stamps FLEET_SOAK.json, gated by bench_gate.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py \
+  --cpu --fleet --json-out "$REPO/FLEET_SOAK.json" >/dev/null 2>&1 || true
+
+# open-loop fleet bench: Poisson arrival sweep past saturation
+# (goodput-vs-load) plus a mid-traffic replica kill (failover
+# recovery curve) — stamps FLEET_BENCH.json, best-effort
+timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_fleet.py --cpu \
+  --json-out "$REPO/FLEET_BENCH.json" >/dev/null 2>&1 || true
+
 # bench regression gate: AFTER the stamps above, diff the evidence
 # files against the committed BENCH_BASELINE.json and leave a verdict
 # in BENCH_GATE.json — the perf trajectory as an enforced contract.
