@@ -22,8 +22,19 @@ must shed it.  Two stamps:
   the time until every request salvaged off the dead replica reached a
   terminal result.
 
+``--elastic`` (ISSUE 11): a third stamp, ``ELASTIC_BENCH.json`` — a
+scripted load **sine wave** drives a :class:`~deepspeed_tpu.autoscale.
+FleetAutoscaler` up and down between its bounds while a **live rolling
+weight update** runs mid-wave.  Recorded: goodput and p99 TTFT through
+the wave (from the flight recorder's queued→first-token spans),
+replica count per bucket, scale-up-decision→first-token latency
+(``scale_up_to_first_token_s``, the streamed-cold-start headline), and
+the invariants the gate pins: ``rollout_dropped`` / ``orphaned`` /
+``leak_count`` all 0.
+
     python bench_fleet.py --cpu --json-out FLEET_BENCH.json
     python bench_fleet.py --cpu --rates 2,5,10 --duration 4
+    python bench_fleet.py --cpu --elastic
 """
 
 import argparse
@@ -85,6 +96,226 @@ def build_router(params, cfg, args, seed: int):
         max_batch=args.slots, page_size=8,
         num_pages=args.num_pages, max_seq=64, prefill_bucket=8,
         seed=seed)
+
+
+def sine_arrivals(rate_lo: float, rate_hi: float, period_s: float,
+                  duration_s: float, seed: int):
+    """Arrival times of a time-varying Poisson process whose rate
+    follows a sine wave between ``rate_lo`` and ``rate_hi`` (thinning:
+    draw at the peak rate, accept with rate(t)/rate_hi)."""
+    import math
+
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    mid = (rate_hi + rate_lo) / 2.0
+    amp = (rate_hi - rate_lo) / 2.0
+    out, t = [], 0.0
+    while True:
+        t += float(rng.exponential(1.0 / rate_hi))
+        if t >= duration_s:
+            return out
+        rate = mid + amp * math.sin(2.0 * math.pi * t / period_s)
+        if rng.random() < rate / rate_hi:
+            out.append(t)
+
+
+def ttft_percentiles(ring, completed_ids):
+    """p50/p99 TTFT (s) from the flight-recorder ring: first `queued`
+    → first `first_token` per completed request (failover resubmits
+    keep the FIRST queued stamp — the user's clock)."""
+    import numpy as np
+
+    queued, first = {}, {}
+    for t_ns, req, _slot, phase, _attrs in ring:
+        if phase == "queued" and req not in queued:
+            queued[req] = t_ns
+        elif phase == "first_token" and req not in first:
+            first[req] = t_ns
+    ttfts = [(first[r] - queued[r]) / 1e9 for r in completed_ids
+             if r in queued and r in first]
+    if not ttfts:
+        return {"n": 0}
+    arr = np.array(sorted(ttfts))
+    return {"n": len(arr),
+            "p50_s": round(float(np.percentile(arr, 50)), 4),
+            "p99_s": round(float(np.percentile(arr, 99)), 4)}
+
+
+def elastic_main(args) -> int:
+    """--elastic: sine-wave load vs the autoscaler + a live rolling
+    weight update; stamps ELASTIC_BENCH.json."""
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from deepspeed_tpu.autoscale import FleetAutoscaler
+    from deepspeed_tpu.fleet import DEAD, fleet_router
+    from deepspeed_tpu.inference.serving import (RequestFailed,
+                                                 RequestShed,
+                                                 serving_engine)
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.telemetry import MetricsRegistry
+    from deepspeed_tpu.utils.evidence import atomic_write_json
+
+    t_start = time.perf_counter()
+    cfg = gpt2.GPT2Config.tiny(dim=64, n_layers=2, n_heads=4,
+                               max_seq_len=128)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    new_params = gpt2.init_params(jax.random.PRNGKey(1), cfg)
+    make_prompt = build_prompts(cfg.vocab_size, args.users, args.seed)
+    # a loose objective on purpose: this stamp measures TTFT
+    # percentiles itself, and the tier exists for goodput accounting
+    # and the rollout's burn gate — a crest-of-wave TTFT blip must not
+    # read as "the new version is bad" (the default 0.99 target turns
+    # one violation into burn ≫ 1 and vetoes every upgrade)
+    slo = {"tiers": {"interactive": {
+        "ttft_s": 10.0, "deadline_s": 30.0, "target": 0.9}},
+        "default_tier": "interactive"}
+    kw = dict(max_batch=args.slots, page_size=8,
+              num_pages=args.num_pages, max_seq=64, prefill_bucket=8,
+              prefix_cache=True, slo=slo,
+              shed_queue_depth=args.replica_shed)
+
+    router = fleet_router(
+        params, cfg,
+        fleet={"replicas": 1, "retry_budget": 2,
+               "shed_queue_depth": args.fleet_shed,
+               # saturation shedding must NOT quarantine the fleet out
+               # of rotation here — scaling, not quarantine, is the
+               # elastic response to crest-of-wave shed activity
+               "quarantine_after": 10_000,
+               "digest_refresh_steps": 2},
+        tracing={"ring_capacity": 262144}, seed=args.seed, **kw)
+
+    def factory(rid, streamed=False):
+        return serving_engine(
+            params, cfg, replica_id=rid, tracing=router.tracer,
+            telemetry=MetricsRegistry(namespace=f"dstpu_{rid}"),
+            seed=args.seed, **kw)
+
+    auto = FleetAutoscaler(router, factory, autoscale={
+        "min_replicas": 1, "max_replicas": args.replicas,
+        "eval_interval_steps": 2, "scale_up_queue_depth": 3.0,
+        "scale_down_queue_depth": 0.5, "up_after": 1, "down_after": 6,
+        "cooldown_s": 1.0, "rollout_soak_steps": 2})
+
+    # warmup: compile the serving programs outside the timed wave
+    router.submit("warm", make_prompt(0), max_new_tokens=4)
+    auto.run()
+    router.drain_finished()
+
+    duration = args.duration * 3           # one wave needs room
+    arrivals = sine_arrivals(args.wave_lo, args.wave_hi,
+                             duration, duration, args.seed + 3)
+    t_rollout = duration * 0.55
+    t0 = time.perf_counter()
+    next_i = 0
+    rollout_started = False
+    buckets = {}
+    while True:
+        now = time.perf_counter() - t0
+        while next_i < len(arrivals) and arrivals[next_i] <= now:
+            router.submit(f"e{next_i:05d}", make_prompt(next_i),
+                          max_new_tokens=MAX_NEW)
+            next_i += 1
+        if not rollout_started and now >= t_rollout:
+            auto.rollout(new_params, version="v2")
+            rollout_started = True
+        done = auto.step()
+        b = int((time.perf_counter() - t0) / 0.5)
+        rec = buckets.setdefault(b, {"completed": 0, "replicas": 0})
+        rec["completed"] += len(done)
+        rec["replicas"] = sum(1 for rep in router.replicas.values()
+                              if rep.state != DEAD)
+        if next_i >= len(arrivals) and not router.has_work \
+                and not auto.rollout_active and not auto._retiring:
+            break
+        if now > WALL_CAP_S:
+            break
+    elapsed = time.perf_counter() - t0
+    # idle tail: the trough after the wave — sustained low pressure
+    # must walk the fleet back down to min_replicas
+    t_tail = time.perf_counter()
+    while time.perf_counter() - t_tail < 15.0:
+        auto.step()
+        live = sum(1 for rep in router.replicas.values()
+                   if rep.state != DEAD)
+        b = int((time.perf_counter() - t0) / 0.5)
+        buckets.setdefault(b, {"completed": 0, "replicas": live})[
+            "replicas"] = live
+        if live <= auto.cfg.min_replicas and not auto._retiring:
+            break
+        time.sleep(0.002)
+
+    fin = router.finished
+    completed = [k for k, v in fin.items() if isinstance(v, list)]
+    failed = [k for k, v in fin.items()
+              if isinstance(v, RequestFailed)]
+    shed = [k for k, v in fin.items() if isinstance(v, RequestShed)]
+    slo_roll = router.statusz()["slo"]
+    life = {"attained": 0, "violated": 0, "tokens": 0,
+            "goodput_tokens": 0}
+    if slo_roll.get("enabled"):
+        for t in slo_roll["tiers"].values():
+            for k in life:
+                life[k] += t["lifetime"].get(k, 0)
+    ring = router.tracer.recorder.events()
+    ttft = ttft_percentiles(ring, set(completed))
+    replica_counts = [rec["replicas"] for _, rec in sorted(
+        buckets.items())]
+    first_tok = [rec["first_token_s"]
+                 for rec in auto.cold_history
+                 if rec.get("first_token_s") is not None]
+    st = auto.status()
+    out = {
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(),
+        "model": "gpt2-tiny",
+        "seed": args.seed,
+        "wave": {"rate_lo": args.wave_lo, "rate_hi": args.wave_hi,
+                 "period_s": duration, "duration_s": duration},
+        "offered": next_i,
+        "completed": len(completed),
+        "shed": len(shed),
+        "failed": len(failed),
+        "elapsed_s": round(elapsed, 2),
+        "tokens_per_s": round(life["tokens"] / max(elapsed, 1e-9), 2),
+        "goodput_tokens_per_s": round(
+            life["goodput_tokens"] / max(elapsed, 1e-9), 2),
+        "attainment": round(
+            life["attained"]
+            / max(life["attained"] + life["violated"], 1), 4),
+        "ttft": ttft,
+        "scale_ups": st["scale_ups"],
+        "scale_downs": st["scale_downs"],
+        "replicas_min": min(replica_counts) if replica_counts else 0,
+        "replicas_max": max(replica_counts) if replica_counts else 0,
+        "scale_up_to_first_token_s": round(max(first_tok), 3)
+        if first_tok else None,
+        "rollout": dict(auto.last_rollout or {}),
+        # the gate rows: an elastic fleet that drops, strands or leaks
+        # even one request regressed
+        "rollout_dropped": len(failed),
+        "orphaned_requests": len(router.orphaned()),
+        "leak_count": len(router.check_leaks()),
+        "replica_buckets": [
+            {"t_s": round(b * 0.5, 1), **rec}
+            for b, rec in sorted(buckets.items())],
+        "duration_s": round(time.perf_counter() - t_start, 2),
+    }
+    router.shutdown()
+    print(json.dumps({k: v for k, v in out.items()
+                      if k != "replica_buckets"}, indent=1,
+                     sort_keys=True))
+    atomic_write_json(out, args.json_out)
+    print("→", args.json_out)
+    ok = (out["rollout_dropped"] == 0 and out["orphaned_requests"] == 0
+          and out["leak_count"] == 0 and out["scale_ups"] >= 1
+          and out["scale_downs"] >= 1
+          and (auto.last_rollout or {}).get("completed", False))
+    return 0 if ok else 1
 
 
 def drive_open_loop(router, arrivals, make_prompt, *, kill=None,
@@ -192,9 +423,24 @@ def main():
     ap.add_argument("--slo-ttft-s", type=float, default=3.0)
     ap.add_argument("--slo-deadline-s", type=float, default=20.0)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--json-out",
-                    default=os.path.join(REPO, "FLEET_BENCH.json"))
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the autoscaler sine-wave + live weight "
+                         "swap bench instead of the load/failover "
+                         "curves; stamps ELASTIC_BENCH.json by default")
+    ap.add_argument("--wave-lo", type=float, default=1.0,
+                    help="--elastic: sine-wave trough arrival rate "
+                         "(req/s)")
+    ap.add_argument("--wave-hi", type=float, default=10.0,
+                    help="--elastic: sine-wave crest arrival rate "
+                         "(req/s)")
+    ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
+    if args.json_out is None:
+        args.json_out = os.path.join(
+            REPO, "ELASTIC_BENCH.json" if args.elastic
+            else "FLEET_BENCH.json")
+    if args.elastic:
+        return elastic_main(args)
 
     import jax
 

@@ -656,6 +656,115 @@ class FleetConfig:
 
 
 @dataclasses.dataclass
+class AutoscaleConfig:
+    """Elastic-fleet autoscaling block (consumed by
+    :class:`~deepspeed_tpu.autoscale.FleetAutoscaler` over a
+    :class:`~deepspeed_tpu.fleet.FleetRouter`).  The autoscaler polls
+    the control-plane signals the fleet already emits — mean queue
+    depth per routable replica, shed activity since the last
+    evaluation, and the max SLO burn rate across the fleet — every
+    ``eval_interval_steps`` router steps, and drives scale-up (spawn a
+    replica from the registered ``engine_factory``) and scale-down
+    (``drain()`` → ``retire()``, warm digest handed to the affinity
+    successor) between ``min_replicas`` and ``max_replicas``.
+
+    Hysteresis + cooldown: pressure must persist for ``up_after``
+    (resp. ``down_after``) consecutive evaluations before a scale
+    event, and at least ``cooldown_s`` must separate events, so a
+    burn-rate blip never flaps the fleet.
+
+    ``cold_start="streamed"`` spawns new replicas in ZeRO-Inference
+    streamed mode (serve immediately while weights page in from
+    host/NVMe — arXiv:2104.07857) and promotes
+    ``promote_layers_per_tick`` layers per autoscaler tick until the
+    replica flips to fully resident; ``"resident"`` builds the classic
+    engine (the factory decides what either means for its model).
+
+    Rolling weight updates (``FleetAutoscaler.rollout``): the fleet is
+    walked one replica at a time (drain → swap → rejoin), watching
+    ``rollout_soak_steps`` ticks between replicas; if the NEW
+    version's max burn rate exceeds ``rollback_burn_threshold`` with
+    at least ``rollback_min_finished`` classified requests on it, the
+    rollout halts and already-updated replicas roll back — an upgrade
+    never drops or double-generates a request.
+    """
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    eval_interval_steps: int = 8
+    scale_up_queue_depth: float = 4.0
+    scale_up_burn: float = 1.0
+    scale_up_on_shed: bool = True
+    scale_down_queue_depth: float = 0.5
+    up_after: int = 2
+    down_after: int = 3
+    cooldown_s: float = 5.0
+    cold_start: str = "resident"
+    promote_layers_per_tick: int = 1
+    rollout_soak_steps: int = 2
+    rollback_burn_threshold: float = 1.0
+    rollback_min_finished: int = 1
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AutoscaleConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        a = cls(**{k: v for k, v in d.items() if k in known})
+        a.enabled = bool(a.enabled)
+        for name, lo in (("min_replicas", 1), ("max_replicas", 1),
+                         ("eval_interval_steps", 1), ("up_after", 1),
+                         ("down_after", 1),
+                         ("promote_layers_per_tick", 1),
+                         ("rollout_soak_steps", 0),
+                         ("rollback_min_finished", 1)):
+            v = int(getattr(a, name))
+            setattr(a, name, v)
+            if v < lo:
+                raise ValueError(
+                    f"autoscale.{name} must be >= {lo}, got {v}")
+        if a.max_replicas < a.min_replicas:
+            raise ValueError(
+                f"autoscale.max_replicas {a.max_replicas} < "
+                f"min_replicas {a.min_replicas}")
+        for name in ("scale_up_queue_depth", "scale_down_queue_depth",
+                     "scale_up_burn", "cooldown_s",
+                     "rollback_burn_threshold"):
+            v = float(getattr(a, name))
+            setattr(a, name, v)
+            if v < 0:
+                raise ValueError(
+                    f"autoscale.{name} must be >= 0, got {v}")
+        if a.scale_down_queue_depth > a.scale_up_queue_depth:
+            raise ValueError(
+                f"autoscale.scale_down_queue_depth "
+                f"{a.scale_down_queue_depth} > scale_up_queue_depth "
+                f"{a.scale_up_queue_depth} — the band would scale up "
+                "and down simultaneously")
+        a.scale_up_on_shed = bool(a.scale_up_on_shed)
+        if a.cold_start not in ("resident", "streamed"):
+            raise ValueError(
+                f"autoscale.cold_start must be 'resident' or "
+                f"'streamed', got {a.cold_start!r}")
+        return a
+
+    @classmethod
+    def coerce(cls, obj) -> "AutoscaleConfig":
+        """Accept None (disabled), a dict (writing the block is the
+        opt-in, like ``fleet``), or an AutoscaleConfig."""
+        if obj is None:
+            return cls(enabled=False)
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("enabled", True)   # passing a block opts in
+            return cls.from_dict(d)
+        raise TypeError(
+            f"autoscale must be a dict or AutoscaleConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class TelemetryConfig:
     """Runtime telemetry block (no single reference analogue — it
     unifies the reference's monitor/comms-logger/flops-profiler
@@ -929,6 +1038,8 @@ class Config:
     faults: FaultsConfig = dataclasses.field(
         default_factory=FaultsConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    autoscale: AutoscaleConfig = dataclasses.field(
+        default_factory=AutoscaleConfig)
     telemetry: TelemetryConfig = dataclasses.field(
         default_factory=TelemetryConfig)
     tracing: TracingConfig = dataclasses.field(
@@ -1062,6 +1173,11 @@ class Config:
             c.faults = FaultsConfig.coerce(d["faults"])
         if "fleet" in d:
             c.fleet = FleetConfig.coerce(d["fleet"])
+        if "autoscale" in d:
+            # coerce, not from_dict: writing the block IS the opt-in
+            # (same contract as faults / slo above); an explicit
+            # "enabled": false still disables
+            c.autoscale = AutoscaleConfig.coerce(d["autoscale"])
         if "telemetry" in d:
             c.telemetry = TelemetryConfig.coerce(d["telemetry"])
         if "tracing" in d:

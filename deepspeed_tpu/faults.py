@@ -51,7 +51,16 @@ module provides both halves of proving that:
               and the replica-only mode ``degrade`` forces its health
               to degraded for ``latency_s`` seconds (default 30) —
               quarantine/hysteresis exercise without breaking
-              anything.
+              anything.  Combined with ``after=`` this is also how the
+              elastic soak kills a replica mid-rollout.
+  scale       the :class:`~deepspeed_tpu.autoscale.FleetAutoscaler`'s
+              scale-up path (one opportunity per spawn attempt; key =
+              the new replica id, so ``match=`` targets one).  Mode
+              ``error`` = engine-factory failure (the scale-up aborts,
+              is counted, and retries at a later evaluation); mode
+              ``latency`` = a slow cold-start (the spawn sleeps
+              ``latency_s`` before the factory runs — visible in the
+              ``autoscale_cold_start_seconds`` histogram).
   ========== ===========================================================
 
 - **Degradation helpers**: :func:`retry_with_backoff` (the bounded
@@ -104,12 +113,13 @@ class FatalStreamError(RuntimeError):
 
 
 SUBSYSTEMS = ("aio_read", "aio_write", "kv_corrupt", "slot",
-              "sync_read", "burst", "replica")
+              "sync_read", "burst", "replica", "scale")
 MODES = ("error", "latency", "degrade")
 # subsystems whose opportunities carry a key a `match` filter can test
 # (aio ops and bursts are anonymous — a match there would validate
 # fine and silently never fire, so it is rejected at rule build)
-_KEYED_SUBSYSTEMS = ("kv_corrupt", "slot", "sync_read", "replica")
+_KEYED_SUBSYSTEMS = ("kv_corrupt", "slot", "sync_read", "replica",
+                     "scale")
 
 
 @dataclasses.dataclass

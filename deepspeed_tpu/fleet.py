@@ -66,7 +66,7 @@ from deepspeed_tpu.inference.prefix_cache import (matchable_pages,
                                                   page_keys)
 from deepspeed_tpu.inference.serving import (EngineClosed, RequestFailed,
                                              RequestShed, RequestResult)
-from deepspeed_tpu.request_trace import RequestTracer
+from deepspeed_tpu.request_trace import NULL_TRACER, RequestTracer
 from deepspeed_tpu.slo import fleet_rollup
 from deepspeed_tpu.telemetry import MetricsRegistry, TelemetryExporter
 from deepspeed_tpu.utils.logging import logger
@@ -123,7 +123,15 @@ class Replica:
         self.stall_until = 0.0
         self.forced_degrade_until = 0.0
         self.affinity_hits = 0
+        self.completed = 0           # token-list results harvested here
         self.state_since = time.perf_counter()
+
+    @property
+    def version(self):
+        """The weight version this replica is serving (rolling updates
+        move replicas between versions one drain→swap→rejoin at a
+        time; the per-version SLO rollup groups on this)."""
+        return self.engine.weights_version
 
     @property
     def routable(self) -> bool:
@@ -158,7 +166,7 @@ class FleetRouter:
     """
 
     def __init__(self, engines, *, fleet=None, telemetry=None,
-                 faults=None):
+                 faults=None, tracer=None):
         self.cfg = FleetConfig.coerce(fleet)
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
@@ -236,6 +244,14 @@ class FleetRouter:
             "fleet_drains", "planned drains started")
         self._c_rejoins = r.counter(
             "fleet_rejoins", "replicas rejoined after drain/death")
+        self._c_spawns = r.counter(
+            "fleet_spawns",
+            "replicas added to the ring after construction "
+            "(autoscaler scale-up or an operator's spawn())")
+        self._c_retires = r.counter(
+            "fleet_retires",
+            "replicas removed from the ring (autoscaler scale-down "
+            "retire after drain, or a dead slot reclaimed)")
         self._c_replica_sheds = r.counter(
             "fleet_replica_shed_returns",
             "typed sheds returned by a replica to the router "
@@ -261,6 +277,18 @@ class FleetRouter:
 
         self.requests: Dict[Any, _FleetReq] = {}    # live ledger
         self.finished: Dict[Any, RequestResult] = {}
+        # final SLO snapshots (with their weight version) of replicas
+        # retired from the ring: the fleet rollup folds these in so
+        # lifetime counters never shrink at a scale-down (the same
+        # contract failover keeps for DEAD replicas, which stay in the
+        # ring)
+        self._retired_slo: List[Tuple[Dict[str, Any], Any]] = []
+        # fleet-level event tracer (the autoscaler and the scale verbs
+        # emit through it; per-replica engines keep their own bound
+        # tracers) — NULL unless the builder passed the shared one
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self._autoscaler = None
+        self._spawn_seq = len(self.replicas)
         # ledger of the most recent failover: which requests the
         # salvage re-placed vs failed typed — the soak and the bench
         # measure recovery against exactly this set (inferring it from
@@ -541,14 +569,22 @@ class FleetRouter:
                              rep.id)
 
     # ---------------------------------------------------- drain / rejoin
-    def drain(self, replica_id: str) -> None:
+    def drain(self, replica_id: str,
+              successor_exclude=()) -> None:
         """Planned drain: stop new admissions, re-route the replica's
         queued requests (no retry-budget charge — this is scheduled
         movement, not failure), let in-flight requests finish in
         place, and republish its warm prefix digest to its affinity
         successor so shared-prefix traffic follows the warmth.  The
         replica stays DRAINING (steppable, unroutable) until
-        :meth:`rejoin`."""
+        :meth:`rejoin` (or :meth:`retire`).
+
+        The donated digest includes keys the replica itself INHERITED
+        from an earlier drain — draining the current affinity
+        successor must pass the whole hint chain along, not quietly
+        drop the part it never materialized.  ``successor_exclude``:
+        replica ids the handoff must skip (a rollout excludes its NEXT
+        target, which is about to drain too)."""
         rep = self.replicas[replica_id]
         if rep.state in (DEAD, DRAINING):
             raise ValueError(
@@ -556,7 +592,8 @@ class FleetRouter:
                 "live replica")
         rep.set_state(DRAINING)
         self._c_drains.inc()
-        succ = self._affinity_successor(rep)
+        succ = self._affinity_successor(
+            rep, exclude=frozenset(successor_exclude))
         if succ is not None:
             # routing hint, deliberately optimistic: the successor does
             # not hold these pages yet, but same-prefix traffic landing
@@ -565,7 +602,7 @@ class FleetRouter:
             # nothing.  Recorded as `inherited` so the periodic digest
             # refresh keeps the hint alive until the successor's own
             # warm pool covers it.
-            donated = rep.engine.warm_keys()
+            donated = rep.engine.warm_keys() | rep.inherited
             succ.inherited = frozenset(succ.inherited | donated)
             succ.digest = frozenset(succ.digest | donated)
         tracer = rep.engine.tracer
@@ -581,15 +618,22 @@ class FleetRouter:
                 self._retry_or_fail(freq, "replica_draining",
                                     exclude=frozenset({rep.id}),
                                     charge=False)
-        rep.digest = frozenset()
+        rep.digest = rep.inherited = frozenset()
 
-    def _affinity_successor(self, rep: Replica) -> Optional[Replica]:
-        """Next routable replica in ring order after ``rep``."""
+    def _affinity_successor(self, rep: Replica,
+                            exclude: frozenset = frozenset()
+                            ) -> Optional[Replica]:
+        """Next ROUTABLE replica in ring order after ``rep`` (routable
+        already excludes DRAINING/DEAD — a warm digest is never
+        donated to a replica that could not serve the traffic it
+        attracts).  ``exclude`` additionally skips ids the caller
+        knows are ABOUT to drain (a rollout's next target), which
+        routability cannot see yet."""
         ring = list(self.replicas.values())
         i = ring.index(rep)
         for j in range(1, len(ring)):
             cand = ring[(i + j) % len(ring)]
-            if cand.routable:
+            if cand.routable and cand.id not in exclude:
                 return cand
         return None
 
@@ -610,6 +654,16 @@ class FleetRouter:
                 f"replica {replica_id} is dead (engine shut down) — "
                 "rejoin needs a replacement engine")
         if engine is not None:
+            # a shut-down engine must be rejected HERE, not discovered
+            # at the first submit: rejoining it would put a replica in
+            # rotation whose every admission raises — the router would
+            # read that as an instant re-death
+            if getattr(engine, "_closed", False):
+                raise EngineClosed(
+                    f"rejoin of replica {replica_id} was handed a "
+                    "shut-down engine — a replacement engine must be "
+                    "freshly built (shutdown() already ran on this "
+                    "one, so it can never serve again)")
             if engine.replica_id is None:
                 engine.replica_id = replica_id
             rep.engine = engine
@@ -626,6 +680,125 @@ class FleetRouter:
         tracer = rep.engine.tracer
         if tracer.enabled:
             tracer.event("replica_rejoin", attrs={"replica": rep.id})
+
+    # ---------------------------------------------------- spawn / retire
+    # (the elastic verbs: the autoscaler adds replicas under load and
+    # removes them — drain → retire — when load falls; both are also
+    # operator verbs for manual fleet surgery)
+    def spawn(self, engine, replica_id: Optional[str] = None) -> str:
+        """Add a NEW replica to the end of the ring (unlike
+        :meth:`rejoin`, which refills an existing slot).  The engine
+        must be live and fleet-compatible (same model/page geometry —
+        the router re-submits requests between replicas).  Returns the
+        replica id; the replica enters rotation HEALTHY with its
+        digest read from its actual warm pool (empty for a cold
+        engine; a ZeRO-Inference streamed engine serves immediately
+        while its weights page in)."""
+        if self._closed:
+            raise EngineClosed("spawn after fleet shutdown")
+        if getattr(engine, "_closed", False):
+            raise EngineClosed(
+                "spawn was handed a shut-down engine — build a fresh "
+                "one (shutdown() already ran on it)")
+        if replica_id is None and engine.replica_id is not None \
+                and engine.replica_id not in self.replicas:
+            replica_id = engine.replica_id
+        if replica_id is None:
+            while f"r{self._spawn_seq}" in self.replicas:
+                self._spawn_seq += 1
+            replica_id = f"r{self._spawn_seq}"
+        if replica_id in self.replicas:
+            raise ValueError(
+                f"duplicate replica id {replica_id!r} — retire or "
+                "rejoin the existing slot instead")
+        if engine.replica_id is None:
+            engine.replica_id = replica_id
+        rep = Replica(replica_id, engine)
+        rep.digest = engine.warm_keys()
+        self.replicas[replica_id] = rep
+        self._c_spawns.inc()
+        if self._tel_exporter is not None:
+            self._tel_exporter.add_source(engine.registry)
+        tracer = engine.tracer
+        if tracer.enabled:
+            tracer.event("replica_spawn", attrs={
+                "replica": replica_id,
+                "version": str(engine.weights_version)})
+        return replica_id
+
+    def retire(self, replica_id: str) -> None:
+        """Remove a replica from the ring for good (scale-down: the
+        counterpart of :meth:`spawn`).  Only a DEAD replica or a
+        DRAINING one that finished its in-flight work may retire — a
+        routable replica must :meth:`drain` first so its queued work
+        re-routes and its warm digest hands off.  The replica's final
+        per-version SLO snapshot is folded into the fleet rollup
+        forever (lifetime counters never shrink at a scale-down)."""
+        rep = self.replicas[replica_id]
+        if rep.state == DRAINING:
+            if rep.engine.has_work or rep.assigned:
+                raise ValueError(
+                    f"replica {replica_id} still has in-flight work — "
+                    "retire only after drained() reports True")
+            if not any(r.state != DEAD for r in self.replicas.values()
+                       if r.id != replica_id):
+                raise ValueError(
+                    f"replica {replica_id} is the last live replica — "
+                    "retiring it would kill the fleet (spawn a "
+                    "replacement first)")
+        elif rep.state != DEAD:
+            raise ValueError(
+                f"replica {replica_id} is {rep.state} — retire needs "
+                "a drained (DRAINING + finished) or DEAD replica")
+        try:
+            self._retired_slo.append(
+                (rep.engine.slo_tracker.snapshot(), rep.version))
+            self._compact_retired()
+        except Exception:
+            logger.exception("fleet: retired-SLO capture (%s)",
+                             replica_id)
+        tracer = rep.engine.tracer
+        if tracer.enabled:
+            tracer.event("replica_retire", attrs={
+                "replica": replica_id, "state": rep.state})
+        del self.replicas[replica_id]
+        self._c_retires.inc()
+        if self._tel_exporter is not None:
+            # the retired replica's metric families leave /metrics
+            # with it (its SLO lifetime survives via _retired_slo)
+            self._tel_exporter.remove_source(rep.engine.registry)
+        try:
+            rep.engine.shutdown()
+        except Exception:
+            logger.exception("fleet: retired replica %s shutdown",
+                             replica_id)
+
+    def _compact_retired(self) -> None:
+        """Bound the retired-SLO ledger: a fleet breathing for weeks
+        retires thousands of replicas, and statusz() re-aggregates the
+        list on every poll.  Same-version snapshots merge through
+        :func:`fleet_rollup` (whose output is itself a consumable
+        snapshot — lifetime counters sum, so nothing ever shrinks);
+        distinct versions stay separate for the by_version view."""
+        if len(self._retired_slo) <= 8:
+            return
+        groups: "collections.OrderedDict[str, list]" = \
+            collections.OrderedDict()
+        for snap, v in self._retired_slo:
+            groups.setdefault(str(v), []).append((snap, v))
+        out = []
+        for g in groups.values():
+            if len(g) > 1:
+                out.append((fleet_rollup([s for s, _ in g]), g[0][1]))
+            else:
+                out.extend(g)
+        self._retired_slo = out
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Register the :class:`~deepspeed_tpu.autoscale.
+        FleetAutoscaler` driving this fleet so ``/statusz`` carries its
+        ``elastic`` block (the autoscaler calls this itself)."""
+        self._autoscaler = autoscaler
 
     # ------------------------------------------------------------ health
     def _poll_faults(self, now: float) -> None:
@@ -747,6 +920,7 @@ class FleetRouter:
             else:
                 self._c_completed.inc()
                 self._n_completed += 1
+                rep.completed += 1
                 self._finish(rid, res)
             out.append(rid)
         return out
@@ -873,6 +1047,7 @@ class FleetRouter:
             row = {
                 "replica": rep.id,
                 "state": rep.state,
+                "version": str(rep.version),
                 "state_age_s": round(now - rep.state_since, 3),
                 "queue_depth": len(e.queue),
                 "active_slots": sum(1 for s in e.slots
@@ -903,6 +1078,8 @@ class FleetRouter:
             "failovers": int(self._c_failovers.value),
             "drains": int(self._c_drains.value),
             "rejoins": int(self._c_rejoins.value),
+            "spawns": int(self._c_spawns.value),
+            "retires": int(self._c_retires.value),
             "affinity": {
                 "enabled": self._affinity,
                 "affinity_routed": int(self._c_affinity.value),
@@ -917,6 +1094,14 @@ class FleetRouter:
             "in_flight": len(self.requests),
             "orphaned": len(self.orphaned()),
         }
+        # DEAD replicas included (their trackers are host-side and
+        # outlive shutdown) and RETIRED replicas' final snapshots
+        # folded in: the fleet "lifetime" counters never shrink at a
+        # failover or a scale-down.  Versions ride along so the rollup
+        # carries the per-version view a rolling update watches.
+        snaps = [(rep.engine.slo_tracker.snapshot(now=now), rep.version)
+                 for rep in self.replicas.values()]
+        snaps.extend(self._retired_slo)
         status = {
             "schema_version": 1,
             "engine": "FleetRouter",
@@ -924,14 +1109,12 @@ class FleetRouter:
             "uptime_s": round(now - self._t_start, 3),
             "steps": self._steps,
             "fleet": fleet,
-            # DEAD replicas included: their trackers are host-side and
-            # outlive shutdown, and dropping them would make the fleet
-            # "lifetime" counters shrink at every failover
-            "slo": fleet_rollup([
-                rep.engine.slo_tracker.snapshot(now=now)
-                for rep in self.replicas.values()]),
+            "slo": fleet_rollup([s for s, _ in snaps],
+                                versions=[v for _, v in snaps]),
             "metrics": self.registry.snapshot(),
         }
+        if self._autoscaler is not None:
+            status["elastic"] = self._autoscaler.status()
         if self._fault_plan is not None:
             status["faults"] = self._fault_plan.snapshot()
         return status
@@ -1023,7 +1206,7 @@ def fleet_router(params, cfg, *, fleet=None, telemetry=None,
                 params, cfg, replica_id=f"r{i}", tracing=tracer,
                 faults=plan, **kw_i))
         router = FleetRouter(engines, fleet=fc, telemetry=telemetry,
-                             faults=plan)
+                             faults=plan, tracer=tracer)
     except Exception:
         for e in engines:
             try:

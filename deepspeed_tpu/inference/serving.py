@@ -526,6 +526,11 @@ class ServingEngine:
             self.tracer = self.tracer.bind(replica=self.replica_id)
         self._trace_on = self.tracer.enabled
 
+        # rolling-update identity: which weight image this engine is
+        # serving (swap_params bumps it; the fleet's per-version SLO
+        # rollup groups replicas by it)
+        self.weights_version: Any = 0
+
         # ---- tiered KV cache (ZeRO-Infinity tiering for the prefix
         # pool): published refcount-0 pages reclaimed under pressure
         # demote to a host pool (spilling onward to NVMe) instead of
@@ -1027,6 +1032,67 @@ class ServingEngine:
         if self._kv_pool is not None and self._kv_pool.disabled is None:
             keys |= set(self._kv_pool.entries)
         return frozenset(keys)
+
+    def swap_params(self, new_params, version=None) -> None:
+        """Rolling-update weight swap: replace the served weight image
+        in place (the jitted programs take params as a plain argument,
+        so no recompile as long as shapes/dtypes match — and they MUST
+        match, because a shape change would silently retrace inside
+        the next request's TTFT).  ``new_params`` must be prepared
+        exactly like the originals (same quantization, same TP
+        sharding — use the family builder's preparation).
+
+        Only a DRAINED engine may swap: the fleet's rollout drains the
+        replica first, so no in-flight request ever mixes layers from
+        two versions.  The engine's generated prefix-cache pages are
+        version-poisoned by a swap (old-version KV under new weights),
+        so the ENTIRE warm pool and spill tier are invalidated here.
+        """
+        if self._closed:
+            raise EngineClosed(
+                "swap_params on a shut-down engine"
+                + (f" (replica {self.replica_id})"
+                   if self.replica_id else ""))
+        if self.has_work:
+            raise RuntimeError(
+                "swap_params needs a drained engine (queue and slots "
+                "empty) — drain the replica first so no in-flight "
+                "request mixes weight versions")
+        old_leaves = jax.tree_util.tree_flatten(self.params)
+        new_leaves = jax.tree_util.tree_flatten(new_params)
+        if old_leaves[1] != new_leaves[1] or any(
+                getattr(a, "shape", None) != getattr(b, "shape", None)
+                or getattr(a, "dtype", None) != getattr(b, "dtype", None)
+                for a, b in zip(old_leaves[0], new_leaves[0])):
+            raise ValueError(
+                "swap_params: new weight tree does not match the "
+                "served one (structure/shape/dtype) — a mismatched "
+                "swap would retrace or mis-serve; rebuild the engine "
+                "for an architecture change")
+        self.params = new_params
+        self._invalidate_warm_pages()
+        if version is not None:
+            self.weights_version = version
+        if self._trace_on:
+            self.tracer.event("weights_swap", attrs={
+                "version": _req_key(self.weights_version)})
+
+    def _invalidate_warm_pages(self) -> None:
+        """Drop every published prefix-cache page (HBM warm pool and
+        spill tier): KV computed under the old weights must never be
+        shared into a new-version request's page table."""
+        if not self._pc_on:
+            return
+        al = self.allocator
+        # a drained engine's published pages are all warm (refcount 0);
+        # reclaim_warm drops them from the pool + content index without
+        # the demote hook — a version swap must not spill poisoned
+        # pages to the tier — and the tier's existing entries discard
+        if al.pool:
+            al.reclaim_warm(list(al.pool), demoted=False)
+        if self._kv_pool is not None:
+            for key in list(self._kv_pool.entries):
+                self._kv_pool.discard(key)
 
     # ----------------------------------------------------------- scheduling
     def _upload_dirty(self) -> None:
@@ -2220,6 +2286,7 @@ class ServingEngine:
             "schema_version": 1,
             "engine": type(self).__name__,
             "replica": self.replica_id,
+            "weights_version": _req_key(self.weights_version),
             "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "uptime_s": round(now - self._t_start, 3),
             "last_step_age_s": (

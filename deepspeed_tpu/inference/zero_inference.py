@@ -149,6 +149,8 @@ class ZeroInferenceServingEngine(ServingEngine):
         self._zi = zi
         self._stem_fn, self._block_fn, self._head_fn = fns
         self._layer_specs = layer_specs
+        self._stem_specs = stem_specs
+        self._head_specs = head_specs
         self._L = n_layers
 
         # ---- per-layer leaf records from the stacked blocks tree.
@@ -463,6 +465,132 @@ class ZeroInferenceServingEngine(ServingEngine):
         self.cache = self.cache._replace(k=tuple(k_list),
                                          v=tuple(v_list))
 
+    # -------------------------------------------- streamed→resident flip
+    # (the elastic fleet's warm cold-start: a new replica spawns in
+    # streamed mode — serving immediately while its weight image lives
+    # on the host/NVMe tier — and the autoscaler promotes layers into
+    # HBM residency between scheduler steps until the engine is fully
+    # resident: the ZeRO-Inference paging made the replica cheap to
+    # add, the flip makes it as fast as a resident one)
+    @property
+    def fully_resident(self) -> bool:
+        """True once every layer's weights are HBM-resident (no tier
+        reads left on the decode path)."""
+        return not self._streamed_ids
+
+    @property
+    def resident_flip_blocked(self) -> bool:
+        """True when ``hbm_budget_bytes`` cannot hold another resident
+        layer: streaming IS this engine's steady state (the normal
+        ZeRO-Inference operating point for a >HBM model) — a cold-start
+        promoter should stop here, not wait for a flip that can never
+        land."""
+        return bool(self._streamed_ids) and not self._promote_budget_ok()
+
+    def _promote_budget_ok(self) -> bool:
+        budget = self._zi.hbm_budget_bytes
+        if budget is None:
+            return True
+        n_res = len(self._resident)
+        still_streaming = len(self._streamed_ids) > 1
+        working = ((self._reader.depth + 1) * self._layer_bytes
+                   if still_streaming else 0)
+        after = (self.plan["stem_head_bytes"] + self.plan["cache_bytes"]
+                 + (n_res + 1) * self._layer_bytes + working)
+        return after <= budget
+
+    def promote_resident_layers(self, n: int = 1) -> int:
+        """Pull up to ``n`` streamed layers' weights into HBM residency
+        (synchronous tier read + upload; call BETWEEN scheduler steps —
+        the host drives the sweep, so nothing is mid-flight then).
+        Stops early when ``hbm_budget_bytes`` cannot hold another
+        resident layer.  Returns the number promoted; the engine is
+        fully resident once :attr:`fully_resident` reports True."""
+        done = 0
+        while self._streamed_ids and done < n:
+            if not self._promote_budget_ok():
+                break
+            l = self._streamed_ids[0]
+            bufs = [self.tier.read_sync(f"zi_p_{l}_{i}", s, d)
+                    for i, (s, d) in enumerate(
+                        zip(self._bshapes, self._bdtypes))]
+            self._resident[l] = self._upload_layer(bufs, l)
+            self._streamed_ids.pop(0)
+            done += 1
+        return done
+
+    # --------------------------------------------------- weight swap
+    def swap_params(self, new_params, version=None) -> None:
+        raise NotImplementedError(
+            "the streamed engine serves a decomposed weight image "
+            "(resident stem/head + tiered blocks) — use swap_weights("
+            "stem, blocks, head, version=) with trees prepared like "
+            "the constructor's (same quantization/sharding)")
+
+    def swap_weights(self, stem, blocks, head, version=None) -> None:
+        """Rolling-update weight swap for the streamed engine: refresh
+        the tier entries of every streamed layer, re-upload the
+        resident layers, re-place stem/head, and invalidate the warm
+        prefix pages (old-version KV must never serve new-version
+        requests).  Same drained-engine contract as
+        :meth:`~deepspeed_tpu.inference.serving.ServingEngine.
+        swap_params`."""
+        from deepspeed_tpu.inference.serving import EngineClosed
+
+        if self._closed:
+            raise EngineClosed(
+                "swap_weights on a shut-down engine"
+                + (f" (replica {self.replica_id})"
+                   if self.replica_id else ""))
+        if self.has_work:
+            raise RuntimeError(
+                "swap_weights needs a drained engine (queue and slots "
+                "empty) — drain the replica first so no in-flight "
+                "request mixes weight versions")
+        leaves, btree = jax.tree_util.tree_flatten(blocks)
+        leaves = [np.asarray(a) for a in leaves]
+        if btree != self._btree or any(
+                a.shape[1:] != s or a.dtype != d
+                for a, s, d in zip(leaves, self._bshapes,
+                                   self._bdtypes)):
+            raise ValueError(
+                "swap_weights: new block tree does not match the "
+                "served one (structure/shape/dtype) — rebuild the "
+                "engine for an architecture change")
+        for what, new, ref in (("stem", stem, self._stem_dev),
+                               ("head", head, self._head_dev)):
+            nl, nt = jax.tree_util.tree_flatten(new)
+            rl, rt = jax.tree_util.tree_flatten(ref)
+            if nt != rt or any(
+                    getattr(a, "shape", None) != getattr(b, "shape",
+                                                         None)
+                    or getattr(a, "dtype", None) != getattr(b, "dtype",
+                                                            None)
+                    for a, b in zip(nl, rl)):
+                raise ValueError(
+                    f"swap_weights: new {what} tree does not match "
+                    "the served one (structure/shape/dtype) — rebuild "
+                    "the engine for an architecture change")
+        for l in self._streamed_ids:
+            for i, a in enumerate(leaves):
+                self.tier.put(f"zi_p_{l}_{i}",
+                              np.ascontiguousarray(a[l]))
+        if isinstance(self.tier, _NvmeTier):
+            self.tier.fence_all()
+        for l in list(self._resident):
+            self._resident[l] = self._upload_layer(
+                [a[l] for a in leaves], l)
+        self._stem_dev = self._place(stem, self._stem_specs)
+        if "embed" in head and head["embed"] is stem["embed"]:
+            head = dict(head, embed=self._stem_dev["embed"])
+        self._head_dev = self._place(head, self._head_specs)
+        self._invalidate_warm_pages()
+        if version is not None:
+            self.weights_version = version
+        if self._trace_on:
+            self.tracer.event("weights_swap", attrs={
+                "version": str(self.weights_version)})
+
     # ------------------------------------------------------- inspection
     def statusz(self) -> Dict[str, Any]:
         """Base snapshot + the weight-streaming view: the residency
@@ -473,6 +601,12 @@ class ZeroInferenceServingEngine(ServingEngine):
         s["zero_inference"] = {
             "tier": self._zi.tier,
             "plan": dict(self.plan),
+            # live residency (promote_resident_layers moves layers out
+            # of the streamed set after the plan was stamped): the
+            # elastic cold-start flip is visible here
+            "n_streamed_now": len(self._streamed_ids),
+            "n_resident_now": len(self._resident),
+            "fully_resident": self.fully_resident,
             "layer_h2d_uploads": int(self._c_h2d.value),
             "layer_sweeps": int(self._c_sweeps.value),
             "bytes_uploaded": int(self._c_bytes.value),

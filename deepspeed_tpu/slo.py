@@ -443,7 +443,7 @@ class SLOTracker:
                 "tiers": tiers}
 
 
-def fleet_rollup(snapshots) -> Dict[str, Any]:
+def fleet_rollup(snapshots, versions=None) -> Dict[str, Any]:
     """Aggregate per-replica :meth:`SLOTracker.snapshot` dicts into one
     fleet view (the multi-replica router's ``/statusz`` ``slo``
     section).  Per tier across replicas: lifetime counters sum, the
@@ -453,7 +453,35 @@ def fleet_rollup(snapshots) -> Dict[str, Any]:
     replica burning its budget", not the average that would let one
     sick replica hide behind two healthy ones), and ``alert_active``
     ORs.  Disabled snapshots pass through; zero-traffic tiers keep the
-    1.0-attainment contract."""
+    1.0-attainment contract.
+
+    ``versions``: a weight-version label per snapshot (aligned with
+    ``snapshots``).  When given and more than one distinct version is
+    present, the result gains ``by_version`` — the SAME rollup
+    computed per version group, keyed by ``str(version)`` — so a
+    rolling update can watch the NEW version's burn rate next to the
+    old one's while both serve side by side."""
+    snapshots = list(snapshots)
+    if versions is not None:
+        versions = list(versions)
+        if len(versions) != len(snapshots):
+            raise ValueError(
+                f"fleet_rollup: {len(versions)} versions for "
+                f"{len(snapshots)} snapshots — they must align")
+        out = _rollup(snapshots)
+        distinct = {str(v) for s, v in zip(snapshots, versions)
+                    if s and s.get("enabled")}
+        if out.get("enabled") and len(distinct) > 1:
+            groups: Dict[str, list] = {}
+            for s, v in zip(snapshots, versions):
+                groups.setdefault(str(v), []).append(s)
+            out["by_version"] = {v: _rollup(g)
+                                 for v, g in sorted(groups.items())}
+        return out
+    return _rollup(snapshots)
+
+
+def _rollup(snapshots) -> Dict[str, Any]:
     snaps = [s for s in snapshots if s and s.get("enabled")]
     if not snaps:
         return {"enabled": False}

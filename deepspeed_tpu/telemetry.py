@@ -520,6 +520,17 @@ class TelemetryExporter:
                 all(registry is not s for s in self._sources):
             self._sources.append(registry)
 
+    def remove_source(self, registry: MetricsRegistry) -> None:
+        """Drop a registry from the exposition (no-op if absent) —
+        the fleet calls this when a replica RETIRES, so a long-lived
+        elastic fleet's ``/metrics`` does not accumulate one dead
+        replica's full metric set per scale cycle.  In-place mutation:
+        the HTTP handler holds the live list."""
+        for i, s in enumerate(self._sources):
+            if s is registry:
+                del self._sources[i]
+                return
+
     def register_provider(self, name: str, fn) -> None:
         """Attach an introspection provider: ``statusz``/``healthz``
         take no args and return a JSON dict (healthz may include

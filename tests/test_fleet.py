@@ -366,6 +366,76 @@ def test_rejoin_dead_needs_engine(gpt2_model):
     router.shutdown()
 
 
+def test_rejoin_rejects_shut_down_engine(gpt2_model):
+    # regression (ISSUE 11 satellite): rejoin used to accept a
+    # shut-down engine object for a DEAD slot and only explode at the
+    # first routed submit — now it raises the typed error at rejoin
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=2)
+    router.kill("r0")
+    stale = serving_engine(params, cfg, prefix_cache=True,
+                           replica_id="r0", **KW)
+    stale.shutdown()
+    with pytest.raises(EngineClosed, match="shut-down engine"):
+        router.rejoin("r0", engine=stale)
+    assert router.replicas["r0"].state == DEAD
+    # a drained (non-dead) rejoin handed a closed engine rejects too
+    router.drain("r1")
+    with pytest.raises(EngineClosed, match="shut-down engine"):
+        router.rejoin("r1", engine=stale)
+    router.rejoin("r1")                   # without an engine: fine
+    assert router.replicas["r1"].state == HEALTHY
+    router.shutdown()
+
+
+def test_drain_handoff_survives_draining_successor(gpt2_model):
+    # regression (ISSUE 11 satellite): draining the replica that holds
+    # an INHERITED digest must pass the whole hint chain to a live
+    # successor — it used to donate only its own warm pool, so the
+    # hint died on the middle replica of a rolling drain; and the
+    # successor pick must never land on a DRAINING replica
+    # (successor_exclude lets a rollout skip its next target)
+    cfg, params = gpt2_model
+    router = make_fleet(params, cfg, n=3, digest_refresh_steps=1000)
+    ps = shared_prefix_prompts(cfg.vocab_size, n=3, seed=9)
+    router.submit("w0", ps[0], max_new_tokens=4)
+    router.run()
+    router.refresh_digests()
+    warm = next(r for r in router.replicas.values() if r.digest)
+    keys = set(warm.engine.warm_keys())
+    assert keys
+    router.drain(warm.id)
+    succ = next(r for r in router.replicas.values()
+                if r.inherited)
+    assert keys <= set(succ.digest)
+    # drain the successor (which holds the hint only as `inherited`,
+    # NOT in its own warm pool): the hint must move to the third
+    # replica, not silently drop
+    router.drain(succ.id)
+    third = next(r for r in router.replicas.values()
+                 if r.state == HEALTHY)
+    assert keys <= set(third.digest), \
+        "inherited digest died on the draining middle replica"
+    router.rejoin(warm.id)
+    router.rejoin(succ.id)
+    # successor_exclude: the handoff skips the excluded id even when
+    # it is the natural ring successor
+    router.refresh_digests()
+    warm2 = next(r for r in router.replicas.values()
+                 if r.engine.warm_keys())
+    ring = list(router.replicas.values())
+    nxt = ring[(ring.index(warm2) + 1) % len(ring)]
+    router.drain(warm2.id, successor_exclude={nxt.id})
+    other = next(r for r in router.replicas.values()
+                 if r.id not in (warm2.id, nxt.id))
+    assert set(warm2.engine.warm_keys()) <= set(other.digest)
+    assert not nxt.inherited
+    router.rejoin(warm2.id)
+    router.run()
+    assert_clean(router)
+    router.shutdown()
+
+
 # ----------------------------------------------------- health machine
 def test_health_state_machine_hysteresis(gpt2_model):
     cfg, params = gpt2_model

@@ -305,6 +305,334 @@ def fleet_main(args) -> int:
     return 0 if ok else 1
 
 
+ELASTIC_FAULT_RULES = [
+    # the FIRST autoscaler spawn attempt: engine-factory failure (the
+    # scale-up aborts, is counted, and retries next evaluation)
+    {"subsystem": "scale", "mode": "error", "count": 1},
+    # the retry: a 30 ms slow cold-start (lands in the
+    # autoscale_cold_start_seconds histogram)
+    {"subsystem": "scale", "mode": "latency", "latency_s": 0.03,
+     "count": 1, "after": 1},
+]
+
+
+def elastic_main(args) -> int:
+    """--elastic: the autoscaler soak (ISSUE 11 acceptance).  A
+    scripted load sine wave drives replica count up (through an
+    injected factory failure + slow cold-start) and back down, a
+    rolling weight update runs with one scripted mid-rollout replica
+    kill, and a second rollout is halted and rolled back by an
+    injected burn-rate trip.  Asserts: every completed request
+    token-identical to the oracle (rollouts swap VALUE-identical
+    weights relabeled v2/v3, so greedy outputs never change), every
+    submitted request reaches a typed terminal result (nothing
+    dropped), zero orphans and leaks on every replica, scale events
+    observed in both directions, and every scale/rollout event in the
+    trace ring exactly once.  Stamps ELASTIC_SOAK.json, gated by
+    tools/bench_gate.py."""
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from deepspeed_tpu.autoscale import FleetAutoscaler
+    from deepspeed_tpu.fleet import DEAD, fleet_router
+    from deepspeed_tpu.inference.serving import (RequestFailed,
+                                                 RequestShed,
+                                                 serving_engine)
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.telemetry import MetricsRegistry
+    from deepspeed_tpu.utils.evidence import atomic_write_json
+
+    t_start = time.perf_counter()
+    cfg = gpt2.GPT2Config.tiny(dim=64, n_layers=2, n_heads=4,
+                               max_seq_len=128)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    # value-identical trees under new version labels: the swap/rollback
+    # machinery runs for real, while greedy outputs stay a pure
+    # function of the prompt — the oracle stays valid across versions
+    v2_params = jax.tree.map(lambda x: x, params)
+    v3_params = jax.tree.map(lambda x: x, params)
+
+    import numpy as np
+
+    rng = np.random.default_rng(17)
+    pref = rng.integers(1, cfg.vocab_size, 16).tolist()
+    mk = lambda: pref + rng.integers(1, cfg.vocab_size, 3).tolist()
+    low = [[rng.integers(1, cfg.vocab_size, 10).tolist(), mk()]
+           for _ in range(3)]
+    crest = [rng.integers(1, cfg.vocab_size, 12).tolist()
+             for _ in range(22)]
+    trickle = [mk() for _ in range(6)]
+    strict_wave = [rng.integers(1, cfg.vocab_size, 8).tolist()
+                   for _ in range(8)]
+
+    all_prompts = [p for w in low for p in w] + crest + trickle \
+        + strict_wave
+    distinct, seen = [], set()
+    for p in all_prompts:
+        t = tuple(p)
+        if t not in seen:
+            seen.add(t)
+            distinct.append(p)
+    kw = dict(max_batch=2, page_size=8, num_pages=12, max_seq=64,
+              prefill_bucket=8)
+    oracle_eng = serving_engine(params, cfg, prefix_cache=True, **kw)
+    for i, p in enumerate(distinct):
+        oracle_eng.submit(f"o{i}", p, max_new_tokens=MAX_NEW)
+    oracle_out = oracle_eng.run()
+    oracle = {tuple(p): oracle_out[f"o{i}"]
+              for i, p in enumerate(distinct)}
+    oracle_eng.shutdown()
+
+    slo = {"tiers": {
+        "lax": {"ttft_s": 60.0, "deadline_s": 300.0, "target": 0.5},
+        # impossible objective: any finished strict request violates,
+        # so burn = 1/(1-0.5) = 2.0 — the injected burn-rate trip.
+        # Short window: after the rollback the violations must age
+        # out fast enough for the final trough to read as calm (a
+        # burn still in-window is up-pressure, by design)
+        "strict": {"ttft_s": 1e-6, "target": 0.5}},
+        "default_tier": "lax", "window_s": 8.0,
+        "burn_windows_s": [8.0]}
+    ekw = dict(prefix_cache=True, slo=slo, shed_queue_depth=6, **kw)
+    router = fleet_router(
+        params, cfg,
+        fleet={"replicas": 2, "retry_budget": 2,
+               "shed_queue_depth": 16,
+               # scaling, not quarantine, is the elastic response to
+               # crest-of-wave shed activity
+               "quarantine_after": 10_000,
+               "digest_refresh_steps": 2},
+        tracing={"ring_capacity": 131072},
+        faults={"seed": args.seed, "rules": ELASTIC_FAULT_RULES},
+        **ekw)
+
+    def factory(rid, streamed=False):
+        return serving_engine(
+            params, cfg, replica_id=rid, tracing=router.tracer,
+            telemetry=MetricsRegistry(namespace=f"dstpu_{rid}"),
+            **ekw)
+
+    auto = FleetAutoscaler(router, factory, autoscale={
+        # floor 2: the trough must not shrink the fleet below the
+        # rollout script's needs (a real fleet would pick its floor
+        # for the same reason — rolling updates need a survivor)
+        "min_replicas": 2, "max_replicas": 3,
+        "eval_interval_steps": 2, "scale_up_queue_depth": 3.0,
+        "scale_down_queue_depth": 0.5, "up_after": 1, "down_after": 6,
+        # the tiny CPU model drains a burst in tens of milliseconds —
+        # any wall-clock cooldown would outlive the pressure window,
+        # so the soak runs uncooled and leans on the streak hysteresis
+        "cooldown_s": 0.0, "rollout_soak_steps": 25,
+        "rollback_burn_threshold": 1.0, "rollback_min_finished": 1})
+
+    prompts_by_id = {}
+    rid_n = 0
+
+    def submit(p, tier=None):
+        nonlocal rid_n
+        req_id = f"r{rid_n:03d}"
+        rid_n += 1
+        prompts_by_id[req_id] = p
+        router.submit(req_id, p, max_new_tokens=MAX_NEW, tier=tier)
+        return req_id
+
+    hang = False
+
+    def drive(until=None):
+        """Step until idle (and `until` satisfied, when given)."""
+        nonlocal hang
+        steps = 0
+        while router.has_work or auto.rollout_active \
+                or auto._retiring or (until is not None and
+                                      not until()):
+            auto.step()
+            steps += 1
+            if steps > STEP_CAP or \
+                    time.perf_counter() - t_start > WALL_CAP_S:
+                hang = True
+                return
+
+    def idle_until_live(n, timeout_s=20.0):
+        """Tick the idle fleet until the live replica count reaches
+        ``n`` (scale-down retires the surplus; heal spawns cover a
+        deficit) — the trough half of the sine wave."""
+        nonlocal hang
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < timeout_s:
+            auto.step()
+            live_n = sum(1 for rep in router.replicas.values()
+                         if rep.state != DEAD)
+            if live_n == n and not auto._retiring \
+                    and not router.has_work:
+                return
+            time.sleep(0.002)
+        hang = True
+
+    # ---- phase A: trough traffic (2 replicas idle along)
+    for wave in low:
+        for p in wave:
+            submit(p)
+        drive()
+    # ---- phase B: crest — a burst the 2-replica fleet cannot absorb
+    # scales up THROUGH the injected factory failure (first attempt)
+    # and the slow cold-start (the retry lands at the next pressured
+    # evaluation while the queue is still deep)
+    for p in crest:
+        submit(p)
+    drive()
+    scale_up_seen = auto.status()["scale_ups"]
+    # ---- phase B2: trough — sustained idle retires the crest's
+    # extra replica back down to the floor
+    idle_until_live(2)
+    scale_down_seen = auto.status()["scale_downs"]
+    # ---- phase C: rolling update to v2 with one scripted mid-rollout
+    # replica kill (the next not-yet-updated target dies right after
+    # the first replica updates; the walk continues on survivors)
+    auto.rollout(v2_params, version="v2")
+    killed = None
+    ti = 0
+    steps = 0
+    while auto.rollout_active or router.has_work:
+        if ti < len(trickle):
+            submit(trickle[ti])
+            ti += 1
+        auto.step()
+        ro = auto._rollout
+        if killed is None and ro is not None and ro["updated"]:
+            nxt = next(
+                (r for r in ro["plan"][ro["i"]:]
+                 if r in router.replicas
+                 and router.replicas[r].state != DEAD
+                 and r not in ro["updated"]), None)
+            if nxt is not None:
+                router.kill(nxt, error="scripted mid-rollout death")
+                killed = nxt
+        steps += 1
+        if steps > STEP_CAP or \
+                time.perf_counter() - t_start > WALL_CAP_S:
+            hang = True
+            break
+    rollout1 = dict(auto.last_rollout or {})
+    # ---- phase C2: the kill left the fleet under its floor — the
+    # next evaluations heal it back up, and the fresh replica swaps
+    # onto v2 (the completed rollout's version) before it serves
+    idle_until_live(2)
+    # ---- phase D: rollout to v3 halted by the strict tier's burn
+    # trip and rolled back — versions must return to v2
+    auto.rollout(v3_params, version="v3")
+    si = 0
+    steps = 0
+    while auto.rollout_active or router.has_work:
+        if si < len(strict_wave):
+            submit(strict_wave[si], tier="strict")
+            si += 1
+        auto.step()
+        steps += 1
+        if steps > STEP_CAP or \
+                time.perf_counter() - t_start > WALL_CAP_S:
+            hang = True
+            break
+    rollout2 = dict(auto.last_rollout or {})
+    # ---- phase E: final trough — the fleet settles at its floor
+    idle_until_live(auto.cfg.min_replicas)
+
+    # ---- reconcile
+    finished = dict(router.finished)
+    completed = {k: v for k, v in finished.items()
+                 if isinstance(v, list)}
+    failed = {k: v for k, v in finished.items()
+              if isinstance(v, RequestFailed)}
+    shed = {k: v for k, v in finished.items()
+            if isinstance(v, RequestShed)}
+    mismatched = [k for k, v in completed.items()
+                  if v != oracle[tuple(prompts_by_id[k])]]
+    leaks = router.check_leaks()
+    orphaned = router.orphaned()
+    cnt = router.registry.snapshot()["counters"]
+    st = auto.status()
+    live_versions = {rep.id: str(rep.version)
+                     for rep in router.replicas.values()
+                     if rep.state != DEAD}
+    ring = router.tracer.recorder.events()
+    from collections import Counter
+    ring_kinds = Counter(e[3] for e in ring
+                         if e[3].startswith(("autoscale_",
+                                             "rollout_")))
+    led_kinds = Counter(e["kind"] for e in auto.events)
+    checks = {
+        "typed_results_partition":
+            len(finished) == rid_n and
+            len(completed) + len(failed) + len(shed) == rid_n,
+        "router_counts":
+            router._n_completed == len(completed) and
+            router._n_failed == len(failed) and
+            router._n_shed == len(shed),
+        "registry_counters":
+            int(cnt.get("fleet_completed_requests", 0)) ==
+            len(completed) and
+            int(cnt.get("fleet_failed_requests", 0)) == len(failed)
+            and int(cnt.get("fleet_shed_requests", 0)) == len(shed),
+        "scaled_up": st["scale_ups"] >= 2 and scale_up_seen >= 1,
+        "scaled_down": st["scale_downs"] >= 1
+            and scale_down_seen >= 1,
+        "factory_failure_retried":
+            st["factory_failures"] == 1 and st["scale_ups"] >= 1,
+        "rollout_completed_with_kill":
+            rollout1.get("completed", False) and killed is not None
+            and rollout1.get("skipped") == [killed],
+        "rollback_on_burn_trip":
+            rollout2.get("halted", False)
+            and rollout2.get("rolled_back", False),
+        "versions_on_v2":
+            bool(live_versions)
+            and all(v == "v2" for v in live_versions.values()),
+        "events_exactly_once":
+            bool(led_kinds) and dict(ring_kinds) == dict(led_kinds),
+    }
+    plan_snap = router._fault_plan.snapshot()
+    router.shutdown()
+    ok = (not mismatched and not hang and not leaks and not orphaned
+          and all(checks.values()) and plan_snap["injected"] >= 2)
+    stamp = {
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(),
+        "model": "gpt2-tiny",
+        "seed": args.seed,
+        "ok": ok,
+        "submitted": rid_n,
+        "completed": len(completed),
+        "failed": len(failed),
+        "shed": len(shed),
+        "shed_by_reason": dict(router._shed_by_reason),
+        "mismatched_requests": len(mismatched),
+        "mismatched_ids": mismatched[:8],
+        "hang": int(hang),
+        "leak_count": len(leaks),
+        "leaks": leaks[:8],
+        "orphaned_requests": len(orphaned),
+        "accounting_ok": int(all(checks.values())),
+        "accounting": checks,
+        "scale_ups": st["scale_ups"],
+        "scale_downs": st["scale_downs"],
+        "factory_failures": st["factory_failures"],
+        "killed_mid_rollout": killed,
+        "rollout_v2": rollout1,
+        "rollout_v3": rollout2,
+        "live_versions": live_versions,
+        "event_counts": dict(led_kinds),
+        "injected": plan_snap,
+        "duration_s": round(time.perf_counter() - t_start, 2),
+    }
+    atomic_write_json(stamp, args.json_out)
+    print(json.dumps({k: v for k, v in stamp.items()
+                      if k not in ("injected",)},
+                     indent=1, sort_keys=True))
+    print("→", args.json_out)
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true",
@@ -315,11 +643,21 @@ def main():
                     help="run the 3-replica fleet soak (replica kill + "
                          "drain/rejoin) instead of the single-engine "
                          "soak; stamps FLEET_SOAK.json by default")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the autoscaler soak (load sine wave, "
+                         "scale up/down through injected scale "
+                         "faults, rolling update with a mid-rollout "
+                         "kill, burn-trip rollback); stamps "
+                         "ELASTIC_SOAK.json by default")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.json_out is None:
         args.json_out = os.path.join(
-            REPO, "FLEET_SOAK.json" if args.fleet else "CHAOS_SOAK.json")
+            REPO, "ELASTIC_SOAK.json" if args.elastic
+            else "FLEET_SOAK.json" if args.fleet
+            else "CHAOS_SOAK.json")
+    if args.elastic:
+        return elastic_main(args)
     if args.fleet:
         return fleet_main(args)
 

@@ -67,16 +67,35 @@ def render_fleet(status: dict, health: dict | None = None) -> list:
              f"  queue {fl.get('queue_depth', 0)}"
              f"  in-flight {fl.get('in_flight', 0)}"
              f"  orphaned {fl.get('orphaned', 0)}")
+    el = status.get("elastic", {})
+    if el.get("enabled"):
+        ro = el.get("rollout") or {}
+        line = (f"elast target {el.get('target_replicas', '?')} "
+                f"[{el.get('min_replicas', '?')}"
+                f"..{el.get('max_replicas', '?')}]"
+                f"  up {el.get('scale_ups', 0)}"
+                f"  down {el.get('scale_downs', 0)}"
+                f"  cold-starts {el.get('cold_starts_in_flight', 0)}")
+        if el.get("cooldown_remaining_s"):
+            line += f"  cooldown {el['cooldown_remaining_s']:.1f}s"
+        if ro.get("active"):
+            line += (f"  ROLLOUT {ro.get('version')} "
+                     f"{ro.get('updated', 0)}/{ro.get('total', 0)} "
+                     f"({ro.get('state', '?')})")
+        elif ro.get("rolled_back"):
+            line += f"  ROLLED-BACK {ro.get('version')}"
+        L.append(line)
     L.append("-" * 78)
-    L.append(f"{'replica':<9}{'state':<13}{'queue':>6}{'slots':>6}"
-             f"{'shed%':>7}{'failed':>7}{'aff':>5}{'digest':>7}"
-             f"  reasons")
+    L.append(f"{'replica':<9}{'state':<13}{'ver':<6}{'queue':>6}"
+             f"{'slots':>6}{'shed%':>7}{'failed':>7}{'aff':>5}"
+             f"{'digest':>7}  reasons")
     for r in fl.get("replicas", []):
         reasons = ",".join(r.get("reasons", []))[:24]
         if r.get("stalled_for_s"):
             reasons = (reasons + f" stall {r['stalled_for_s']:.1f}s"
                        ).strip()
         L.append(f"{r['replica']:<9}{r['state']:<13}"
+                 f"{str(r.get('version', '-'))[:5]:<6}"
                  f"{r.get('queue_depth', 0):>6}"
                  f"{r.get('active_slots', 0):>6}"
                  f"{100 * r.get('shed_rate', 0.0):>6.1f}%"

@@ -100,6 +100,22 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py \
 timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_fleet.py --cpu \
   --json-out "$REPO/FLEET_BENCH.json" >/dev/null 2>&1 || true
 
+# elastic soak: the autoscaler under a scripted load wave — scale up
+# through an injected factory failure + slow cold-start, scale back
+# down, a rolling weight update with a mid-rollout replica kill, and
+# a burn-rate-tripped rollback — token identity, zero orphans/leaks,
+# exactly-once scale/rollout events.  Stamps ELASTIC_SOAK.json, gated
+# by bench_gate.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py \
+  --cpu --elastic --json-out "$REPO/ELASTIC_SOAK.json" >/dev/null 2>&1 || true
+
+# elastic bench: sine-wave arrivals vs the autoscaler plus a live
+# weight swap mid-wave — goodput, p99 TTFT, replica-count breathing,
+# scale-up-to-first-token, and the zero-drop/orphan/leak gate rows.
+# Stamps ELASTIC_BENCH.json, gated by bench_gate.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_fleet.py --cpu \
+  --elastic --json-out "$REPO/ELASTIC_BENCH.json" >/dev/null 2>&1 || true
+
 # bench regression gate: AFTER the stamps above, diff the evidence
 # files against the committed BENCH_BASELINE.json and leave a verdict
 # in BENCH_GATE.json — the perf trajectory as an enforced contract.
