@@ -22,6 +22,21 @@ must shed it.  Two stamps:
   the time until every request salvaged off the dead replica reached a
   terminal result.
 
+``--disagg`` (ISSUE 12): stamps ``DISAGG_BENCH.json`` — two A/Bs for
+the KV fabric.  (a) **affinity-miss TTFT, migration on/off**: one
+replica warms a long shared prefix and DRAINS (its digest hints hand
+to the survivor, its pages stay exportable); every following
+same-prefix request is an affinity miss on the cold survivor.  With
+the fabric, the router migrates the serialized chain and the miss
+serves by promotion; without, it re-prefills — the p50 TTFT ratio is
+the headline (gated ≥ 1), with ``mismatched_requests`` = 0 against a
+single-engine oracle.  (b) **goodput, prefill-heavy vs decode-heavy
+mixes, with/without the role split**: open-loop Poisson traffic
+against a classic 3-replica fleet vs the same ring split
+``{"prefill": 1, "decode": 2}`` with fabric handoff — when disagg
+wins (prefill-heavy mixes, where long prompts stall decode batches)
+and when it does not is the README's capacity story.
+
 ``--elastic`` (ISSUE 11): a third stamp, ``ELASTIC_BENCH.json`` — a
 scripted load **sine wave** drives a :class:`~deepspeed_tpu.autoscale.
 FleetAutoscaler` up and down between its bounds while a **live rolling
@@ -318,6 +333,201 @@ def elastic_main(args) -> int:
     return 0 if ok else 1
 
 
+def disagg_main(args) -> int:
+    """--disagg: the KV-fabric A/Bs; stamps DISAGG_BENCH.json."""
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from deepspeed_tpu.fleet import fleet_router
+    from deepspeed_tpu.inference.serving import serving_engine
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.utils.evidence import atomic_write_json
+
+    t_start = time.perf_counter()
+    cfg = gpt2.GPT2Config.tiny(dim=128, n_layers=2, n_heads=4,
+                               max_seq_len=256)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(args.seed)
+    kw = dict(max_batch=args.slots, page_size=8, num_pages=48,
+              max_seq=128, prefill_bucket=8, prefix_cache=True,
+              kv_tier={"host_pool_bytes": 256 << 20})
+
+    # ---------------- (a) affinity-miss TTFT, migration on/off
+    # distinct prefixes → every timed request is a TRUE miss on the
+    # survivor (same-prefix repeats would warm it after the first)
+    prefixes = [rng.integers(1, cfg.vocab_size, 88).tolist()
+                for _ in range(args.miss_requests)]
+    miss_prompts = [pref + rng.integers(1, cfg.vocab_size, 3).tolist()
+                    for pref in prefixes]
+    oracle_eng = serving_engine(params, cfg, **kw)
+    for i, p in enumerate(miss_prompts):
+        oracle_eng.submit(f"o{i}", p, max_new_tokens=MAX_NEW)
+    oracle = oracle_eng.run()
+    oracle_eng.shutdown()
+
+    def miss_arm(with_fabric: bool):
+        router = fleet_router(
+            params, cfg,
+            fleet={"replicas": 2, "affinity": True,
+                   "digest_refresh_steps": 1},
+            fabric=True if with_fabric else None,
+            tracing={"ring_capacity": 65536}, seed=args.seed,
+            # split-fuse: the production prefill discipline (one long
+            # admission must not stall in-flight decodes) — and the
+            # regime the migration targets: a miss re-prefill costs
+            # prefix/chunk sequential forwards, a migrated admission
+            # one batched promotion + the tail chunk
+            prefill_chunk=8, **kw)
+        # warm r0 with every prefix, then drain it: each following
+        # prefixed request is an affinity miss on r1
+        for i, pref in enumerate(prefixes):
+            router.submit(f"warm{i}", pref, max_new_tokens=MAX_NEW)
+            router.run()
+        router.refresh_digests()
+        warm = next(r for r in router.replicas.values() if r.digest)
+        router.drain(warm.id)
+        # TTFT measured from ROUTER submit on the ring's own clock
+        # (monotonic_ns): the migration's export+fetch cost lands
+        # INSIDE the on-arm TTFT, same as the off arm's re-prefill —
+        # the engine-side queued event would start the clock after the
+        # migration already ran
+        sub_ns = {}
+        for i, p in enumerate(miss_prompts):
+            sub_ns[f"m{i}"] = time.monotonic_ns()
+            router.submit(f"m{i}", p, max_new_tokens=MAX_NEW)
+            router.run()
+        out = dict(router.finished)
+        mism = [i for i in range(len(miss_prompts))
+                if out.get(f"m{i}") != oracle[f"o{i}"]]
+        ring = router.tracer.recorder.events()
+        first = {}
+        for t_ns, req, _s, phase, _a in ring:
+            if phase == "first_token" and req not in first:
+                first[req] = t_ns
+        ttfts = sorted(
+            (first[r] - sub_ns[r]) / 1e9
+            for r in sub_ns if r in first)
+        fab = (router.statusz()["fleet"].get("fabric") or {})
+        leaks = len(router.check_leaks())
+        orphans = len(router.orphaned())
+        router.shutdown()
+        p50 = ttfts[len(ttfts) // 2] if ttfts else None
+        return {"n_miss": len(ttfts),
+                "ttft_p50_s": round(p50, 5) if p50 else None,
+                "ttft_mean_s": round(sum(ttfts) / len(ttfts), 5)
+                if ttfts else None,
+                "mismatched": len(mism), "leaks": leaks,
+                "orphans": orphans,
+                "migrations": fab.get("migrations", 0),
+                "migration_pages": fab.get("migration_pages", 0),
+                "bytes_moved": fab.get("bytes_moved", 0)}
+
+    # on-arm FIRST (its compile warms shared jit caches; the off arm
+    # then starts warm — bias, if any, is AGAINST the migration win)
+    arm_on = miss_arm(True)
+    arm_off = miss_arm(False)
+    migration = {
+        "prefix_tokens": len(prefixes[0]),
+        "requests": len(miss_prompts),
+        "off": arm_off,
+        "on": arm_on,
+        "ttft_speedup": round(
+            arm_off["ttft_p50_s"] / arm_on["ttft_p50_s"], 3)
+        if arm_off["ttft_p50_s"] and arm_on["ttft_p50_s"] else None,
+        "mismatched_requests": arm_off["mismatched"]
+        + arm_on["mismatched"],
+        "leak_count": arm_off["leaks"] + arm_on["leaks"],
+    }
+    print(json.dumps({"migration": migration}), flush=True)
+
+    # ---------------- (b) goodput: mixes x role split
+    slo = {"tiers": {"interactive": {
+        "ttft_s": args.slo_ttft_s, "deadline_s": args.slo_deadline_s}},
+        "default_tier": "interactive"}
+    mixes = {
+        # long prompts, short answers: prompt work dominates — the
+        # regime where a prefill pool keeps decode batches dense
+        "prefill_heavy": (48, 4),
+        # short prompts, long answers: decode dominates — role split
+        # overhead (handoff) with little to amortize it
+        "decode_heavy": (8, 24),
+    }
+
+    def mix_arm(mix, roles: bool):
+        plen, mnew = mixes[mix]
+        prefs = [rng.integers(1, cfg.vocab_size, plen).tolist()
+                 for _ in range(4)]
+        prompts = [prefs[i % 4][:-3]
+                   + rng.integers(1, cfg.vocab_size, 3).tolist()
+                   for i in range(256)]
+        fleet = {"replicas": 3, "digest_refresh_steps": 2,
+                 "shed_queue_depth": args.fleet_shed}
+        if roles:
+            fleet["roles"] = {"prefill": 1, "decode": 2}
+        router = fleet_router(
+            params, cfg, fleet=fleet,
+            fabric=True if roles else None,
+            slo=slo, shed_queue_depth=args.replica_shed,
+            seed=args.seed, **kw)
+        router.submit("warm", prompts[0], max_new_tokens=mnew)
+        router.run()
+        router.drain_finished()
+        arrivals = poisson_arrivals(args.rate, args.duration,
+                                    args.seed + 11)
+        t0 = time.perf_counter()
+        next_i = 0
+        while True:
+            now = time.perf_counter() - t0
+            while next_i < len(arrivals) and arrivals[next_i] <= now:
+                router.submit(f"g{next_i:04d}",
+                              prompts[next_i % len(prompts)],
+                              max_new_tokens=mnew)
+                next_i += 1
+            router.step()
+            if next_i >= len(arrivals) and not router.has_work:
+                break
+            if now > WALL_CAP_S:
+                break
+        drove = {"submitted": next_i,
+                 "elapsed_s": time.perf_counter() - t0}
+        row = summarize(router, drove, args.rate)
+        st = router.statusz()["fleet"]
+        row["handoffs"] = (st.get("fabric") or {}).get("handoffs", 0)
+        row["leaks"] = len(router.check_leaks())
+        row["orphans"] = len(router.orphaned())
+        router.shutdown()
+        return row
+
+    role_split = {}
+    for mix in mixes:
+        role_split[mix] = {"off": mix_arm(mix, False),
+                           "on": mix_arm(mix, True)}
+        print(json.dumps({mix: role_split[mix]}), flush=True)
+
+    out = {
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(),
+        "model": "gpt2-tiny-d128",
+        "seed": args.seed,
+        "migration": migration,
+        "role_split": role_split,
+        "duration_s": round(time.perf_counter() - t_start, 2),
+    }
+    atomic_write_json(out, args.json_out)
+    print("→", args.json_out)
+    ok = (migration["mismatched_requests"] == 0
+          and migration["leak_count"] == 0
+          and (migration["ttft_speedup"] or 0) >= 1.0
+          and arm_on["migrations"] >= 1
+          and all(r[a]["leaks"] == 0 and r[a]["orphans"] == 0
+                  for r in role_split.values() for a in ("off", "on")))
+    return 0 if ok else 1
+
+
 def drive_open_loop(router, arrivals, make_prompt, *, kill=None,
                     bucket_s: float = 0.5):
     """Submit arrivals on their schedule while stepping the fleet;
@@ -427,6 +637,17 @@ def main():
                     help="run the autoscaler sine-wave + live weight "
                          "swap bench instead of the load/failover "
                          "curves; stamps ELASTIC_BENCH.json by default")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the KV-fabric A/Bs (affinity-miss TTFT "
+                         "with migration on/off; goodput under "
+                         "prefill- vs decode-heavy mixes with/without "
+                         "the role split); stamps DISAGG_BENCH.json "
+                         "by default")
+    ap.add_argument("--miss-requests", type=int, default=8,
+                    help="--disagg: affinity-miss requests per arm")
+    ap.add_argument("--rate", type=float, default=6.0,
+                    help="--disagg: arrival rate for the mix arms "
+                         "(req/s)")
     ap.add_argument("--wave-lo", type=float, default=1.0,
                     help="--elastic: sine-wave trough arrival rate "
                          "(req/s)")
@@ -438,9 +659,12 @@ def main():
     if args.json_out is None:
         args.json_out = os.path.join(
             REPO, "ELASTIC_BENCH.json" if args.elastic
+            else "DISAGG_BENCH.json" if args.disagg
             else "FLEET_BENCH.json")
     if args.elastic:
         return elastic_main(args)
+    if args.disagg:
+        return disagg_main(args)
 
     import jax
 

@@ -276,6 +276,13 @@ class FleetAutoscaler:
             "max_burn": round(burn, 3),
             "effective_replicas": effective,
         }
+        if getattr(router, "_roles_on", False):
+            # disaggregated fleets scale per role: the pressured pool
+            # gets the next spawn, and the per-role depths ride the
+            # elastic statusz block
+            self._last_signals["role_queue_depth"] = {
+                ro: (round(v, 3) if v != float("inf") else "inf")
+                for ro, v in router.role_pressure().items()}
         if not heal and self._last_scale_t is not None and \
                 now - self._last_scale_t < self.cfg.cooldown_s:
             return          # cooling down: streaks keep accumulating
@@ -341,7 +348,13 @@ class FleetAutoscaler:
                     except Exception:
                         pass
                     return
-        self.router.spawn(eng, rid)
+        role = None
+        if getattr(self.router, "_roles_on", False):
+            # spawn into the pressured pool (a role with no routable
+            # member reads as infinite pressure and heals first)
+            pressure = self.router.role_pressure()
+            role = max(sorted(pressure), key=lambda ro: pressure[ro])
+        self.router.spawn(eng, rid, role=role)
         if self._rollout is not None:
             # a replica added mid-rollout (heal after a rollout
             # casualty, or genuine pressure) comes up on the factory's
@@ -435,7 +448,11 @@ class FleetAutoscaler:
         # the heal-only evaluation then)
         cands = [rep for rep in self.router.replicas.values()
                  if rep.state in _VICTIM_RANK
-                 and not self._pending_flip(rep.id)]
+                 and not self._pending_flip(rep.id)
+                 # never scale a configured role's LAST replica away:
+                 # routing would degrade to the other pool, silently
+                 # un-disaggregating the fleet at every load trough
+                 and not self.router.last_of_role(rep)]
         if not cands:
             return
         victim = min(cands, key=lambda rep: (_VICTIM_RANK[rep.state],

@@ -599,6 +599,18 @@ class FleetConfig:
     refreshes (the affinity lookup's staleness bound).
     ``fatal_stall_s``: a replica stalled longer than this is treated as
     dead (failover) rather than waited out.
+
+    ``roles``: disaggregated prefill/decode serving — a dict
+    ``{"prefill": n, "decode": m}`` (n + m == replicas) splits the ring
+    into a prefill-specialized pool and a decode-specialized pool.  New
+    requests route to a prefill replica, run to first-token-ready
+    state, publish their KV chain to the attached
+    :class:`~deepspeed_tpu.kv_fabric.KVFabric`, and a decode replica
+    picks the request up as a migrated admission (the handoff charges
+    no retry budget — it is scheduled movement).  Role preference
+    degrades gracefully: when a role's pool has no routable replica,
+    requests fall back to the other pool (every replica runs the full
+    engine).  None = classic symmetric fleet.
     """
 
     replicas: int = 2
@@ -609,6 +621,7 @@ class FleetConfig:
     shed_queue_depth: int = 0
     digest_refresh_steps: int = 8
     fatal_stall_s: float = 5.0
+    roles: Optional[Dict[str, int]] = None
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "FleetConfig":
@@ -619,6 +632,34 @@ class FleetConfig:
             raise ValueError(
                 f"fleet.replicas must be >= 1, got {f.replicas}")
         f.affinity = bool(f.affinity)
+        if f.roles is not None:
+            if not isinstance(f.roles, dict):
+                raise ValueError(
+                    f"fleet.roles must be a dict like "
+                    f'{{"prefill": 1, "decode": 2}}, got '
+                    f"{type(f.roles).__name__}")
+            bad = set(f.roles) - {"prefill", "decode"}
+            if bad:
+                raise ValueError(
+                    f"fleet.roles keys must be 'prefill'/'decode', got "
+                    f"{sorted(bad)}")
+            f.roles = {k: int(v) for k, v in f.roles.items()}
+            if any(v < 1 for v in f.roles.values()):
+                raise ValueError(
+                    f"fleet.roles counts must be >= 1, got {f.roles} — "
+                    "a role with zero replicas is the same as not "
+                    "declaring it")
+            if len(f.roles) != 2:
+                raise ValueError(
+                    f"fleet.roles needs BOTH a prefill and a decode "
+                    f"pool, got {sorted(f.roles)} — one pool is just a "
+                    "classic fleet")
+            if sum(f.roles.values()) != f.replicas:
+                raise ValueError(
+                    f"fleet.roles counts {f.roles} sum to "
+                    f"{sum(f.roles.values())} but fleet.replicas is "
+                    f"{f.replicas} — every replica needs exactly one "
+                    "role")
         f.retry_budget = int(f.retry_budget)
         if f.retry_budget < 0:
             raise ValueError(
@@ -652,6 +693,76 @@ class FleetConfig:
             return cls.from_dict(obj)
         raise TypeError(
             f"fleet must be an int, dict or FleetConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
+class FabricConfig:
+    """Cross-replica KV fabric block (consumed by
+    :class:`~deepspeed_tpu.kv_fabric.KVFabric` and the
+    :class:`~deepspeed_tpu.fleet.FleetRouter` migration/handoff paths;
+    ref: ZeRO-Infinity's checksummed host/NVMe transport,
+    arXiv:2104.07857, re-targeted at serialized KV pages).
+
+    The fabric is a shared, content-addressed exchange of serialized KV
+    pages (same chained blake2b keys as the prefix cache, same
+    per-buffer crc32 discipline as the spill tier — int8-quantized cold
+    pages ride as-is).  On an affinity miss where another replica's
+    digest covers the prompt, the router asks the owner to export the
+    matching page chain into the fabric and the target admits it
+    through the existing ``begin_promotion``/``TierPageReader`` path
+    instead of re-prefilling; a checksum failure or a migration past
+    ``migrate_timeout_s`` falls back to re-prefill exactly like a
+    failed tier promotion.  Replicas participating in the fabric need
+    the ``kv_tier`` block — the local spill pool is the admission side
+    of the transport.
+
+    ``capacity_bytes`` caps the exchange (oldest entries evict);
+    ``min_pages`` is the smallest chain worth migrating (below it the
+    re-prefill is cheaper than the bookkeeping).
+    """
+
+    enabled: bool = False
+    capacity_bytes: int = 1 << 30
+    migrate_timeout_s: float = 5.0
+    min_pages: int = 1
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FabricConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        f = cls(**{k: v for k, v in d.items() if k in known})
+        f.capacity_bytes = int(f.capacity_bytes)
+        if f.capacity_bytes < 1:
+            raise ValueError(
+                f"fabric.capacity_bytes must be >= 1, got "
+                f"{f.capacity_bytes}")
+        f.migrate_timeout_s = float(f.migrate_timeout_s)
+        if f.migrate_timeout_s <= 0:
+            raise ValueError(
+                f"fabric.migrate_timeout_s must be positive, got "
+                f"{f.migrate_timeout_s}")
+        f.min_pages = int(f.min_pages)
+        if f.min_pages < 1:
+            raise ValueError(
+                f"fabric.min_pages must be >= 1, got {f.min_pages}")
+        return f
+
+    @classmethod
+    def coerce(cls, obj) -> "FabricConfig":
+        """Accept None (disabled), a bool, a dict (writing the block is
+        the opt-in, like ``kv_tier``), or a FabricConfig."""
+        if obj is None:
+            return cls(enabled=False)
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, bool):
+            return cls(enabled=obj)
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("enabled", True)   # passing a block opts in
+            return cls.from_dict(d)
+        raise TypeError(
+            f"fabric must be a bool, dict or FabricConfig, got "
             f"{type(obj).__name__}")
 
 
@@ -1038,6 +1149,8 @@ class Config:
     faults: FaultsConfig = dataclasses.field(
         default_factory=FaultsConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    fabric: FabricConfig = dataclasses.field(
+        default_factory=FabricConfig)
     autoscale: AutoscaleConfig = dataclasses.field(
         default_factory=AutoscaleConfig)
     telemetry: TelemetryConfig = dataclasses.field(
@@ -1173,6 +1286,9 @@ class Config:
             c.faults = FaultsConfig.coerce(d["faults"])
         if "fleet" in d:
             c.fleet = FleetConfig.coerce(d["fleet"])
+        if "fabric" in d:
+            # coerce, not from_dict: writing the block IS the opt-in
+            c.fabric = FabricConfig.coerce(d["fabric"])
         if "autoscale" in d:
             # coerce, not from_dict: writing the block IS the opt-in
             # (same contract as faults / slo above); an explicit

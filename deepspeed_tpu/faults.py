@@ -53,6 +53,20 @@ module provides both halves of proving that:
               quarantine/hysteresis exercise without breaking
               anything.  Combined with ``after=`` this is also how the
               elastic soak kills a replica mid-rollout.
+  fabric      the :class:`~deepspeed_tpu.kv_fabric.KVFabric` hook
+              points.  Opportunities carry prefixed keys so one rule
+              targets one leg via ``match``: ``export:<keyhex>``
+              (publish into the fabric — an error rule fails the
+              export and the migration falls back to re-prefill),
+              ``fetch:<keyhex>`` (admit out of the fabric — a latency
+              rule delays the fetch, pushing the migration toward its
+              ``migrate_timeout_s``; an error rule fails it), and
+              ``corrupt:<keyhex>`` (an error rule flips a payload byte
+              AFTER the per-buffer crc32 was recorded, so the
+              admitting replica's promotion-time checksum verify must
+              catch it and re-prefill).  A rule without ``match``
+              fires on every leg — write ``match="export"`` etc. to
+              scope it.
   scale       the :class:`~deepspeed_tpu.autoscale.FleetAutoscaler`'s
               scale-up path (one opportunity per spawn attempt; key =
               the new replica id, so ``match=`` targets one).  Mode
@@ -113,13 +127,13 @@ class FatalStreamError(RuntimeError):
 
 
 SUBSYSTEMS = ("aio_read", "aio_write", "kv_corrupt", "slot",
-              "sync_read", "burst", "replica", "scale")
+              "sync_read", "burst", "replica", "scale", "fabric")
 MODES = ("error", "latency", "degrade")
 # subsystems whose opportunities carry a key a `match` filter can test
 # (aio ops and bursts are anonymous — a match there would validate
 # fine and silently never fire, so it is rejected at rule build)
 _KEYED_SUBSYSTEMS = ("kv_corrupt", "slot", "sync_read", "replica",
-                     "scale")
+                     "scale", "fabric")
 
 
 @dataclasses.dataclass

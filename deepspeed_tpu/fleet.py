@@ -44,11 +44,31 @@ the FLEET robust where PR 9 made the engine robust:
   replica (or a fresh replacement engine for a dead slot) back into
   rotation and restores its affinity from its actual warm pool.
 
+KV fabric (``fabric=`` / the config block; ISSUE 12): with a
+:class:`~deepspeed_tpu.kv_fabric.KVFabric` attached, warmth moves
+instead of dying with its owner —
+
+- **cross-replica migration**: an affinity miss where another
+  replica's digest (or a draining replica's still-held pages) covers
+  the prompt exports the serialized, checksummed page chain into the
+  fabric and admits it into the target's spill pool, so the admission
+  promotes a DMA instead of re-prefilling; export errors, fetch
+  latency past ``migrate_timeout_s``, and in-transit corruption all
+  degrade to re-prefill through the engine's existing promotion
+  fallback.
+- **disaggregated prefill/decode** (``fleet.roles``): prefill
+  replicas run prompts to first-token-ready, publish the KV chain,
+  and decode replicas pick the request up as a migrated admission —
+  failover, drain, autoscaling (per-role pressure) and rolling
+  updates compose on top.
+
 Chaos composes: the ``faults`` plan's ``replica`` rules (kill /
 stall-for / force-degrade, ``match=`` a replica id) fire through the
 router's per-step poll, so the soak can kill one of three replicas
 mid-traffic and assert every accepted request still resolves token-
-identical or typed (``tools/chaos_soak.py --fleet``).
+identical or typed (``tools/chaos_soak.py --fleet``); ``fabric``
+rules (export error / fetch latency / corrupt-after-checksum) do the
+same for the migration paths (``--disagg``).
 """
 
 from __future__ import annotations
@@ -59,9 +79,11 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from deepspeed_tpu import faults as faults_mod
-from deepspeed_tpu.config import (FaultsConfig, FleetConfig,
-                                  TelemetryConfig, TracingConfig)
+from deepspeed_tpu.config import (FabricConfig, FaultsConfig,
+                                  FleetConfig, TelemetryConfig,
+                                  TracingConfig)
 from deepspeed_tpu.faults import FaultPlan, InjectedFault
+from deepspeed_tpu.kv_fabric import KVFabric
 from deepspeed_tpu.inference.prefix_cache import (matchable_pages,
                                                   page_keys)
 from deepspeed_tpu.inference.serving import (EngineClosed, RequestFailed,
@@ -100,6 +122,12 @@ class _FleetReq:
     keys: Optional[List[bytes]] = None   # chained page keys (affinity)
     replica: Optional[str] = None        # current assignment
     resubmits: int = 0
+    # disaggregated prefill/decode leg (fleet.roles): None = classic;
+    # "prefill" = running to first-token-ready on a prefill replica
+    # (engine-side max_new_tokens clamps to 1, completion triggers the
+    # KV handoff instead of finishing); "decode" = the post-handoff
+    # leg, whose tokens list carries the prefill leg's boundary token
+    phase: Optional[str] = None
 
 
 class Replica:
@@ -109,7 +137,10 @@ class Replica:
         self.id = rid
         self.engine = engine
         self.state = HEALTHY
-        self.digest: frozenset = frozenset()
+        # key -> tier location ("hbm"/"host"/"nvme"): the located form
+        # (engine.warm_digest) lets affinity prefer an HBM-warm
+        # replica over an NVMe-warm one on warm-length ties
+        self.digest: Dict[bytes, str] = {}
         self.assigned: set = set()       # req_ids routed here, live
         self.degraded_streak = 0
         self.healthy_streak = 0
@@ -117,7 +148,14 @@ class Replica:
         # hint the periodic refresh must not wipe (the successor does
         # not hold these pages yet — they drop out one by one as the
         # real warm pool catches up, or wholesale on rejoin/death)
-        self.inherited: frozenset = frozenset()
+        self.inherited: Dict[bytes, str] = {}
+        # a DRAINING replica leaves the routing digest but still
+        # physically holds its pages until rejoin/death: migration's
+        # owner search reads this so drained warmth can still export
+        # through the fabric instead of dying with the drain
+        self.exportable: Dict[bytes, str] = {}
+        # disaggregation pool ("prefill"/"decode"; None = symmetric)
+        self.role: Optional[str] = None
         self.health_reasons: List[str] = []
         self.stall_started = 0.0
         self.stall_until = 0.0
@@ -166,7 +204,7 @@ class FleetRouter:
     """
 
     def __init__(self, engines, *, fleet=None, telemetry=None,
-                 faults=None, tracer=None):
+                 faults=None, tracer=None, fabric=None):
         self.cfg = FleetConfig.coerce(fleet)
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
@@ -185,6 +223,34 @@ class FleetRouter:
         self.page_size = r0.page_size
         self._affinity = self.cfg.affinity and \
             any(rep.engine._pc_on for rep in self.replicas.values())
+
+        # ---- disaggregated prefill/decode pools (fleet.roles): ring
+        # order assigns the first roles["prefill"] replicas to the
+        # prefill pool, the rest to decode; routing prefers the
+        # matching pool and degrades to the other when it empties
+        self._roles_on = self.cfg.roles is not None
+        if self._roles_on:
+            if sum(self.cfg.roles.values()) != len(self.replicas):
+                raise ValueError(
+                    f"fleet.roles {self.cfg.roles} does not cover the "
+                    f"{len(self.replicas)} engines handed to the "
+                    "router — every replica needs exactly one role")
+            n_pre = self.cfg.roles["prefill"]
+            for i, rep in enumerate(self.replicas.values()):
+                rep.role = "prefill" if i < n_pre else "decode"
+
+        # ---- KV fabric: the shared content-addressed exchange the
+        # migration and handoff paths move serialized page chains
+        # through.  Built against the ROUTER registry (kv_fabric_*
+        # family rides the fleet /metrics); every replica attaches —
+        # which requires its kv_tier block, the admission side of the
+        # transport.
+        if isinstance(fabric, KVFabric):
+            self._fabric: Optional[KVFabric] = fabric
+        else:
+            fab_cfg = FabricConfig.coerce(fabric)
+            self._fabric = None if not fab_cfg.enabled else fab_cfg
+        # (deferred: the fabric needs the registry built below)
 
         # ---- fault plan: the router owns the process-wide install for
         # `replica` rules (engines passed the SAME plan instance see it
@@ -265,6 +331,36 @@ class FleetRouter:
         self._g_routable = r.gauge(
             "fleet_routable_replicas",
             "replicas currently accepting new admissions")
+        self._c_migrations = r.counter(
+            "fleet_kv_migrations",
+            "cross-replica KV migrations completed (an affinity miss "
+            "served by the fabric instead of a re-prefill)")
+        self._c_migration_pages = r.counter(
+            "fleet_kv_migration_pages",
+            "pages made locally matchable by migrations")
+        self._c_migration_fallbacks = r.counter(
+            "fleet_kv_migration_fallbacks",
+            "migrations abandoned (export failure, fetch failure, or "
+            "migrate_timeout_s) — the span re-prefilled instead")
+        self._c_migration_routed = r.counter(
+            "fleet_migration_routed",
+            "admissions with no warm replica that the fabric could "
+            "cover (a migratable hit weighed above a cold re-prefill)")
+        self._c_handoffs = r.counter(
+            "fleet_kv_handoffs",
+            "prefill->decode handoffs (disaggregated fleets: the "
+            "prefill leg finished first-token-ready and the request "
+            "moved to a decode replica as a migrated admission)")
+
+        # ---- finalize the fabric against this registry
+        if self._fabric is not None and not isinstance(self._fabric,
+                                                       KVFabric):
+            self._fabric = KVFabric(self._fabric, registry=r)
+        if self._fabric is not None:
+            for rep in self.replicas.values():
+                # raises for a replica without kv_tier — fabric
+                # participation is all-or-nothing per fleet
+                rep.engine.attach_fabric(self._fabric)
 
         # host-side accounting (works with telemetry disabled; the
         # soak reconciles these against typed results and the registry)
@@ -274,6 +370,9 @@ class FleetRouter:
         self._n_shed = 0
         self._shed_by_reason: Dict[str, int] = {}
         self._n_resubmits = 0
+        self._n_migrations = 0
+        self._n_migration_fallbacks = 0
+        self._n_handoffs = 0
 
         self.requests: Dict[Any, _FleetReq] = {}    # live ledger
         self.finished: Dict[Any, RequestResult] = {}
@@ -335,6 +434,11 @@ class FleetRouter:
             req_id, list(map(int, tokens)), int(max_new_tokens),
             float(temperature), tier, time.perf_counter(),
             retries_left=self.cfg.retry_budget)
+        if self._roles_on and freq.max_new_tokens > 1:
+            # disaggregation: the request starts as a prefill leg (a
+            # 1-token request IS pure prefill work — it routes to the
+            # prefill pool but finishes there, no handoff)
+            freq.phase = "prefill"
         if self.cfg.shed_queue_depth:
             depth = sum(len(rep.engine.queue)
                         for rep in self.replicas.values()
@@ -361,36 +465,61 @@ class FleetRouter:
         self._n_submitted += 1
         return res
 
+    def _ensure_keys(self, freq: _FleetReq) -> List[bytes]:
+        if freq.keys is None:
+            freq.keys = page_keys(freq.tokens, self.page_size)[
+                :matchable_pages(len(freq.tokens), self.page_size)]
+        return freq.keys
+
     def _route(self, freq: _FleetReq,
                exclude: frozenset = frozenset()
                ) -> Tuple[Optional[Replica], bool]:
         """Pick a replica for ``freq``: warm-digest affinity first
-        (longest matched page-key prefix wins, load breaks ties), then
-        least-loaded.  HEALTHY replicas are preferred over DEGRADED
-        ones.  Returns ``(replica_or_None, was_affinity_hit)``."""
+        (longest matched page-key prefix wins; on length ties the
+        replica holding more of the match in HBM beats one whose copy
+        sits on host/NVMe — a promotion costs a DMA the HBM share does
+        not — then load breaks ties), then least-loaded.  HEALTHY
+        replicas are preferred over DEGRADED ones; under
+        ``fleet.roles`` the phase-matching pool is preferred over the
+        other (falling back when it has no routable member).  Returns
+        ``(replica_or_None, was_affinity_hit)``."""
         cands = [rep for rep in self.replicas.values()
                  if rep.routable and rep.id not in exclude]
         if not cands:
             return None, False
+        if self._roles_on:
+            want = "decode" if freq.phase == "decode" else "prefill"
+            role_pool = [rep for rep in cands if rep.role == want]
+            if role_pool:
+                cands = role_pool
         healthy = [rep for rep in cands if rep.state == HEALTHY]
         pool = healthy or cands
         if self._affinity:
-            if freq.keys is None:
-                freq.keys = page_keys(freq.tokens, self.page_size)[
-                    :matchable_pages(len(freq.tokens), self.page_size)]
-            best, best_score = None, 0
+            keys = self._ensure_keys(freq)
+            best, best_rank = None, (0, 0)
             for rep in pool:
-                score = 0
-                for k in freq.keys:
-                    if k not in rep.digest:
+                n = hbm = 0
+                for k in keys:
+                    loc = rep.digest.get(k)
+                    if loc is None:
                         break
-                    score += 1
-                if score > best_score or (
-                        score == best_score and score > 0 and
-                        best is not None and rep.load() < best.load()):
-                    best, best_score = rep, score
-            if best is not None and best_score > 0:
+                    n += 1
+                    if loc == "hbm":
+                        hbm += 1
+                rank = (n, hbm)
+                if n > 0 and (
+                        best is None or rank > best_rank or
+                        (rank == best_rank and
+                         rep.load() < best.load())):
+                    best, best_rank = rep, rank
+            if best is not None:
                 return best, True
+            if self._fabric is not None and \
+                    self._fabric.covers(keys) > 0:
+                # no replica is warm but the fabric holds the chain: a
+                # migratable hit weighs above a cold re-prefill — the
+                # least-loaded target admits it through _maybe_migrate
+                self._c_migration_routed.inc()
         return min(pool, key=lambda rep: rep.load()), False
 
     def _place(self, freq: _FleetReq,
@@ -405,9 +534,17 @@ class FleetRouter:
             rep, hit = self._route(freq, exclude)
             if rep is None:
                 return self._finish_shed(freq, "no_replica")
+            if self._fabric is not None:
+                self._maybe_migrate(freq, rep)
+            # a prefill leg runs to first-token-ready only: the engine
+            # generates ONE token (sampled from the last prompt
+            # position — prefill's own output) and the harvest hands
+            # the request to the decode pool
+            mnt = 1 if freq.phase == "prefill" \
+                else freq.max_new_tokens
             try:
                 res = rep.engine.submit(
-                    freq.req_id, freq.tokens, freq.max_new_tokens,
+                    freq.req_id, freq.tokens, mnt,
                     freq.temperature, tier=freq.tier,
                     arrival=freq.t_arrival)
             except EngineClosed as e:
@@ -470,6 +607,13 @@ class FleetRouter:
         request that already emitted tokens fails typed (never
         double-generate); otherwise re-place on a survivor while the
         retry budget lasts."""
+        if generated and freq.phase == "prefill":
+            # the prefill leg's boundary token is never surfaced to
+            # the caller (only the decode leg's completion is), so a
+            # replica dying mid-prefill-leg re-runs the leg from the
+            # prompt instead of failing a request the user saw
+            # nothing from
+            generated = 0
         if generated > 0:
             self._finish_failed(freq, reason, error, generated)
             return
@@ -483,6 +627,145 @@ class FleetRouter:
             self._n_resubmits += 1
         freq.replica = None
         self._place(freq, exclude)
+
+    # -------------------------------------------------- KV migration
+    def _maybe_migrate(self, freq: _FleetReq, target: Replica) -> None:
+        """Affinity-miss migration: when the routing target does not
+        locally cover ``freq``'s prompt chain but the fabric (or
+        another replica's warmth, exported on demand) does, pull the
+        chain into the target's spill pool BEFORE the submit — its
+        admission then matches the span as tier hits and promotes
+        through the existing checksum-verified path instead of
+        re-prefilling.  Every failure mode degrades to re-prefill:
+        export errors stop the chain where they hit, fetch latency
+        past ``migrate_timeout_s`` abandons the remainder (the
+        admitted prefix is still chain-valid), and in-transit
+        corruption is caught by the admitting engine's promotion-time
+        crc32 and falls back like any failed tier promotion."""
+        eng = target.engine
+        if not getattr(eng, "_kvt_on", False) or eng._fabric is None:
+            return
+        keys = self._ensure_keys(freq)
+        if not keys:
+            return
+        # the target's ACTUAL local coverage (its routing digest may
+        # carry inherited drain hints for pages it never materialized)
+        n_local = 0
+        for k in keys:
+            if k in eng.allocator.index or eng._kv_pool.has(k):
+                n_local += 1
+            else:
+                break
+        if n_local >= len(keys):
+            return
+        fab = self._fabric
+        t0 = time.perf_counter()
+        deadline = t0 + fab.cfg.migrate_timeout_s
+        n_fab = fab.covers(keys)
+        if n_fab <= n_local:
+            # find an owner whose digest (or drained-but-held pages)
+            # covers more of the chain and export on demand
+            owner, cov = None, max(n_local, n_fab)
+            for rep in self.replicas.values():
+                if rep.state == DEAD or rep.id == target.id:
+                    continue
+                n = 0
+                for k in keys:
+                    if k not in rep.digest and k not in rep.exportable:
+                        break
+                    n += 1
+                if n > cov:
+                    owner, cov = rep, n
+            if owner is None:
+                return
+            try:
+                n_exp = owner.engine.export_pages(keys[:cov],
+                                                  fabric=fab)
+            except Exception as e:
+                logger.warning("fleet: fabric export from %s failed "
+                               "(%s) — re-prefilling", owner.id, e)
+                self._c_migration_fallbacks.inc()
+                self._n_migration_fallbacks += 1
+                return
+            if time.perf_counter() > deadline:
+                # export timeout: fall back to re-prefill exactly like
+                # a failed promotion — the published pages stay in the
+                # fabric for a later (faster) migration
+                self._c_migration_fallbacks.inc()
+                self._n_migration_fallbacks += 1
+                return
+            if n_exp <= n_local:
+                # the export was attempted but delivered nothing new
+                # (an injected export error on the first page, or the
+                # owner's digest went stale): a degraded migration
+                self._c_migration_fallbacks.inc()
+                self._n_migration_fallbacks += 1
+                return
+            n_fab = n_exp
+        if n_fab - n_local < fab.cfg.min_pages:
+            return
+        n_adm = eng.admit_fabric(keys[:n_fab], deadline=deadline)
+        if n_adm > n_local:
+            self._c_migrations.inc()
+            self._n_migrations += 1
+            self._c_migration_pages.inc(n_adm - n_local)
+            fab.h_migrate.observe(time.perf_counter() - t0)
+            # the target is tier-warm for the MIGRATED span now —
+            # reflect it in the routing digest before the next refresh
+            # tick.  Only the newly admitted tail is stamped (the
+            # locally-covered prefix may be HBM-resident, and "host"
+            # would downgrade its affinity tie-break rank), with the
+            # tier the admit actually landed each key in.
+            pool = eng._kv_pool
+            target.digest = {
+                **target.digest,
+                **{k: (pool.location(k) or "host")
+                   for k in keys[n_local:n_adm]}}
+            tracer = eng.tracer
+            if tracer.enabled:
+                tracer.event("kv_migrate", freq.req_id, attrs={
+                    "pages": n_adm - n_local,
+                    "target": target.id,
+                    "wait_s": round(time.perf_counter() - t0, 6)})
+        else:
+            self._c_migration_fallbacks.inc()
+            self._n_migration_fallbacks += 1
+
+    # ------------------------------------------- prefill->decode handoff
+    def _refresh_one(self, rep: Replica) -> None:
+        warm = rep.engine.warm_digest()
+        rep.inherited = {k: v for k, v in rep.inherited.items()
+                         if k not in warm}
+        rep.digest = {**warm, **rep.inherited}
+
+    def _handoff(self, freq: _FleetReq, src: Replica,
+                 result: List[int]) -> None:
+        """The disaggregation seam: the prefill leg finished
+        first-token-ready on ``src`` — move the request to the decode
+        pool as a migrated admission.  The boundary token joins the
+        prompt (the decode replica's admission treats it as prompt
+        history; its KV chain migrates through the fabric, so the
+        decode leg prefills only the unmigrated tail), the remaining
+        token budget carries over, and like a drain re-route this is
+        scheduled movement: no retry-budget charge."""
+        self._c_handoffs.inc()
+        self._n_handoffs += 1
+        freq.phase = "decode"
+        freq.tokens = [int(t) for t in result]
+        freq.max_new_tokens -= 1
+        freq.keys = None
+        freq.replica = None
+        # the source just published the prompt's pages at finish: make
+        # its digest current NOW so _maybe_migrate's owner search sees
+        # the warmth without waiting for the periodic refresh tick
+        self._refresh_one(src)
+        tracer = src.engine.tracer
+        if tracer.enabled:
+            tracer.event("kv_handoff", freq.req_id, attrs={
+                "from": src.id,
+                "prompt_tokens": len(freq.tokens),
+                "remaining_tokens": freq.max_new_tokens})
+        self._place(freq)
 
     # --------------------------------------------------------- failover
     def kill(self, replica_id: str, error: str = "killed") -> None:
@@ -561,7 +844,7 @@ class FleetRouter:
             "failed_typed": [r for r in candidates
                              if r in self.finished],
         }
-        rep.digest = rep.inherited = frozenset()
+        rep.digest, rep.inherited, rep.exportable = {}, {}, {}
         try:
             rep.engine.shutdown()
         except Exception:
@@ -594,6 +877,7 @@ class FleetRouter:
         self._c_drains.inc()
         succ = self._affinity_successor(
             rep, exclude=frozenset(successor_exclude))
+        donated = {**rep.engine.warm_digest(), **rep.inherited}
         if succ is not None:
             # routing hint, deliberately optimistic: the successor does
             # not hold these pages yet, but same-prefix traffic landing
@@ -601,10 +885,17 @@ class FleetRouter:
             # handoff it would spray across the fleet and warm
             # nothing.  Recorded as `inherited` so the periodic digest
             # refresh keeps the hint alive until the successor's own
-            # warm pool covers it.
-            donated = rep.engine.warm_keys() | rep.inherited
-            succ.inherited = frozenset(succ.inherited | donated)
-            succ.digest = frozenset(succ.digest | donated)
+            # warm pool covers it.  With a fabric attached the hint is
+            # better than optimistic: the first same-prefix admission
+            # on the successor MIGRATES the chain out of the draining
+            # replica (still holding its pages — see `exportable`)
+            # instead of recomputing it.
+            succ.inherited = {**succ.inherited, **donated}
+            succ.digest = {**succ.digest, **donated}
+        # the draining replica leaves the routing digest but keeps its
+        # pages until rejoin/death: migration's owner search may still
+        # export them through the fabric
+        rep.exportable = donated
         tracer = rep.engine.tracer
         if tracer.enabled:
             tracer.event("replica_drain", attrs={
@@ -618,7 +909,7 @@ class FleetRouter:
                 self._retry_or_fail(freq, "replica_draining",
                                     exclude=frozenset({rep.id}),
                                     charge=False)
-        rep.digest = rep.inherited = frozenset()
+        rep.digest, rep.inherited = {}, {}
 
     def _affinity_successor(self, rep: Replica,
                             exclude: frozenset = frozenset()
@@ -667,6 +958,8 @@ class FleetRouter:
             if engine.replica_id is None:
                 engine.replica_id = replica_id
             rep.engine = engine
+            if self._fabric is not None:
+                engine.attach_fabric(self._fabric)
             if self._tel_exporter is not None:
                 self._tel_exporter.add_source(engine.registry)
         rep.set_state(HEALTHY)
@@ -674,8 +967,9 @@ class FleetRouter:
         rep.stall_until = rep.stall_started = 0.0
         rep.forced_degrade_until = 0.0
         rep.health_reasons = []
-        rep.inherited = frozenset()
-        rep.digest = rep.engine.warm_keys()
+        rep.inherited = {}
+        rep.exportable = {}
+        rep.digest = dict(rep.engine.warm_digest())
         self._c_rejoins.inc()
         tracer = rep.engine.tracer
         if tracer.enabled:
@@ -685,7 +979,8 @@ class FleetRouter:
     # (the elastic verbs: the autoscaler adds replicas under load and
     # removes them — drain → retire — when load falls; both are also
     # operator verbs for manual fleet surgery)
-    def spawn(self, engine, replica_id: Optional[str] = None) -> str:
+    def spawn(self, engine, replica_id: Optional[str] = None,
+              role: Optional[str] = None) -> str:
         """Add a NEW replica to the end of the ring (unlike
         :meth:`rejoin`, which refills an existing slot).  The engine
         must be live and fleet-compatible (same model/page geometry —
@@ -714,7 +1009,30 @@ class FleetRouter:
         if engine.replica_id is None:
             engine.replica_id = replica_id
         rep = Replica(replica_id, engine)
-        rep.digest = engine.warm_keys()
+        if self._fabric is not None:
+            engine.attach_fabric(self._fabric)
+        rep.digest = dict(engine.warm_digest())
+        if self._roles_on:
+            if role is not None and role not in self.cfg.roles:
+                raise ValueError(
+                    f"spawn role {role!r} not in fleet.roles "
+                    f"{sorted(self.cfg.roles)}")
+            if role is None:
+                # fill the pool furthest below its configured share
+                # (the autoscaler passes the pressured role instead)
+                live = [r for r in self.replicas.values()
+                        if r.state != DEAD]
+                total = sum(self.cfg.roles.values())
+
+                def deficit(ro: str) -> float:
+                    have = sum(1 for r in live if r.role == ro)
+                    return have / max(len(live), 1) \
+                        - self.cfg.roles[ro] / total
+
+                role = min(sorted(self.cfg.roles), key=deficit)
+            rep.role = role
+        elif role is not None:
+            rep.role = role
         self.replicas[replica_id] = rep
         self._c_spawns.inc()
         if self._tel_exporter is not None:
@@ -793,6 +1111,34 @@ class FleetRouter:
             else:
                 out.extend(g)
         self._retired_slo = out
+
+    # ------------------------------------------------------- role views
+    # (the autoscaler's per-role scaling signals and victim guard)
+    def role_pressure(self) -> Dict[str, float]:
+        """Mean queue depth per routable replica, per role.  A role
+        with NO routable member reads as infinite pressure — the
+        autoscaler heals it before anything else."""
+        out: Dict[str, float] = {}
+        for ro in (self.cfg.roles or {}):
+            members = [rep for rep in self.replicas.values()
+                       if rep.role == ro and rep.routable]
+            out[ro] = (sum(len(rep.engine.queue) for rep in members)
+                       / len(members)) if members else float("inf")
+        return out
+
+    def last_of_role(self, rep: Replica) -> bool:
+        """True when ``rep`` is the only live member of its role — a
+        scale-down victim guard (routing degrades to the other pool,
+        but a fleet that CONFIGURED both pools should not silently
+        lose one to load troughs)."""
+        if not self._roles_on or rep.role is None:
+            return False
+        # ROUTABLE peers only: a DRAINING/QUARANTINED peer cannot
+        # absorb the role's traffic, so retiring this replica would
+        # still empty the pool
+        return not any(
+            r.id != rep.id and r.routable and r.role == rep.role
+            for r in self.replicas.values())
 
     def attach_autoscaler(self, autoscaler) -> None:
         """Register the :class:`~deepspeed_tpu.autoscale.
@@ -918,9 +1264,21 @@ class FleetRouter:
                     self._shed_by_reason.get(res.reason, 0) + 1
                 self._finish(rid, res)
             else:
+                eos = getattr(rep.engine, "eos", None)
+                if freq.phase == "prefill" and \
+                        freq.max_new_tokens > 1 and \
+                        len(res) > len(freq.tokens) and not (
+                            eos is not None and res[-1] == eos):
+                    # first-token-ready, not finished: hand the
+                    # request (and its KV chain) to the decode pool.
+                    # An EOS boundary token IS the whole answer — it
+                    # completes here like any 1-token request.
+                    self._handoff(freq, rep, res)
+                    out.append(rid)
+                    continue
+                rep.completed += 1
                 self._c_completed.inc()
                 self._n_completed += 1
-                rep.completed += 1
                 self._finish(rid, res)
             out.append(rid)
         return out
@@ -935,9 +1293,7 @@ class FleetRouter:
         the very next refresh tick."""
         for rep in self.replicas.values():
             if rep.state not in (DEAD, DRAINING):
-                warm = rep.engine.warm_keys()
-                rep.inherited = rep.inherited - warm
-                rep.digest = warm | rep.inherited
+                self._refresh_one(rep)
 
     def step(self) -> List[Any]:
         """One fleet iteration: fault poll → health poll → step every
@@ -1047,6 +1403,7 @@ class FleetRouter:
             row = {
                 "replica": rep.id,
                 "state": rep.state,
+                "role": rep.role,
                 "version": str(rep.version),
                 "state_age_s": round(now - rep.state_since, 3),
                 "queue_depth": len(e.queue),
@@ -1094,14 +1451,42 @@ class FleetRouter:
             "in_flight": len(self.requests),
             "orphaned": len(self.orphaned()),
         }
+        if self._fabric is not None:
+            fleet["fabric"] = {
+                **self._fabric.occupancy(),
+                "migrations": self._n_migrations,
+                "migration_pages": int(
+                    self._c_migration_pages.value),
+                "migration_fallbacks": self._n_migration_fallbacks,
+                "handoffs": self._n_handoffs,
+            }
+        if self._roles_on:
+            roles: Dict[str, Any] = {}
+            for ro in sorted(self.cfg.roles):
+                members = [rep for rep in self.replicas.values()
+                           if rep.role == ro]
+                roles[ro] = {
+                    "replicas": len(members),
+                    "routable": sum(1 for rep in members
+                                    if rep.routable),
+                    "queue_depth": sum(
+                        len(rep.engine.queue) for rep in members
+                        if rep.state != DEAD),
+                    "active_slots": sum(
+                        1 for rep in members if rep.state != DEAD
+                        for s in rep.engine.slots if s is not None),
+                }
+            fleet["roles"] = roles
+            fleet["handoffs"] = self._n_handoffs
         # DEAD replicas included (their trackers are host-side and
         # outlive shutdown) and RETIRED replicas' final snapshots
         # folded in: the fleet "lifetime" counters never shrink at a
         # failover or a scale-down.  Versions ride along so the rollup
         # carries the per-version view a rolling update watches.
-        snaps = [(rep.engine.slo_tracker.snapshot(now=now), rep.version)
+        snaps = [(rep.engine.slo_tracker.snapshot(now=now), rep.version,
+                  rep.role)
                  for rep in self.replicas.values()]
-        snaps.extend(self._retired_slo)
+        snaps.extend((s, v, None) for s, v in self._retired_slo)
         status = {
             "schema_version": 1,
             "engine": "FleetRouter",
@@ -1109,8 +1494,10 @@ class FleetRouter:
             "uptime_s": round(now - self._t_start, 3),
             "steps": self._steps,
             "fleet": fleet,
-            "slo": fleet_rollup([s for s, _ in snaps],
-                                versions=[v for _, v in snaps]),
+            "slo": fleet_rollup([s for s, _v, _r in snaps],
+                                versions=[v for _s, v, _r in snaps],
+                                roles=[r for _s, _v, r in snaps]
+                                if self._roles_on else None),
             "metrics": self.registry.snapshot(),
         }
         if self._autoscaler is not None:
@@ -1164,8 +1551,8 @@ class FleetRouter:
 
 
 def fleet_router(params, cfg, *, fleet=None, telemetry=None,
-                 tracing=None, faults=None, engine_builder=None,
-                 **engine_kw) -> FleetRouter:
+                 tracing=None, faults=None, fabric=None,
+                 engine_builder=None, **engine_kw) -> FleetRouter:
     """Build a fleet of homogeneous replicas over one model + config.
 
     Each replica is built through :func:`~deepspeed_tpu.inference.
@@ -1176,7 +1563,11 @@ def fleet_router(params, cfg, *, fleet=None, telemetry=None,
     plan, installed by the router for its lifetime.  ``telemetry``
     configures the ROUTER's rollup registry/exporter (give replicas
     their own telemetry via ``engine_kw``; avoid fixed http ports
-    there — N replicas cannot share one)."""
+    there — N replicas cannot share one).  ``fabric`` (a config
+    block, ``True``, or a pre-built :class:`~deepspeed_tpu.kv_fabric.
+    KVFabric`) attaches the cross-replica KV exchange to every
+    replica — each then needs the ``kv_tier`` block in
+    ``engine_kw``."""
     fc = FleetConfig.coerce(fleet)
     tracer = RequestTracer.from_config(TracingConfig.coerce(tracing))
     if isinstance(faults, FaultPlan):
@@ -1206,7 +1597,7 @@ def fleet_router(params, cfg, *, fleet=None, telemetry=None,
                 params, cfg, replica_id=f"r{i}", tracing=tracer,
                 faults=plan, **kw_i))
         router = FleetRouter(engines, fleet=fc, telemetry=telemetry,
-                             faults=plan, tracer=tracer)
+                             faults=plan, tracer=tracer, fabric=fabric)
     except Exception:
         for e in engines:
             try:

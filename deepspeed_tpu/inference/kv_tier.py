@@ -43,6 +43,7 @@ on exactly this bound.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import os
 import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -83,6 +84,32 @@ def dequantize_page(codes: np.ndarray, scale: np.ndarray,
                     dtype) -> np.ndarray:
     """Inverse of :func:`quantize_page`, cast back to the page dtype."""
     return (codes.astype(np.float32) * scale).astype(dtype)
+
+
+def encode_entry(key: bytes, k: np.ndarray, v: np.ndarray, *,
+                 quantize: bool, page_dtype, tick: int = 0) -> TierEntry:
+    """Serialize one page's (k, v) into a host-resident
+    :class:`~deepspeed_tpu.inference.prefix_cache.TierEntry`: the
+    spill tier's demote path and the cross-replica KV fabric's export
+    path share exactly this encoding (same buffer naming, same
+    per-buffer crc32 recorded now and verified when a promotion — or a
+    migrated admission on another replica — decodes the payload
+    back)."""
+    hexk = key_hex(key)
+    if quantize:
+        kq, ks = quantize_page(k)
+        vq, vs = quantize_page(v)
+        data = (kq, ks, vq, vs)
+    else:
+        data = (np.ascontiguousarray(k), np.ascontiguousarray(v))
+    bufs = tuple((f"kv_{hexk}_{i}", tuple(b.shape), str(b.dtype))
+                 for i, b in enumerate(data))
+    sums = tuple(_crc(b) for b in data)
+    return TierEntry(
+        key=key, location="host", quantized=quantize,
+        dtype=str(np.dtype(page_dtype)), buffers=bufs,
+        nbytes=int(sum(b.nbytes for b in data)), data=data,
+        tick=tick, checksums=sums)
 
 
 # ------------------------------------------------- NVMe read/write legs
@@ -369,27 +396,16 @@ class KVTierPool:
     # ----------------------------------------------------------- demote
     def _encode(self, key: bytes, k: np.ndarray,
                 v: np.ndarray) -> TierEntry:
-        hexk = key_hex(key)
-        if self.cfg.quantize_cold:
-            kq, ks = quantize_page(k)
-            vq, vs = quantize_page(v)
-            data = (kq, ks, vq, vs)
-        else:
-            data = (np.ascontiguousarray(k),
-                    np.ascontiguousarray(v))
-        bufs = tuple((f"kv_{hexk}_{i}", tuple(b.shape), str(b.dtype))
-                     for i, b in enumerate(data))
         self._tick += 1
-        # per-buffer crc32 recorded NOW, verified when a promotion
-        # decodes the payload back — bit rot, a torn spill write, or
-        # injected corruption all surface as ChecksumError there, and
-        # the consumer re-prefills instead of serving garbage KV
-        sums = tuple(_crc(b) for b in data)
-        return TierEntry(
-            key=key, location="host", quantized=self.cfg.quantize_cold,
-            dtype=str(self.page_dtype), buffers=bufs,
-            nbytes=int(sum(b.nbytes for b in data)), data=data,
-            tick=self._tick, checksums=sums)
+        # per-buffer crc32 recorded NOW (inside encode_entry), verified
+        # when a promotion decodes the payload back — bit rot, a torn
+        # spill write, or injected corruption all surface as
+        # ChecksumError there, and the consumer re-prefills instead of
+        # serving garbage KV
+        return encode_entry(key, k, v,
+                            quantize=self.cfg.quantize_cold,
+                            page_dtype=self.page_dtype,
+                            tick=self._tick)
 
     def demote(self, key: bytes, k: np.ndarray,
                v: np.ndarray) -> Optional[str]:
@@ -411,6 +427,50 @@ class KVTierPool:
             _delay, err = _faults.poll("kv_corrupt", key_hex(key))
             if err is not None:
                 _faults.corrupt_array(entry.data[0])
+        return self._land(entry)
+
+    def admit_entry(self, entry: TierEntry) -> Optional[str]:
+        """Admit an ALREADY-SERIALIZED entry (a fabric migration: the
+        payload was encoded — and checksummed — on another replica;
+        quantized cold pages ride as-is).  Record AND payload are
+        copied — this pool's lifetime must never alias a shared
+        transit buffer (a later in-fabric corruption or eviction
+        cannot reach pages already admitted here).  Returns the
+        landing tier like :meth:`demote`; the original checksums carry
+        over, so a payload corrupted in transit fails this pool's
+        promotion-time verify and the admitting engine re-prefills."""
+        if self.disabled is not None:
+            return None
+        if entry.key in self.entries:
+            return self.touch(entry.key)
+        self._tick += 1
+        clone = dataclasses.replace(
+            entry, location="host", tick=self._tick,
+            data=tuple(np.array(b, copy=True) for b in entry.data))
+        return self._land(clone)
+
+    def entry_payload(self, key: bytes) -> TierEntry:
+        """A host-form view of one entry for export: host entries
+        return as-is; an NVMe entry's buffers are read back
+        synchronously (export is off the decode critical path).  The
+        ORIGINAL checksums ride along — the importer's decode verifies
+        them, so corruption anywhere between the demote that recorded
+        them and the remote promotion is caught there."""
+        e = self.entries[key]
+        if e.location == "host":
+            return e
+        bufs = tuple(
+            _faults.read_file_sync(self._nvme._path(name), shape,
+                                   dtype, key=name)
+            for name, shape, dtype in e.buffers)
+        return dataclasses.replace(e, location="host", data=bufs)
+
+    def _land(self, entry: TierEntry) -> Optional[str]:
+        """Place a freshly encoded (or fabric-admitted) entry: host
+        pool first, cascading older entries down (host → NVMe → drop)
+        to make room; an entry bigger than the whole host pool goes
+        straight to NVMe."""
+        key = entry.key
         if entry.nbytes > self.cfg.host_pool_bytes:
             # bigger than the whole host pool: straight to NVMe (the
             # entry was never host-accounted — accounted=False keeps

@@ -543,6 +543,9 @@ class ServingEngine:
         self.kv_tier = kvt
         self._kvt_on = kvt.enabled
         self._kv_pool = None
+        # cross-replica KV fabric (attach_fabric): export/admit ride
+        # the spill pool, so the handle stays None unless kv_tier is on
+        self._fabric = None
         # slot whose in-flight promotion owns the NVMe read channel
         # (host-resident promotions run concurrently and never claim it)
         self._promo_channel: Optional[int] = None
@@ -1028,10 +1031,140 @@ class ServingEngine:
         the tier is live) the spilled host/NVMe entries.  The fleet
         router diffs these digests to answer "which replica has this
         prompt warm" without touching any page payloads."""
-        keys = set(self.allocator.index)
-        if self._kv_pool is not None and self._kv_pool.disabled is None:
-            keys |= set(self._kv_pool.entries)
-        return frozenset(keys)
+        return frozenset(self.warm_digest())
+
+    def warm_digest(self) -> Dict[bytes, str]:
+        """:meth:`warm_keys` with tier locations: content key →
+        ``"hbm"`` / ``"host"`` / ``"nvme"``.  The fleet router's
+        cost-aware affinity prefers an HBM-warm replica over an
+        NVMe-warm one when warm-prefix lengths tie — a promotion from
+        NVMe is a DMA plus an aio read, not a dict lookup.  A span
+        resident in both HBM and the spill (a promoted page whose
+        spill copy was kept as a free re-demote) reports HBM."""
+        d = {k: "hbm" for k in self.allocator.index}
+        pool = self._kv_pool
+        if pool is not None and pool.disabled is None:
+            for k, e in pool.entries.items():
+                d.setdefault(k, e.location)
+        return d
+
+    # ------------------------------------------------ KV fabric verbs
+    # (consumed by deepspeed_tpu.fleet.FleetRouter's migration and
+    # prefill→decode handoff paths; both are host bookkeeping + one
+    # batched device→host gather on the export side)
+    def attach_fabric(self, fabric) -> None:
+        """Join a :class:`~deepspeed_tpu.kv_fabric.KVFabric`: this
+        replica may then export page chains into it and admit chains
+        other replicas computed.  Requires the ``kv_tier`` block —
+        admitted entries land in the local spill pool so the existing
+        tier-hit admission path (``begin_promotion`` + TierPageReader,
+        checksum-verified, re-prefill fallback) serves them."""
+        if fabric is not None and not self._kvt_on:
+            raise ValueError(
+                "attach_fabric needs the kv_tier block — the local "
+                "spill pool is the admission side of the transport "
+                "(migrated chains land there and re-admit through the "
+                "tier promotion path)")
+        self._fabric = fabric
+
+    def export_pages(self, keys: List[bytes], fabric=None) -> int:
+        """Export the longest contiguous prefix of ``keys`` this
+        replica holds (HBM published pages batch-fetch device→host and
+        encode; spilled tier entries ride as-is, int8 cold pages
+        included) into the fabric.  Returns the number of leading keys
+        now covered by the fabric; an export failure mid-chain stops
+        there — the published prefix is still chain-valid, and the
+        uncovered tail re-prefills on the importer."""
+        from deepspeed_tpu.inference.kv_tier import encode_entry
+
+        fab = fabric if fabric is not None else self._fabric
+        if fab is None or not self._kvt_on:
+            raise ValueError(
+                "export_pages needs an attached fabric and the "
+                "kv_tier block")
+        plan: List[Tuple[bytes, str, Optional[int]]] = []
+        for k in keys:
+            if fab.has(k):
+                plan.append((k, "fab", None))
+            elif k in self.allocator.index:
+                plan.append((k, "hbm", self.allocator.index[k]))
+            elif self._kv_pool.has(k):
+                plan.append((k, "tier", None))
+            else:
+                break
+        hbm = [(k, p) for k, kind, p in plan if kind == "hbm"]
+        payload: Dict[bytes, tuple] = {}
+        # one batched gather per prewarmed-bucket chunk, not one
+        # device read per page — same discipline as the demote sweep
+        cap = self._kvt_fetch_cap
+        for i in range(0, len(hbm), cap):
+            chunk = hbm[i:i + cap]
+            kh, vh = self._fetch_pages_host([p for _, p in chunk])
+            for j, (kk, _p) in enumerate(chunk):
+                payload[kk] = (kh[:, :, j], vh[:, :, j])
+        n = 0
+        nbytes = 0
+        for k, kind, _p in plan:
+            try:
+                if kind == "hbm":
+                    e = encode_entry(
+                        k, *payload[k],
+                        quantize=self.kv_tier.quantize_cold,
+                        page_dtype=self._kv_pool.page_dtype)
+                    fab.publish(k, e)
+                    nbytes += e.nbytes
+                elif kind == "tier":
+                    e = self._kv_pool.entry_payload(k)
+                    fab.publish(k, e)
+                    nbytes += e.nbytes
+            except (IOError, OSError) as exc:
+                # injected export failure or an unreadable spill file:
+                # the chain stops here, the rest re-prefills remotely
+                logger.warning(
+                    "serving: fabric export stopped at page %d/%d "
+                    "(%s)", n, len(plan), exc)
+                break
+            n += 1
+        if n and self._trace_on:
+            self.tracer.event("kv_export", attrs={
+                "pages": n, "bytes": nbytes})
+        return n
+
+    def admit_fabric(self, keys: List[bytes],
+                     deadline: Optional[float] = None) -> int:
+        """Fetch the longest contiguous prefix of ``keys`` out of the
+        fabric into the LOCAL spill pool, so the next admission's
+        chained walk treats the span as tier hits and promotes it
+        through the existing checksum-verified path.  ``deadline``
+        (perf_counter): stop fetching once past it — a migration that
+        blows its budget admits the partial prefix it has (still
+        chain-valid) and the rest re-prefills.  Returns the leading
+        keys now locally matchable."""
+        fab = self._fabric
+        if fab is None or not self._kvt_on:
+            raise ValueError(
+                "admit_fabric needs an attached fabric and the "
+                "kv_tier block")
+        n = 0
+        for k in keys:
+            if k in self.allocator.index or self._kv_pool.has(k):
+                n += 1              # already warm here — free
+                continue
+            if deadline is not None and \
+                    time.perf_counter() > deadline:
+                break
+            if not fab.has(k):
+                break
+            try:
+                entry = fab.fetch(k)
+            except (KeyError, IOError, OSError):
+                break               # evicted or injected fetch failure
+            if self._kv_pool.admit_entry(entry) is None:
+                break               # pool can't hold it (or disabled)
+            n += 1
+        if n and self._trace_on:
+            self.tracer.event("fabric_admit", attrs={"pages": n})
+        return n
 
     def swap_params(self, new_params, version=None) -> None:
         """Rolling-update weight swap: replace the served weight image

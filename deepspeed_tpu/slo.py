@@ -443,7 +443,7 @@ class SLOTracker:
                 "tiers": tiers}
 
 
-def fleet_rollup(snapshots, versions=None) -> Dict[str, Any]:
+def fleet_rollup(snapshots, versions=None, roles=None) -> Dict[str, Any]:
     """Aggregate per-replica :meth:`SLOTracker.snapshot` dicts into one
     fleet view (the multi-replica router's ``/statusz`` ``slo``
     section).  Per tier across replicas: lifetime counters sum, the
@@ -460,7 +460,15 @@ def fleet_rollup(snapshots, versions=None) -> Dict[str, Any]:
     present, the result gains ``by_version`` — the SAME rollup
     computed per version group, keyed by ``str(version)`` — so a
     rolling update can watch the NEW version's burn rate next to the
-    old one's while both serve side by side."""
+    old one's while both serve side by side.
+
+    ``roles``: a serving-role label per snapshot (a disaggregated
+    fleet's ``"prefill"``/``"decode"``; None entries — e.g. retired
+    replicas — are skipped).  With at least one labeled snapshot the
+    result gains ``by_role``, the same rollup per role group, so a
+    disaggregated fleet watches the prefill pool's TTFT burn apart
+    from the decode pool's deadline burn (the per-role scaling signal
+    the autoscaler composes on)."""
     snapshots = list(snapshots)
     if versions is not None:
         versions = list(versions)
@@ -468,17 +476,31 @@ def fleet_rollup(snapshots, versions=None) -> Dict[str, Any]:
             raise ValueError(
                 f"fleet_rollup: {len(versions)} versions for "
                 f"{len(snapshots)} snapshots — they must align")
-        out = _rollup(snapshots)
+    if roles is not None:
+        roles = list(roles)
+        if len(roles) != len(snapshots):
+            raise ValueError(
+                f"fleet_rollup: {len(roles)} roles for "
+                f"{len(snapshots)} snapshots — they must align")
+    out = _rollup(snapshots)
+    if versions is not None and out.get("enabled"):
         distinct = {str(v) for s, v in zip(snapshots, versions)
                     if s and s.get("enabled")}
-        if out.get("enabled") and len(distinct) > 1:
+        if len(distinct) > 1:
             groups: Dict[str, list] = {}
             for s, v in zip(snapshots, versions):
                 groups.setdefault(str(v), []).append(s)
             out["by_version"] = {v: _rollup(g)
                                  for v, g in sorted(groups.items())}
-        return out
-    return _rollup(snapshots)
+    if roles is not None and out.get("enabled"):
+        rgroups: Dict[str, list] = {}
+        for s, ro in zip(snapshots, roles):
+            if ro is not None:
+                rgroups.setdefault(str(ro), []).append(s)
+        if rgroups:
+            out["by_role"] = {ro: _rollup(g)
+                              for ro, g in sorted(rgroups.items())}
+    return out
 
 
 def _rollup(snapshots) -> Dict[str, Any]:

@@ -736,7 +736,8 @@ def test_inherited_digest_survives_refresh(gpt2_model):
     router.run()
     router.refresh_digests()
     # …after which the hint retires into the successor's own digest
-    assert succ.inherited < frozenset(donated)
+    # (digests carry tier locations now — compare key sets)
+    assert set(succ.inherited) < set(donated)
     assert donated <= set(succ.digest)
     assert_clean(router)
     router.shutdown()

@@ -1,0 +1,719 @@
+"""KV fabric + disaggregated prefill/decode fleet (ISSUE 12): the
+cross-replica KV exchange, migrated admissions, prefill→decode
+handoff, role-aware routing/failover/drain, the ``fabric`` fault
+rules, and the accounting/leak invariants every scenario must leave
+behind.
+
+Correctness oracle throughout: a single fault-free engine — a
+migrated admission streams KV another replica computed, and greedy
+decode over bit-exact pages must produce exactly the tokens a cold
+prefill would (the same contract the spill tier's promotion path
+already carries)."""
+
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu import faults
+from deepspeed_tpu.config import FabricConfig, FleetConfig, KVTierConfig
+from deepspeed_tpu.faults import FaultPlan, FaultRule
+from deepspeed_tpu.fleet import DEAD, DRAINING, fleet_router
+from deepspeed_tpu.inference.kv_tier import KVTierPool, encode_entry
+from deepspeed_tpu.inference.prefix_cache import page_keys
+from deepspeed_tpu.inference.serving import (RequestFailed, RequestShed,
+                                             serving_engine)
+from deepspeed_tpu.kv_fabric import FabricExportError, KVFabric
+from deepspeed_tpu.models import gpt2, llama
+from deepspeed_tpu.slo import fleet_rollup
+from deepspeed_tpu.telemetry import MetricsRegistry
+
+KW = dict(max_batch=2, page_size=8, num_pages=24, max_seq=64,
+          prefill_bucket=8)
+TIER = {"host_pool_bytes": 64 << 20}
+
+
+@pytest.fixture(scope="module")
+def gpt2_model():
+    cfg = gpt2.GPT2Config.tiny(dim=64, n_layers=2, n_heads=4,
+                               max_seq_len=128)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def llama_model():
+    cfg = llama.LlamaConfig.tiny(dim=64, n_layers=2, n_heads=4,
+                                 n_kv_heads=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear_fault_plan()
+    yield
+    faults.clear_fault_plan()
+
+
+def shared_prefix_prompts(vocab, n=4, seed=1, prefix_len=40,
+                          tail_len=3):
+    rng = np.random.default_rng(seed)
+    pref = rng.integers(1, vocab, prefix_len).tolist()
+    return [pref + rng.integers(1, vocab, tail_len).tolist()
+            for _ in range(n)]
+
+
+def build_engine(params, cfg, **over):
+    kw = dict(KW, prefix_cache=True, kv_tier=dict(TIER))
+    kw.update(over)
+    return serving_engine(params, cfg, **kw)
+
+
+def oracle(params, cfg, ps, max_new=6, **over):
+    eng = build_engine(params, cfg, **over)
+    for i, p in enumerate(ps):
+        eng.submit(f"o{i}", p, max_new_tokens=max_new)
+    out = eng.run()
+    eng.shutdown()
+    return [out[f"o{i}"] for i in range(len(ps))]
+
+
+def assert_clean_engine(eng):
+    assert eng.check_leaks() == []
+
+
+def assert_clean(router):
+    assert router.check_leaks() == []
+    assert router.orphaned() == []
+
+
+# ------------------------------------------------------------- config
+def test_fabric_config_validation():
+    c = FabricConfig.coerce({"capacity_bytes": 1024})
+    assert c.enabled and c.capacity_bytes == 1024
+    assert not FabricConfig.coerce(None).enabled
+    assert FabricConfig.coerce(True).enabled
+    with pytest.raises(ValueError):
+        FabricConfig.coerce({"capacity_bytes": 0})
+    with pytest.raises(ValueError):
+        FabricConfig.coerce({"migrate_timeout_s": 0})
+    with pytest.raises(ValueError):
+        FabricConfig.coerce({"min_pages": 0})
+    with pytest.raises(TypeError):
+        FabricConfig.coerce("yes")
+
+
+def test_roles_config_validation():
+    c = FleetConfig.coerce({"replicas": 3,
+                            "roles": {"prefill": 1, "decode": 2}})
+    assert c.roles == {"prefill": 1, "decode": 2}
+    with pytest.raises(ValueError):        # sum mismatch
+        FleetConfig.coerce({"replicas": 3,
+                            "roles": {"prefill": 1, "decode": 1}})
+    with pytest.raises(ValueError):        # unknown role
+        FleetConfig.coerce({"replicas": 2,
+                            "roles": {"prefill": 1, "verify": 1}})
+    with pytest.raises(ValueError):        # one pool only
+        FleetConfig.coerce({"replicas": 2, "roles": {"prefill": 2}})
+    with pytest.raises(ValueError):        # zero-replica role
+        FleetConfig.coerce({"replicas": 2,
+                            "roles": {"prefill": 0, "decode": 2}})
+
+
+def test_fabric_fault_rule_validation():
+    FaultRule(subsystem="fabric", mode="error", match="export")
+    FaultRule(subsystem="fabric", mode="latency", latency_s=0.01,
+              match="fetch")
+    with pytest.raises(ValueError):        # degrade is replica-only
+        FaultRule(subsystem="fabric", mode="degrade")
+    with pytest.raises(ValueError):        # keyless subsystem + match
+        FaultRule(subsystem="burst", match="export")
+
+
+# --------------------------------------------------- fabric unit level
+def page_payload(seed=0, shape=(2, 2, 8, 4)):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal(shape).astype(np.float32),
+            rng.standard_normal(shape).astype(np.float32))
+
+
+def test_export_import_crc_roundtrip():
+    """Publish → fetch → admit_entry → decode round-trips bit-exact
+    and verifies the ORIGINAL checksums; a flipped byte in the
+    fabric's copy fails decode on the importer, not the exporter."""
+    reg = MetricsRegistry()
+    fab = KVFabric({"capacity_bytes": 1 << 20}, registry=reg)
+    pool_a = KVTierPool(KVTierConfig.coerce(dict(TIER)), (2, 2, 8, 4),
+                        np.float32, registry=reg)
+    pool_b = KVTierPool(KVTierConfig.coerce(dict(TIER)), (2, 2, 8, 4),
+                        np.float32, registry=reg)
+    key = b"a" * 16
+    k, v = page_payload(1)
+    e = encode_entry(key, k, v, quantize=False, page_dtype=np.float32)
+    assert fab.publish(key, e)
+    assert not fab.publish(key, e)         # dedup refreshes, no double
+    got = fab.fetch(key)
+    assert got.data[0] is not e.data[0]    # fabric copies the payload
+    assert pool_b.admit_entry(got) == "host"
+    kk, vv = pool_b.decode(key, pool_b.entries[key].data)
+    assert np.array_equal(kk, k) and np.array_equal(vv, v)
+    # quantized entries ride as-is: admit keeps codes + scales intact
+    key2 = b"b" * 16
+    e2 = encode_entry(key2, k, v, quantize=True, page_dtype=np.float32)
+    fab.publish(key2, e2)
+    pool_a.admit_entry(fab.fetch(key2))
+    assert pool_a.entries[key2].quantized
+    assert len(pool_a.entries[key2].data) == 4
+    # corruption in transit: flip a byte of the FABRIC copy — the
+    # importer's decode raises, the exporter's arrays are untouched
+    faults.corrupt_array(fab.entries[key].data[0])
+    pool_c = KVTierPool(KVTierConfig.coerce(dict(TIER)), (2, 2, 8, 4),
+                        np.float32, registry=reg)
+    pool_c.admit_entry(fab.fetch(key))
+    with pytest.raises(faults.ChecksumError):
+        pool_c.decode(key, pool_c.entries[key].data)
+    assert np.array_equal(pool_b.decode(key, pool_b.entries[key].data)[0],
+                          k)               # earlier import unaffected
+    cnt = reg.snapshot()["counters"]
+    assert cnt["kv_fabric_exports"] == 2
+    assert cnt["kv_fabric_fetches"] == 3
+    assert cnt["kv_fabric_bytes_in"] > 0
+
+
+def test_fabric_capacity_evicts_oldest():
+    fab = KVFabric({"capacity_bytes": 3000})
+    k, v = page_payload(2)
+    keys = [bytes([i]) * 16 for i in range(4)]
+    for key in keys:
+        fab.publish(key, encode_entry(key, k, v, quantize=False,
+                                      page_dtype=np.float32))
+    assert fab.bytes <= 3000
+    assert fab.evicted > 0
+    assert not fab.has(keys[0])            # oldest went first
+    assert fab.has(keys[-1])
+
+
+def test_export_fault_raises_and_counts():
+    fab = KVFabric(True)
+    plan = FaultPlan([{"subsystem": "fabric", "mode": "error",
+                       "match": "export", "count": 1}])
+    faults.install_fault_plan(plan)
+    k, v = page_payload(3)
+    key = b"c" * 16
+    e = encode_entry(key, k, v, quantize=False, page_dtype=np.float32)
+    with pytest.raises(FabricExportError):
+        fab.publish(key, e)
+    assert fab.export_failures == 1
+    assert fab.publish(key, e)             # rule count exhausted
+
+
+# ------------------------------------------- engine export/admit verbs
+def warm_and_export(params, cfg, prompt, fabric, max_new=6, **over):
+    """Serve ``prompt`` on a fresh engine, export its chain, return
+    (engine, exported_count, keys)."""
+    eng = build_engine(params, cfg, **over)
+    eng.attach_fabric(fabric)
+    eng.submit("w", prompt, max_new_tokens=max_new)
+    eng.run()
+    keys = page_keys(prompt, eng.page_size)
+    n = eng.export_pages(keys)
+    return eng, n, keys
+
+
+def test_export_requires_kv_tier(gpt2_model):
+    cfg, params = gpt2_model
+    eng = serving_engine(params, cfg, prefix_cache=True, **KW)
+    with pytest.raises(ValueError):
+        eng.attach_fabric(KVFabric(True))
+    eng.shutdown()
+
+
+def test_warm_digest_carries_locations(gpt2_model, tmp_path):
+    """The located digest: HBM-warm keys report "hbm", demoted ones
+    their tier; warm_keys() stays the flat frozenset view."""
+    cfg, params = gpt2_model
+    eng = build_engine(params, cfg)
+    ps = shared_prefix_prompts(cfg.vocab_size, n=1, seed=3)
+    eng.submit("w", ps[0], max_new_tokens=4)
+    eng.run()
+    d = eng.warm_digest()
+    assert d and all(loc == "hbm" for loc in d.values())
+    assert eng.warm_keys() == frozenset(d)
+    # demote everything: locations flip to the tier
+    al = eng.allocator
+    eng._demote_warm_batch(al.oldest_warm(len(al.pool)))
+    d2 = eng.warm_digest()
+    assert d2 and all(loc == "host" for loc in d2.values())
+    assert set(d2) >= set(d) - {None}
+    assert_clean_engine(eng)
+    eng.shutdown()
+
+
+class TestMigratedAdmissionIdentity:
+    """Acceptance: a migrated admission (KV exported by one engine,
+    admitted by another) serves token-identically to the cold-prefill
+    oracle on the admitting engine, across every serving flavor."""
+
+    def _run(self, params, cfg, seed=0, max_new=6, **over):
+        ps = shared_prefix_prompts(cfg.vocab_size, n=3, seed=seed)
+        want = oracle(params, cfg, ps, max_new=max_new, **over)
+        fab = KVFabric(True)
+        src, n_exp, _keys = warm_and_export(
+            params, cfg, ps[0], fab, max_new=max_new, **over)
+        assert n_exp > 0
+        dst = build_engine(params, cfg, **over)
+        dst.attach_fabric(fab)
+        for i, p in enumerate(ps):
+            n_adm = dst.admit_fabric(page_keys(p, dst.page_size))
+            assert n_adm >= n_exp          # chain prefix is shared
+            dst.submit(f"m{i}", p, max_new_tokens=max_new)
+        out = dst.run()
+        assert [out[f"m{i}"] for i in range(len(ps))] == want
+        cnt = dst.registry.snapshot()["counters"]
+        # the migrated span was served by tier promotion, not prefill
+        assert cnt["kv_tier_promoted_pages"] > 0
+        assert cnt.get("kv_tier_fallback_events", 0) == 0
+        assert_clean_engine(src)
+        assert_clean_engine(dst)
+        src.shutdown()
+        dst.shutdown()
+
+    def test_plain(self, gpt2_model, devices):
+        cfg, params = gpt2_model
+        self._run(params, cfg, seed=1)
+
+    def test_chunked_decode(self, gpt2_model, devices):
+        cfg, params = gpt2_model
+        self._run(params, cfg, seed=2, decode_chunk=4)
+
+    def test_split_fuse(self, llama_model, devices):
+        cfg, params = llama_model
+        self._run(params, cfg, seed=3, prefill_chunk=8)
+
+    def test_speculative(self, gpt2_model, devices):
+        cfg, params = gpt2_model
+        self._run(params, cfg, seed=4,
+                  speculative={"enabled": True, "draft_tokens": 3})
+
+    def test_zero_inference(self, llama_model, devices):
+        cfg, params = llama_model
+        self._run(params, cfg, seed=5,
+                  zero_inference={"enabled": True, "tier": "host"})
+
+
+def test_checksum_failure_falls_back_to_reprefill(gpt2_model):
+    """An in-fabric corruption (the ``corrupt:`` fault leg) survives
+    fetch + admit and is caught by the admitting engine's
+    promotion-time crc — the request re-prefills token-identically
+    and the engine stays leak-free."""
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=2, seed=9)
+    want = oracle(params, cfg, ps)
+    fab = KVFabric(True)
+    plan = FaultPlan([{"subsystem": "fabric", "mode": "error",
+                       "match": "corrupt", "count": 2}])
+    faults.install_fault_plan(plan)
+    src, n_exp, _ = warm_and_export(params, cfg, ps[0], fab)
+    faults.clear_fault_plan(plan)
+    assert fab.corrupted == 2
+    dst = build_engine(params, cfg)
+    dst.attach_fabric(fab)
+    for i, p in enumerate(ps):
+        dst.admit_fabric(page_keys(p, dst.page_size))
+        dst.submit(f"m{i}", p, max_new_tokens=6)
+    out = dst.run()
+    assert [out[f"m{i}"] for i in range(len(ps))] == want
+    cnt = dst.registry.snapshot()["counters"]
+    assert cnt["kv_tier_checksum_failures"] > 0
+    assert cnt["kv_tier_fallback_events"] > 0
+    assert_clean_engine(dst)
+    src.shutdown()
+    dst.shutdown()
+
+
+def test_fetch_latency_respects_deadline(gpt2_model):
+    """A slow fabric (fetch latency rules) stops admitting at the
+    deadline — the partial prefix stays chain-valid, the rest
+    re-prefills."""
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=1, seed=10)
+    fab = KVFabric(True)
+    src, n_exp, keys = warm_and_export(params, cfg, ps[0], fab)
+    assert n_exp >= 3
+    plan = FaultPlan([{"subsystem": "fabric", "mode": "latency",
+                       "latency_s": 0.05, "match": "fetch"}])
+    faults.install_fault_plan(plan)
+    dst = build_engine(params, cfg)
+    dst.attach_fabric(fab)
+    n = dst.admit_fabric(keys, deadline=time.perf_counter() + 0.08)
+    faults.clear_fault_plan(plan)
+    assert 0 < n < n_exp                   # partial, not all-or-nothing
+    dst.submit("m", ps[0], max_new_tokens=6)
+    out = dst.run()
+    assert out["m"] == oracle(params, cfg, ps)[0]
+    assert_clean_engine(dst)
+    src.shutdown()
+    dst.shutdown()
+
+
+# --------------------------------------------------------- fleet level
+def make_fleet(params, cfg, n=2, fabric=True, engine_kw=None, **over):
+    kw = dict(KW, prefix_cache=True, kv_tier=dict(TIER))
+    kw.update(engine_kw or {})
+    return fleet_router(params, cfg, fleet={"replicas": n, **over},
+                        fabric=fabric, **kw)
+
+
+def test_fleet_migration_on_affinity_miss(gpt2_model):
+    """Warm one replica, then steer same-prefix traffic at the cold
+    one (affinity off → least-loaded spreads): the router migrates
+    the chain through the fabric and the miss serves by promotion,
+    token-identical."""
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=4, seed=11)
+    want = oracle(params, cfg, ps)
+    router = make_fleet(params, cfg, n=2, affinity=False,
+                        digest_refresh_steps=1)
+    router.submit("w", ps[0], max_new_tokens=6)
+    router.run()
+    for i, p in enumerate(ps):             # concurrent: load spreads
+        router.submit(f"m{i}", p, max_new_tokens=6)
+    out = router.run()
+    assert [out[f"m{i}"] for i in range(len(ps))] == want
+    fb = router.statusz()["fleet"]["fabric"]
+    assert fb["migrations"] >= 1
+    assert fb["exports"] > 0 and fb["fetches"] > 0
+    assert fb["migration_fallbacks"] == 0
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_fleet_migration_export_fault_falls_back(gpt2_model):
+    """An injected export error degrades the migration to re-prefill:
+    same tokens, fallback counted, zero leaks."""
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=4, seed=12)
+    want = oracle(params, cfg, ps)
+    router = fleet_router(
+        params, cfg,
+        fleet={"replicas": 2, "affinity": False,
+               "digest_refresh_steps": 1},
+        fabric=True,
+        faults={"rules": [{"subsystem": "fabric", "mode": "error",
+                           "match": "export", "count": 1}]},
+        prefix_cache=True, kv_tier=dict(TIER), **KW)
+    router.submit("w", ps[0], max_new_tokens=6)
+    router.run()
+    for i, p in enumerate(ps):
+        router.submit(f"m{i}", p, max_new_tokens=6)
+    out = router.run()
+    assert [out[f"m{i}"] for i in range(len(ps))] == want
+    fb = router.statusz()["fleet"]["fabric"]
+    assert fb["migration_fallbacks"] >= 1
+    assert fb["export_failures"] >= 1
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_cost_aware_affinity_prefers_hbm(gpt2_model):
+    """Satellite: on a warm-length tie the HBM-warm replica beats the
+    tier-warm one (a promotion is a DMA the HBM share is not)."""
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=3, seed=13)
+    router = make_fleet(params, cfg, n=2, digest_refresh_steps=1)
+    router.submit("w0", ps[0], max_new_tokens=4)
+    router.run()
+    router.refresh_digests()
+    warm = next(r for r in router.replicas.values() if r.digest)
+    other = next(r for r in router.replicas.values()
+                 if r.id != warm.id)
+    # fake a location tie-break: the other replica "covers" the same
+    # keys but on NVMe — routing must still pick the HBM-warm one
+    other.digest = {k: "nvme" for k in warm.digest}
+    router.submit("w1", ps[1], max_new_tokens=4)
+    assert "w1" in warm.assigned
+    # and with the HBM copy gone (all demoted to host), an NVMe-warm
+    # competitor of equal length loses to host on hbm-count 0 ties by
+    # load — but a LONGER warm prefix must always win regardless
+    other.digest = dict(list(warm.digest.items())[:1])
+    router.submit("w2", ps[2], max_new_tokens=4)
+    assert "w2" in warm.assigned
+    router.run()
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_migration_routed_counts_fabric_cover(gpt2_model):
+    """Satellite: a fabric-migratable hit is weighed above a cold
+    replica — counted when no digest is warm but the fabric covers
+    the prompt."""
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=2, seed=14)
+    fab = KVFabric(True)
+    src, n_exp, _ = warm_and_export(params, cfg, ps[0], fab)
+    assert n_exp > 0
+    router = make_fleet(params, cfg, n=2, fabric=fab,
+                        digest_refresh_steps=1000)
+    router.submit("m0", ps[1], max_new_tokens=4)
+    router.run()
+    cnt = router.registry.snapshot()["counters"]
+    assert cnt["fleet_migration_routed"] >= 1
+    assert router.statusz()["fleet"]["fabric"]["migrations"] >= 1
+    assert_clean(router)
+    router.shutdown()
+    src.shutdown()
+
+
+# ------------------------------------------------------ disaggregation
+def test_handoff_token_identity(gpt2_model):
+    """Prefill→decode handoff: every request runs its first token on
+    the prefill pool, migrates, and finishes on a decode replica —
+    token-identical to the single-engine oracle."""
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=4, seed=15)
+    want = oracle(params, cfg, ps)
+    router = make_fleet(params, cfg, n=3, digest_refresh_steps=1,
+                        roles={"prefill": 1, "decode": 2})
+    for i, p in enumerate(ps):
+        router.submit(f"d{i}", p, max_new_tokens=6)
+    out = router.run()
+    assert [out[f"d{i}"] for i in range(len(ps))] == want
+    st = router.statusz()
+    fb = st["fleet"]["fabric"]
+    assert fb["handoffs"] == len(ps)
+    assert fb["migrations"] >= 1           # the chain moved, not re-run
+    pre = next(r for r in router.replicas.values()
+               if r.role == "prefill")
+    # prefill replicas never decode past the boundary token
+    assert pre.completed == 0
+    roles = st["fleet"]["roles"]
+    assert roles["prefill"]["replicas"] == 1
+    assert roles["decode"]["replicas"] == 2
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_one_token_requests_skip_handoff(gpt2_model):
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=2, seed=16)
+    want = oracle(params, cfg, ps, max_new=1)
+    router = make_fleet(params, cfg, n=2, digest_refresh_steps=1,
+                        roles={"prefill": 1, "decode": 1})
+    for i, p in enumerate(ps):
+        router.submit(f"d{i}", p, max_new_tokens=1)
+    out = router.run()
+    assert [out[f"d{i}"] for i in range(len(ps))] == want
+    assert router.statusz()["fleet"]["fabric"]["handoffs"] == 0
+    # pure-prefill work landed on (and completed on) the prefill pool
+    pre = next(r for r in router.replicas.values()
+               if r.role == "prefill")
+    assert pre.completed == len(ps)
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_role_fallback_when_pool_empty(gpt2_model):
+    """Role preference degrades: with every decode replica dead, the
+    handoff leg falls back to the prefill pool instead of shedding."""
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=2, seed=17)
+    want = oracle(params, cfg, ps)
+    router = make_fleet(params, cfg, n=2, digest_refresh_steps=1,
+                        roles={"prefill": 1, "decode": 1})
+    dec = next(r for r in router.replicas.values()
+               if r.role == "decode")
+    router.kill(dec.id)
+    for i, p in enumerate(ps):
+        router.submit(f"d{i}", p, max_new_tokens=6)
+    out = router.run()
+    assert [out[f"d{i}"] for i in range(len(ps))] == want
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_mid_handoff_decode_kill_recovers(gpt2_model):
+    """Kill the decode replica while handed-off requests are queued or
+    zero-token in flight there: failover re-places the decode legs on
+    the survivors (prefill legs re-run from the prompt — their
+    boundary token was never surfaced) and every request still
+    resolves token-identical or typed.  Zero leaks and orphans,
+    including on the dead replica."""
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=4, seed=18)
+    want = {f"d{i}": t for i, t in
+            enumerate(oracle(params, cfg, ps))}
+    router = fleet_router(
+        params, cfg,
+        fleet={"replicas": 3, "digest_refresh_steps": 1,
+               "retry_budget": 2,
+               "roles": {"prefill": 1, "decode": 2}},
+        fabric=True,
+        faults={"rules": [{"subsystem": "replica", "mode": "error",
+                           "match": "r1", "count": 1, "after": 2}]},
+        prefix_cache=True, kv_tier=dict(TIER), **KW)
+    for i, p in enumerate(ps):
+        router.submit(f"d{i}", p, max_new_tokens=6)
+    out = router.run()
+    assert router.replicas["r1"].state == DEAD
+    for rid, res in out.items():
+        if isinstance(res, list):
+            assert res == want[rid]
+        else:
+            assert isinstance(res, (RequestFailed, RequestShed))
+    assert len(out) == len(ps)             # typed partition, no drops
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_drain_prefill_replica_migrates_warmth(gpt2_model):
+    """Drain the warm replica: its digest hints hand to the successor
+    AND its still-held pages stay exportable — the next same-prefix
+    admission on the successor migrates the chain out of the draining
+    replica instead of recomputing it."""
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=3, seed=19)
+    want = oracle(params, cfg, ps)
+    router = make_fleet(params, cfg, n=2, digest_refresh_steps=1)
+    router.submit("w", ps[0], max_new_tokens=6)
+    router.run()
+    router.refresh_digests()
+    warm = next(r for r in router.replicas.values() if r.digest)
+    router.drain(warm.id)
+    assert warm.exportable                 # drained but still exports
+    for i, p in enumerate(ps):
+        router.submit(f"m{i}", p, max_new_tokens=6)
+    out = router.run()
+    assert [out[f"m{i}"] for i in range(len(ps))] == want
+    fb = router.statusz()["fleet"]["fabric"]
+    assert fb["migrations"] >= 1
+    assert router.drained(warm.id)
+    router.rejoin(warm.id)
+    assert warm.exportable == {}
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_roles_compose_with_autoscaler(gpt2_model):
+    """Per-role scaling signals: spawns land in the pressured role,
+    scale-down never removes a role's last replica."""
+    from deepspeed_tpu.autoscale import FleetAutoscaler
+
+    cfg, params = gpt2_model
+    kw = dict(KW, prefix_cache=True, kv_tier=dict(TIER))
+    router = make_fleet(params, cfg, n=2, digest_refresh_steps=1,
+                        roles={"prefill": 1, "decode": 1})
+
+    def factory(rid, streamed=False):
+        return serving_engine(params, cfg, replica_id=rid,
+                              telemetry=MetricsRegistry(
+                                  namespace=f"dstpu_{rid}"), **kw)
+
+    auto = FleetAutoscaler(router, factory, autoscale={
+        "min_replicas": 2, "max_replicas": 4,
+        "eval_interval_steps": 1, "scale_up_queue_depth": 1.0,
+        "scale_down_queue_depth": 0.5, "up_after": 1, "down_after": 2,
+        "cooldown_s": 0.0})
+    # pressure the decode pool: long decode legs pile its queue up
+    ps = shared_prefix_prompts(cfg.vocab_size, n=6, seed=20)
+    for i, p in enumerate(ps):
+        router.submit(f"a{i}", p, max_new_tokens=8)
+    deadline = time.perf_counter() + 60.0
+    while router.has_work and time.perf_counter() < deadline:
+        auto.step()
+    st = auto.status()
+    assert st["scale_ups"] >= 1
+    spawned = [r for r in router.replicas.values()
+               if r.id not in ("r0", "r1")]
+    assert spawned and all(r.role in ("prefill", "decode")
+                           for r in spawned)
+    assert "role_queue_depth" in st["pressure"]
+    # idle: scale-down walks back but keeps >= 1 replica per role
+    deadline = time.perf_counter() + 60.0
+    while time.perf_counter() < deadline:
+        auto.step()
+        live = [r for r in router.replicas.values()
+                if r.state != DEAD]
+        if len(live) <= 2 and not auto._retiring:
+            break
+        time.sleep(0.002)
+    live = [r for r in router.replicas.values() if r.state != DEAD]
+    assert any(r.role == "prefill" for r in live)
+    assert any(r.role == "decode" for r in live)
+    assert_clean(router)
+    router.shutdown()
+
+
+# -------------------------------------------------------- introspection
+def test_statusz_and_dstpu_render(gpt2_model):
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=2, seed=21)
+    router = make_fleet(params, cfg, n=2, digest_refresh_steps=1,
+                        roles={"prefill": 1, "decode": 1},
+                        engine_kw={"slo": {"tiers": {"default": {
+                            "ttft_s": 30.0}}}})
+    for i, p in enumerate(ps):
+        router.submit(f"s{i}", p, max_new_tokens=4)
+    router.run()
+    st = router.statusz()
+    fb = st["fleet"]["fabric"]
+    assert {"exports", "fetches", "bytes_moved", "migrations",
+            "migration_fallbacks", "handoffs",
+            "entries"} <= set(fb)
+    assert {"prefill", "decode"} == set(st["fleet"]["roles"])
+    assert all("role" in r for r in st["fleet"]["replicas"])
+    assert st["slo"].get("by_role") and \
+        {"prefill", "decode"} == set(st["slo"]["by_role"])
+    cnt = st["metrics"]["counters"]
+    assert "kv_fabric_exports" in cnt
+    assert "fleet_kv_handoffs" in cnt
+    from tools.dstpu_top import render_fleet
+
+    lines = render_fleet(st, router.healthz())
+    joined = "\n".join(lines)
+    assert "fab " in joined and "handoff" in joined
+    assert "prefill" in joined and "decode" in joined
+    assert_clean(router)
+    router.shutdown()
+
+
+def test_fleet_rollup_by_role_unit():
+    snap = {"enabled": True, "default_tier": "d",
+            "tiers": {"d": {"window_finished": 2, "window_attained": 1,
+                            "goodput_tokens_per_s": 1.0,
+                            "burn_rates": {"60": 0.5},
+                            "lifetime": {"attained": 1},
+                            "in_flight": 0}}}
+    out = fleet_rollup([snap, snap], roles=["prefill", "decode"])
+    assert set(out["by_role"]) == {"prefill", "decode"}
+    assert out["tiers"]["d"]["window_finished"] == 4
+    # None roles (retired replicas) are skipped, not keyed
+    out = fleet_rollup([snap, snap], roles=["prefill", None])
+    assert set(out["by_role"]) == {"prefill"}
+    with pytest.raises(ValueError):
+        fleet_rollup([snap], roles=["a", "b"])
+
+
+def test_handoff_leaves_slo_per_role_meaningful(gpt2_model):
+    """Each leg classifies on its own replica: the prefill pool's
+    tracker sees the request's TTFT, the decode pool's its deadline —
+    the per-role rollup is the per-role scaling signal."""
+    cfg, params = gpt2_model
+    ps = shared_prefix_prompts(cfg.vocab_size, n=2, seed=22)
+    router = make_fleet(params, cfg, n=2, digest_refresh_steps=1,
+                        roles={"prefill": 1, "decode": 1},
+                        engine_kw={"slo": {"tiers": {"default": {
+                            "ttft_s": 30.0, "deadline_s": 60.0}}}})
+    for i, p in enumerate(ps):
+        router.submit(f"s{i}", p, max_new_tokens=4)
+    router.run()
+    by_role = router.statusz()["slo"]["by_role"]
+    for role in ("prefill", "decode"):
+        life = by_role[role]["tiers"]["default"]["lifetime"]
+        assert life.get("attained", 0) + life.get("violated", 0) \
+            == len(ps)
+    assert_clean(router)
+    router.shutdown()

@@ -305,6 +305,244 @@ def fleet_main(args) -> int:
     return 0 if ok else 1
 
 
+DISAGG_FAULT_RULES = [
+    # the first fabric EXPORT opportunity fails: that migration falls
+    # back to re-prefill (counted, never wrong)
+    {"subsystem": "fabric", "mode": "error", "match": "export",
+     "count": 1},
+    # fetch-latency spikes push migrations toward their timeout
+    {"subsystem": "fabric", "mode": "latency", "match": "fetch",
+     "latency_s": 0.01, "count": 3},
+    # corrupt the first two pages published INTO the fabric after
+    # their checksums were recorded: the admitting replica's
+    # promotion-time crc must catch them and re-prefill (the
+    # corrupt-after-checksum leg)
+    {"subsystem": "fabric", "mode": "error", "match": "corrupt",
+     "count": 2},
+    # kill decode replica r2 mid-traffic — handed-off decode legs
+    # queued or zero-token in flight there re-place on the survivors,
+    # prefill legs re-run from the prompt
+    {"subsystem": "replica", "mode": "error", "match": "r2",
+     "count": 1, "after": 4},
+    # one queue-pressure burst (consumed by the traffic generator)
+    {"subsystem": "burst", "rate": 1.0, "count": 1},
+]
+
+
+def disagg_main(args) -> int:
+    """--disagg: the disaggregated prefill/decode + KV-fabric soak
+    (ISSUE 12 acceptance).  A roles-split fleet (1 prefill, 2 decode)
+    serves phased shared-prefix traffic while the seeded schedule
+    fails fabric exports, delays fetches, corrupts in-fabric pages
+    after their checksums, and kills a decode replica mid-handoff;
+    the script also drains + rejoins the ONLY prefill replica (role
+    fallback).  Asserts: every completed request token-identical to a
+    single-engine oracle, typed partition with zero orphans, zero
+    leaks on every replica (dead one included), handoffs + migrations
+    actually happened, and the corruption was caught by the importer's
+    checksum.  Stamps DISAGG_SOAK.json, gated by bench_gate."""
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from deepspeed_tpu import faults
+    from deepspeed_tpu.fleet import DEAD, DRAINING, fleet_router
+    from deepspeed_tpu.inference.serving import (RequestFailed,
+                                                 RequestShed,
+                                                 serving_engine)
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.utils.evidence import atomic_write_json
+
+    t_start = time.perf_counter()
+    cfg = gpt2.GPT2Config.tiny(dim=64, n_layers=2, n_heads=4,
+                               max_seq_len=128)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    waves, burst, expired = build_traffic(cfg.vocab_size)
+    kw = dict(max_batch=2, page_size=8, num_pages=24, max_seq=64,
+              prefill_bucket=8, prefix_cache=True,
+              kv_tier={"host_pool_bytes": 64 << 20})
+
+    # ---- single-engine fault-free oracle
+    oracle_eng = serving_engine(params, cfg, **kw)
+    distinct, seen = [], set()
+    for p in [p for w in waves for p in w] + burst + expired:
+        t = tuple(p)
+        if t not in seen:
+            seen.add(t)
+            distinct.append(p)
+    for i, p in enumerate(distinct):
+        oracle_eng.submit(f"o{i}", p, max_new_tokens=MAX_NEW)
+    oracle_out = oracle_eng.run()
+    oracle = {tuple(p): oracle_out[f"o{i}"]
+              for i, p in enumerate(distinct)}
+    oracle_eng.shutdown()
+
+    router = fleet_router(
+        params, cfg,
+        fleet={"replicas": 3, "retry_budget": 2,
+               "shed_queue_depth": 10, "digest_refresh_steps": 2,
+               "roles": {"prefill": 1, "decode": 2}},
+        fabric=True,
+        slo={"tiers": {
+            "interactive": {"ttft_s": 60.0, "deadline_s": 300.0},
+            "expired": {"deadline_s": 0.001, "target": 0.5}},
+            "default_tier": "interactive"},
+        tracing={"ring_capacity": 65536},
+        faults={"seed": args.seed, "rules": DISAGG_FAULT_RULES},
+        shed_queue_depth=4, shed_expired_deadline=True, **kw)
+
+    prompts_by_id = {}
+    rid = 0
+
+    def submit(p, tier=None):
+        nonlocal rid
+        req_id = f"r{rid:02d}"
+        rid += 1
+        prompts_by_id[req_id] = p
+        router.submit(req_id, p, max_new_tokens=MAX_NEW, tier=tier)
+        return req_id
+
+    t_kill = None
+    salvaged = set()
+    recovery_s = None
+
+    def drive():
+        nonlocal t_kill, salvaged, recovery_s
+        steps = 0
+        while router.has_work:
+            router.step()
+            if t_kill is None and router.last_failover is not None:
+                t_kill = router.last_failover["t"]
+                salvaged = set(router.last_failover["resubmitted"])
+            if t_kill is not None and recovery_s is None and \
+                    all(k in router.finished for k in salvaged):
+                recovery_s = time.perf_counter() - t_kill
+            steps += 1
+            if steps > STEP_CAP or \
+                    time.perf_counter() - t_start > WALL_CAP_S:
+                return False
+        return True
+
+    hang = False
+    drain_ok = True
+    for w, wave in enumerate(waves):
+        for p in wave:
+            submit(p)
+        _delay, fire = faults.poll("burst")
+        if fire is not None:
+            for p in burst:
+                submit(p)
+        hang = hang or not drive()
+        if w == 1:
+            # drain + rejoin the ONLY prefill replica mid-soak: role
+            # preference must degrade (prefill legs fall back to the
+            # decode pool) and come back after rejoin
+            router.drain("r0")
+            hang = hang or not drive()
+            drain_ok = drain_ok and router.drained("r0") and \
+                router.replicas["r0"].state == DRAINING
+            router.rejoin("r0")
+            drain_ok = drain_ok and \
+                router.replicas["r0"].state == "healthy"
+    for p in expired:
+        submit(p, tier="expired")
+    time.sleep(0.05)
+    hang = hang or not drive()
+    if recovery_s is None and t_kill is not None:
+        recovery_s = time.perf_counter() - t_kill
+
+    # ---- reconcile
+    finished = dict(router.finished)
+    completed = {k: v for k, v in finished.items()
+                 if isinstance(v, list)}
+    failed = {k: v for k, v in finished.items()
+              if isinstance(v, RequestFailed)}
+    shed = {k: v for k, v in finished.items()
+            if isinstance(v, RequestShed)}
+    mismatched = [k for k, v in completed.items()
+                  if v != oracle[tuple(prompts_by_id[k])]]
+    leaks = router.check_leaks()
+    orphaned = router.orphaned()
+    cnt = router.registry.snapshot()["counters"]
+    status = router.statusz()
+    fab = status["fleet"]["fabric"]
+    checksum_caught = sum(
+        int(rep.engine.registry.snapshot()["counters"].get(
+            "kv_tier_checksum_failures", 0))
+        for rep in router.replicas.values())
+    checks = {
+        "typed_results_partition":
+            len(finished) == rid and
+            len(completed) + len(failed) + len(shed) == rid,
+        "router_counts":
+            router._n_completed == len(completed) and
+            router._n_failed == len(failed) and
+            router._n_shed == len(shed),
+        "registry_counters":
+            int(cnt.get("fleet_completed_requests", 0)) ==
+            len(completed) and
+            int(cnt.get("fleet_failed_requests", 0)) == len(failed)
+            and int(cnt.get("fleet_shed_requests", 0)) == len(shed),
+        "failover_happened":
+            router.replicas["r2"].state == DEAD and
+            int(cnt.get("fleet_failovers", 0)) == 1,
+        "handoffs_happened": fab["handoffs"] > 0,
+        "migrations_happened": fab["migrations"] >= 1,
+        "export_faults_fell_back":
+            fab["export_failures"] >= 1 and
+            fab["migration_fallbacks"] >= 1,
+        "corruption_caught_by_importer":
+            fab["corrupted"] >= 1 and checksum_caught >= 1,
+        "drain_rejoin": drain_ok,
+    }
+    plan_snap = router._fault_plan.snapshot()
+    router.shutdown()
+    ok = (not mismatched and not hang and not leaks and not orphaned
+          and all(checks.values()) and plan_snap["injected"] > 0
+          and recovery_s is not None and recovery_s < 60.0)
+    stamp = {
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(),
+        "model": "gpt2-tiny",
+        "seed": args.seed,
+        "replicas": 3,
+        "roles": {"prefill": 1, "decode": 2},
+        "ok": ok,
+        "submitted": rid,
+        "completed": len(completed),
+        "failed": len(failed),
+        "shed": len(shed),
+        "shed_by_reason": dict(router._shed_by_reason),
+        "resubmits": router._n_resubmits,
+        "handoffs": fab["handoffs"],
+        "migrations": fab["migrations"],
+        "migration_fallbacks": fab["migration_fallbacks"],
+        "fabric_bytes_moved": fab["bytes_moved"],
+        "checksum_caught": checksum_caught,
+        "mismatched_requests": len(mismatched),
+        "mismatched_ids": mismatched[:8],
+        "hang": int(hang),
+        "leak_count": len(leaks),
+        "leaks": leaks[:8],
+        "orphaned_requests": len(orphaned),
+        "recovery_s": round(recovery_s, 3)
+        if recovery_s is not None else None,
+        "accounting_ok": int(all(checks.values())),
+        "accounting": checks,
+        "replica_states": {r["replica"]: r["state"]
+                           for r in status["fleet"]["replicas"]},
+        "injected": plan_snap,
+        "duration_s": round(time.perf_counter() - t_start, 2),
+    }
+    atomic_write_json(stamp, args.json_out)
+    print(json.dumps({k: v for k, v in stamp.items()
+                      if k not in ("injected",)},
+                     indent=1, sort_keys=True))
+    print("→", args.json_out)
+    return 0 if ok else 1
+
+
 ELASTIC_FAULT_RULES = [
     # the FIRST autoscaler spawn attempt: engine-factory failure (the
     # scale-up aborts, is counted, and retries next evaluation)
@@ -649,15 +887,24 @@ def main():
                          "faults, rolling update with a mid-rollout "
                          "kill, burn-trip rollback); stamps "
                          "ELASTIC_SOAK.json by default")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the disaggregated prefill/decode + KV "
+                         "fabric soak (fabric export/fetch/corrupt "
+                         "faults + mid-handoff decode-replica kill + "
+                         "prefill-pool drain); stamps "
+                         "DISAGG_SOAK.json by default")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.json_out is None:
         args.json_out = os.path.join(
             REPO, "ELASTIC_SOAK.json" if args.elastic
+            else "DISAGG_SOAK.json" if args.disagg
             else "FLEET_SOAK.json" if args.fleet
             else "CHAOS_SOAK.json")
     if args.elastic:
         return elastic_main(args)
+    if args.disagg:
+        return disagg_main(args)
     if args.fleet:
         return fleet_main(args)
 

@@ -67,6 +67,21 @@ def render_fleet(status: dict, health: dict | None = None) -> list:
              f"  queue {fl.get('queue_depth', 0)}"
              f"  in-flight {fl.get('in_flight', 0)}"
              f"  orphaned {fl.get('orphaned', 0)}")
+    fab = fl.get("fabric")
+    if fab:
+        line = (f"fab   exp {fab.get('exports', 0)}"
+                f"  fetch {fab.get('fetches', 0)}"
+                f"  moved {fab.get('bytes_moved', 0) / 2**20:.1f}MB"
+                f"  mig {fab.get('migrations', 0)}"
+                f"  fb {fab.get('migration_fallbacks', 0)}"
+                f"  handoff {fab.get('handoffs', 0)}")
+        roles = fl.get("roles") or {}
+        if roles:
+            line += "  | " + "  ".join(
+                f"{ro} q={r.get('queue_depth', 0)}"
+                f" ({r.get('routable', 0)}/{r.get('replicas', 0)})"
+                for ro, r in sorted(roles.items()))
+        L.append(line)
     el = status.get("elastic", {})
     if el.get("enabled"):
         ro = el.get("rollout") or {}
@@ -86,7 +101,8 @@ def render_fleet(status: dict, health: dict | None = None) -> list:
             line += f"  ROLLED-BACK {ro.get('version')}"
         L.append(line)
     L.append("-" * 78)
-    L.append(f"{'replica':<9}{'state':<13}{'ver':<6}{'queue':>6}"
+    L.append(f"{'replica':<9}{'state':<13}{'role':<9}{'ver':<6}"
+             f"{'queue':>6}"
              f"{'slots':>6}{'shed%':>7}{'failed':>7}{'aff':>5}"
              f"{'digest':>7}  reasons")
     for r in fl.get("replicas", []):
@@ -95,6 +111,7 @@ def render_fleet(status: dict, health: dict | None = None) -> list:
             reasons = (reasons + f" stall {r['stalled_for_s']:.1f}s"
                        ).strip()
         L.append(f"{r['replica']:<9}{r['state']:<13}"
+                 f"{str(r.get('role') or '-')[:8]:<9}"
                  f"{str(r.get('version', '-'))[:5]:<6}"
                  f"{r.get('queue_depth', 0):>6}"
                  f"{r.get('active_slots', 0):>6}"

@@ -116,6 +116,22 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py \
 timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_fleet.py --cpu \
   --elastic --json-out "$REPO/ELASTIC_BENCH.json" >/dev/null 2>&1 || true
 
+# disagg soak: the prefill/decode roles fleet + KV fabric under
+# seeded fabric faults (export error, fetch latency, in-fabric
+# corruption after checksum) and a mid-handoff decode-replica kill,
+# plus a drain/rejoin of the only prefill replica — token identity,
+# corruption caught by the importer's crc, zero leaks/orphans.
+# Stamps DISAGG_SOAK.json, gated by bench_gate.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py \
+  --cpu --disagg --json-out "$REPO/DISAGG_SOAK.json" >/dev/null 2>&1 || true
+
+# disagg bench: the KV-fabric A/Bs — affinity-miss TTFT with
+# migration on/off (gated: speedup >= 1, mismatched = 0) and goodput
+# under prefill-heavy vs decode-heavy mixes with/without the role
+# split.  Stamps DISAGG_BENCH.json, gated by bench_gate.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_fleet.py --cpu \
+  --disagg --json-out "$REPO/DISAGG_BENCH.json" >/dev/null 2>&1 || true
+
 # bench regression gate: AFTER the stamps above, diff the evidence
 # files against the committed BENCH_BASELINE.json and leave a verdict
 # in BENCH_GATE.json — the perf trajectory as an enforced contract.
