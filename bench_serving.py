@@ -34,6 +34,14 @@ honestly (``truncated: true``) rather than burning the window.
         # pair whose rows record weight bytes streamed PER GENERATED
         # TOKEN (the ZeRO-Inference amortization contract); the slow
         # lane stamps this as SPEC_BENCH.json
+    python bench_serving.py --tp 2
+        # tensor-parallel A/B: the same traffic on a 1-device engine
+        # vs an N-device model-axis mesh (GSPMD shards wq/wk/wv/w1/w3
+        # column-wise, wo/w2 row-wise, KV heads over the mesh) —
+        # decode tokens/s, TTFT and a token-identity gate
+        # (mismatched_requests must be 0; sharding is an execution
+        # strategy).  With --cpu the devices are virtual host CPUs;
+        # the slow lane stamps this as TP_BENCH.json
     python bench_serving.py --kv-tier
         # eviction-churn workload (--prefix-groups distinct system
         # prompts revisited in a second pass, over a KV pool sized to
@@ -164,7 +172,7 @@ def build_prompts(args, cfg):
 
 def measure_config(name, args, params, mod, cfg, phase, prompts,
                    zero_inference=None, prefix_cache=None,
-                   speculative=None, kv_tier=None):
+                   speculative=None, kv_tier=None, tp=0):
     """Build one engine flavor, warm it, drive the request stream under
     the wall-clock cap; returns ``(evidence row, finished outputs)`` —
     the outputs feed the kv-tier A/B's token-identity check."""
@@ -223,12 +231,21 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
             # identity gate compares the on arm against this row
             num_pages = (args.slots * (-(-max_seq // 16))
                          + args.prefix_groups * prefix_pages + 8)
+    mesh = None
+    if tp and tp > 1:
+        # the TP A/B arm: this engine spans tp devices on the model
+        # axis (CPU: virtual host devices forced in main before the
+        # backend came up)
+        from deepspeed_tpu.topology import MeshSpec
+
+        mesh = MeshSpec.build({"model": tp},
+                              devices=jax.devices()[:tp])
     engine = init_serving(
         params, cfg, config=config or None, max_batch=args.slots,
         page_size=16, num_pages=num_pages,
         max_seq=max_seq, prefill_bucket=bucket,
         decode_chunk=args.decode_chunk, prefill_chunk=args.prefill_chunk,
-        weight_dtype=args.weight_dtype)
+        weight_dtype=args.weight_dtype, mesh=mesh)
 
     rng = np.random.default_rng(1)
     phase(f"[{name}] warmup (compile prefill + decode)")
@@ -418,8 +435,17 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
                 round(delta("zi_bytes_uploaded") / generated, 1)
                 if generated else None),
         }
+    if args.tp:
+        row["detail"]["tp"] = {
+            "tp": max(tp, 1),
+            "mesh": engine.mesh_info(),
+        }
     outputs = {str(k): list(map(int, v)) for k, v in out.items()}
     del engine
+    if mesh is not None:
+        from deepspeed_tpu.topology import set_current_mesh
+
+        set_current_mesh(None)
     return row, outputs
 
 
@@ -475,6 +501,15 @@ def main():
     ap.add_argument("--draft-tokens", type=int, default=4,
                     help="speculation window K for the --speculative "
                          "A/B (drafts per verify sweep)")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="A/B the same traffic on a 1-device engine vs "
+                         "an N-device model-axis (tensor-parallel) "
+                         "mesh — decode tokens/s, TTFT, and a token-"
+                         "identity gate (sharding is an execution "
+                         "strategy, so tokens must match exactly).  "
+                         "With --cpu the N virtual host devices are "
+                         "forced before the backend comes up; the slow "
+                         "lane stamps this as TP_BENCH.json")
     ap.add_argument("--zero-inference", action="store_true",
                     help="also measure the ZeRO-Inference weight-streamed "
                          "engine (host-tier layer streaming) next to the "
@@ -508,6 +543,17 @@ def main():
     ap.add_argument("--json-out", default=os.path.join(REPO,
                                                        "SERVING_BENCH.json"))
     args = ap.parse_args()
+    if args.tp and (args.kv_tier or args.prefix_cache
+                    or args.speculative or args.zero_inference):
+        raise SystemExit("--tp is its own A/B")
+    if args.tp and args.tp < 2:
+        raise SystemExit("--tp needs N >= 2 (the A/B is 1 vs N devices)")
+    if args.tp and args.cpu:
+        # N virtual host devices for the sharded arm — must land before
+        # the first backend touch below
+        from deepspeed_tpu.mesh import host_device_count
+
+        host_device_count(args.tp)
     if args.kv_tier and (args.prefix_cache or args.speculative
                          or args.zero_inference):
         raise SystemExit("--kv-tier is its own A/B")
@@ -539,9 +585,15 @@ def main():
     phase(f"backend={jax.default_backend()} — init params")
     params = mod.init_params(jax.random.PRNGKey(0), cfg)
 
-    # (name, zero_inference, prefix_cache, speculative, kv_tier) per
-    # engine flavor
-    configs = [("resident", None, None, None, None)]
+    # (name, zero_inference, prefix_cache, speculative, kv_tier, tp)
+    # per engine flavor
+    configs = [("resident", None, None, None, None, 0)]
+    if args.tp:
+        # same model, same traffic: the 1-device oracle vs the
+        # N-device model-axis mesh — sharding is an execution
+        # strategy, so the identity gate below must see 0 mismatches
+        configs = [("tp1", None, None, None, None, 0),
+                   (f"tp{args.tp}", None, None, None, None, args.tp)]
     if args.prefix_cache:
         configs = [("prefix_off", None, {"enabled": False}, None, None),
                    ("prefix_on", None, {"enabled": True}, None, None)]
@@ -590,13 +642,15 @@ def main():
            "backend": jax.default_backend(), "partial": True, "rows": []}
     commit(out, args.json_out)
     outputs_by_config = {}
-    for name, zi, pc, spec, kvt in configs:
+    for cfg_row in configs:
+        name, zi, pc, spec, kvt, *rest = cfg_row
+        tp = rest[0] if rest else 0
         row = outs = None
         for rep in range(max(args.repeats, 1)):
             cand, c_outs = measure_config(
                 name, args, params, mod, cfg, phase, prompts,
                 zero_inference=zi, prefix_cache=pc, speculative=spec,
-                kv_tier=kvt)
+                kv_tier=kvt, tp=tp)
             if row is None or cand["value"] > row["value"]:
                 row, outs = cand, c_outs
         outputs_by_config[name] = outs
@@ -652,6 +706,28 @@ def main():
                 "mean_accepted_len": zon["detail"]["speculative"][
                     "mean_accepted_len"],
             }
+    if args.tp and len(out["rows"]) == 2:
+        one, sh = out["rows"]
+        o_one = outputs_by_config["tp1"]
+        o_sh = outputs_by_config[f"tp{args.tp}"]
+        # identity over the requests both arms completed (the wall
+        # cap can truncate different subsets)
+        both = sorted(set(o_one) & set(o_sh))
+        mismatched = sum(1 for k in both if o_one[k] != o_sh[k])
+        out["tp_ab"] = {
+            "tp": args.tp,
+            "tokens_per_s_1dev": one["value"],
+            "tokens_per_s_tp": sh["value"],
+            "speedup": (round(sh["value"] / one["value"], 3)
+                        if one["value"] else None),
+            "ttft_1dev_ms": one["detail"].get("ttft_ms"),
+            "ttft_tp_ms": sh["detail"].get("ttft_ms"),
+            "compared_requests": len(both),
+            # THE gate: sharding is an execution strategy — any
+            # mismatch is a correctness bug
+            "mismatched_requests": mismatched,
+            "mesh": sh["detail"]["tp"]["mesh"],
+        }
     if args.kv_tier and len(out["rows"]) == 3:
         off_r, on_r, _ref_r = out["rows"]
         off_d, on_d = off_r["detail"], on_r["detail"]

@@ -189,7 +189,9 @@ def reduce_scatter(x, axis_name: str, axis: int = 0,
         raise ValueError("reduce_scatter supports SUM/AVG")
     out = jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
     if op is ReduceOp.AVG:
-        out = out / jax.lax.axis_size(axis_name)
+        from deepspeed_tpu.mesh import axis_size
+
+        out = out / axis_size(axis_name)
     return out
 
 
@@ -227,7 +229,7 @@ def rank_in(axis_name: str):
 # --------------------------------------------------------------------------
 def mesh_all_reduce(x: jax.Array, mesh: Mesh, op: ReduceOp = ReduceOp.SUM) -> jax.Array:
     """Reduce a per-device-sharded array to a replicated one."""
-    from jax.experimental.shard_map import shard_map
+    from deepspeed_tpu.mesh import shard_map
 
     axes = mesh.axis_names
 

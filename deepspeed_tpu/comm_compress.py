@@ -10,7 +10,8 @@ plain ``jax.jit`` — GSPMD decides the collectives from shardings, and by
 the time gradients exist they are ALREADY averaged over the data axis in
 f32.  There is nothing left to compress.  To put int8 on the wire the
 gradient exchange must be explicit, which means the loss/grad computation
-runs under ``jax.shard_map`` with the batch manually sharded over the
+runs under ``shard_map`` (the version-portable
+:func:`deepspeed_tpu.mesh.shard_map`) with the batch manually sharded over the
 ``data`` axis: each device computes grads of its LOCAL microbatch (no
 implicit psum), and the reduction is ours to implement.
 
@@ -52,6 +53,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.mesh import axis_size, shard_map
 from deepspeed_tpu.ops.quant import dequantize, quantize, \
     quantized_reduce_scatter
 from deepspeed_tpu.topology import MeshSpec
@@ -151,7 +153,7 @@ def quantized_all_reduce(x: jnp.ndarray, axis_name: str = AXIS,
     all-gather of the reduced shard — every hop carries ~1/4 the bytes of
     the f32 ring all-reduce GSPMD would emit.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     flat = _pad_to(x.reshape(-1).astype(jnp.float32), world * _GROUP)
     shard = flat.shape[0] // world
     groups = shard // _GROUP
@@ -256,7 +258,7 @@ def local_grad_shardmap(grad_fn: Callable, ms: MeshSpec, accum: int,
         return grads, jax.lax.pmean(loss, AXIS)
 
     pspec = lambda tree: jax.tree.map(lambda _: P(), tree)
-    return lambda params, batch: jax.shard_map(
+    return lambda params, batch: shard_map(
         f, mesh=ms.mesh,
         in_specs=(pspec(params), jax.tree.map(lambda _: P(AXIS), batch)),
         out_specs=(pspec(params), P()),

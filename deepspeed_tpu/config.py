@@ -600,6 +600,15 @@ class FleetConfig:
     ``fatal_stall_s``: a replica stalled longer than this is treated as
     dead (failover) rather than waited out.
 
+    ``tp``: devices per replica on the ``model`` (tensor-parallel)
+    axis.  With ``tp > 1`` :func:`~deepspeed_tpu.fleet.fleet_router`
+    builds each replica over its own ``tp``-device model-axis mesh
+    (replica i takes the i-th device slice, wrapping around when
+    ``replicas * tp`` exceeds the host's device count — in-process
+    replicas may share chips), so a fleet replica is itself a
+    TP-sharded engine, token-identical to the single-device build.
+    1 = classic unsharded replicas.
+
     ``roles``: disaggregated prefill/decode serving — a dict
     ``{"prefill": n, "decode": m}`` (n + m == replicas) splits the ring
     into a prefill-specialized pool and a decode-specialized pool.  New
@@ -614,6 +623,7 @@ class FleetConfig:
     """
 
     replicas: int = 2
+    tp: int = 1
     affinity: bool = True
     retry_budget: int = 2
     quarantine_after: int = 3
@@ -632,6 +642,9 @@ class FleetConfig:
             raise ValueError(
                 f"fleet.replicas must be >= 1, got {f.replicas}")
         f.affinity = bool(f.affinity)
+        f.tp = int(f.tp)
+        if f.tp < 1:
+            raise ValueError(f"fleet.tp must be >= 1, got {f.tp}")
         if f.roles is not None:
             if not isinstance(f.roles, dict):
                 raise ValueError(

@@ -1417,6 +1417,9 @@ class FleetRouter:
                 if e._n_submitted else 0.0,
                 "affinity_hits": n_aff,
                 "digest_pages": len(rep.digest),
+                "mesh": (e.mesh_info() if hasattr(e, "mesh_info")
+                         else {"sharded": False, "devices": 1,
+                               "axes": {}, "tp": 1, "ep": 1}),
                 "reasons": rep.health_reasons,
             }
             if rep.stall_until > now:
@@ -1450,6 +1453,16 @@ class FleetRouter:
                                if rep.state != DEAD),
             "in_flight": len(self.requests),
             "orphaned": len(self.orphaned()),
+            # a TP-sharded fleet is visibly sharded: the configured
+            # devices-per-replica plus how many live replicas actually
+            # run on a multi-device mesh (rows carry the per-replica
+            # axes; DEAD replicas excluded — their engines are down)
+            "mesh": {
+                "tp": self.cfg.tp,
+                "sharded_replicas": sum(
+                    1 for r in reps
+                    if r["mesh"]["sharded"] and r["state"] != DEAD),
+            },
         }
         if self._fabric is not None:
             fleet["fabric"] = {
@@ -1550,6 +1563,28 @@ class FleetRouter:
             pass
 
 
+def tp_replica_mesh(index: int, tp: int, devices=None):
+    """The ``tp``-device model-axis mesh for fleet replica ``index``:
+    consecutive device slices, wrapping around when ``index * tp`` runs
+    past the host's device count (in-process replicas may share chips —
+    the virtual-device test mesh does, a real fleet sizes
+    ``replicas * tp`` to the slice).  The autoscaler's engine factory
+    uses this to cold-start TP-sharded replicas onto the same layout."""
+    import jax
+
+    from deepspeed_tpu.topology import MeshSpec
+
+    devs = list(devices if devices is not None else jax.devices())
+    tp = int(tp)
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if tp > len(devs):
+        raise ValueError(
+            f"fleet.tp {tp} exceeds the host's {len(devs)} devices")
+    picked = [devs[(index * tp + j) % len(devs)] for j in range(tp)]
+    return MeshSpec.build({"model": tp}, devices=picked)
+
+
 def fleet_router(params, cfg, *, fleet=None, telemetry=None,
                  tracing=None, faults=None, fabric=None,
                  engine_builder=None, **engine_kw) -> FleetRouter:
@@ -1593,6 +1628,12 @@ def fleet_router(params, cfg, *, fleet=None, telemetry=None,
             # /metrics scrape without name collisions
             kw_i.setdefault("telemetry", MetricsRegistry(
                 namespace=f"dstpu_r{i}"))
+            if fc.tp > 1:
+                # fleet.tp: every replica is itself a TP-sharded engine
+                # over its own model-axis device slice (an explicit
+                # mesh= in engine_kw still wins — but then all replicas
+                # share it)
+                kw_i.setdefault("mesh", tp_replica_mesh(i, fc.tp))
             engines.append(build(
                 params, cfg, replica_id=f"r{i}", tracing=tracer,
                 faults=plan, **kw_i))

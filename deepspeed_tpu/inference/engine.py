@@ -105,12 +105,56 @@ def init_inference(model: Any = None, *, apply_fn: Optional[Callable] = None,
                            quant_group_size=quant_group_size)
 
 
+def serving_mesh_from_config(config: Any) -> Optional[MeshSpec]:
+    """Resolve the serving TP mesh from a config ``mesh`` block.
+
+    Serving shards params/KV over the ``model`` (TP) and ``expert``
+    (EP) axes; the ``data`` axis is a training concept (one replica
+    serves its whole batch), so a ``data: -1`` left at its default is
+    read as 1 here and the engine spans exactly
+    ``pipe*expert*seq*model`` devices from the front of
+    ``jax.devices()`` — e.g. ``{"mesh": {"model": 2}}`` builds a
+    2-device TP replica no matter how many chips the host exposes
+    (the fleet hands later device slices to later replicas).  Returns
+    None when every non-data axis is 1 (the single-device engine)."""
+    mc = config.mesh
+    sizes = {"pipe": mc.pipe, "data": mc.data, "expert": mc.expert,
+             "seq": mc.seq, "model": mc.model}
+    if sizes["data"] not in (1, -1):
+        # a reused training config: data parallelism is meaningless for
+        # one serving replica (the fleet is the data axis here), so an
+        # explicit data>1 must not multiply the device demand 8x or
+        # trip the device-count check on a small host
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.warning(
+            "serving mesh: ignoring mesh.data=%s — one serving replica "
+            "has no data axis (replicate via the fleet instead)",
+            sizes["data"])
+    sizes["data"] = 1
+    if all(int(v) <= 1 for v in sizes.values()):
+        return None
+    total = 1
+    for v in sizes.values():
+        total *= int(v)
+    devs = jax.devices()
+    if total > len(devs):
+        raise ValueError(
+            f"serving mesh {sizes} needs {total} devices, host exposes "
+            f"{len(devs)}")
+    return MeshSpec.build(sizes, devices=devs[:total])
+
+
 def init_serving(params, model_config, *, config: Any = None,
                  mesh: Optional[MeshSpec] = None, **kw):
     """Serving counterpart of :func:`init_inference` (ref: the reference
     serves through ``init_inference`` + DeepSpeed-MII's serve loop):
     build the continuous-batching engine for a model-family config,
     honoring a DeepSpeed-style JSON config.
+
+    A ``mesh`` block in ``config`` builds a TP/EP-sharded serving
+    replica (see :func:`serving_mesh_from_config` for how the axis
+    sizes are read); an explicit ``mesh=`` kw still wins.
 
     A ``zero_inference`` block in ``config`` routes to the weight-
     streamed ZeRO-Inference engine
@@ -142,6 +186,11 @@ def init_serving(params, model_config, *, config: Any = None,
 
     if isinstance(config, dict):
         config = Config.from_dict(config)
+    if mesh is None and config is not None:
+        # `mesh` block → TP/EP-sharded serving replica (an explicit
+        # mesh= kw still wins); see serving_mesh_from_config for the
+        # serving reading of the axis sizes
+        mesh = serving_mesh_from_config(config)
     if config is not None and config.zero_inference.enabled:
         kw.setdefault("zero_inference", config.zero_inference)
     if config is not None and config.prefix_cache.enabled:

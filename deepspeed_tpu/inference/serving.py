@@ -2371,6 +2371,24 @@ class ServingEngine:
         engine before the abort lands."""
         self._watchdog = watchdog
 
+    def mesh_info(self) -> Dict[str, Any]:
+        """The /statusz ``mesh`` block: is this replica an SPMD-sharded
+        engine, and over what?  Axis names/sizes plus the device count
+        it spans — a TP-sharded fleet is visibly sharded (``dstpu_top``
+        renders the tp column from this)."""
+        ms = self._mesh
+        if ms is None:
+            return {"sharded": False, "devices": 1, "axes": {},
+                    "tp": 1, "ep": 1}
+        axes = {a: int(s) for a, s in ms.sizes.items() if int(s) > 1}
+        return {
+            "sharded": any(s > 1 for s in axes.values()),
+            "devices": int(ms.mesh.devices.size),
+            "axes": axes,
+            "tp": int(ms.size("model")),
+            "ep": int(ms.size("expert")),
+        }
+
     def statusz(self) -> Dict[str, Any]:
         """Live machine-readable engine snapshot: per-slot state,
         in-flight requests with phase and age, KV/prefix-cache pool
@@ -2477,6 +2495,7 @@ class ServingEngine:
                     self._c_spec_emitted.value / spec_slots, 4)
                 if spec_slots else None,
             },
+            "mesh": self.mesh_info(),
         }
         metrics = self.registry.snapshot()
         status["slo"] = self.slo_tracker.snapshot(now=now)

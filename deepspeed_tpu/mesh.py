@@ -1,0 +1,209 @@
+"""Version-portable shard_map / mesh layer — the SPMD core every
+manual-collective path routes through.
+
+GSPMD (arXiv:2105.04663) is the compilation model: ONE jitted program,
+named mesh axes, ``NamedSharding``/``PartitionSpec`` annotations, and
+XLA choosing the collectives.  ``shard_map`` is the escape hatch for the
+paths that schedule their own collectives (pipeline ticks, ring/Ulysses
+attention, int8 gradient wires, 1-bit momentum) — and it is also the
+API JAX has moved twice:
+
+=================  ==========================  =========================
+spelling           modern (jax >= 0.5.x)       pinned legacy (0.4.x)
+=================  ==========================  =========================
+entrypoint         ``jax.shard_map``           ``jax.experimental.
+                                               shard_map.shard_map``
+manual axes        ``axis_names={...}``        ``auto=frozenset(rest)``
+replication check  ``check_vma=``              ``check_rep=``
+=================  ==========================  =========================
+
+This module resolves the spelling ONCE and exposes one portable
+:func:`shard_map` (plus :func:`axis_size`, the other renamed API) so
+callers never touch a version-specific attribute again.  The package
+was written against the modern spelling; on the pinned JAX the bare
+``jax.shard_map`` attribute does not exist and 31 seed tests died on
+the AttributeError — :func:`install` also publishes the portable
+wrapper AT ``jax.shard_map`` so modern-idiom code (including tests)
+runs unmodified.
+
+Partial manualization note: the modern ``axis_names={...}`` keyword
+leaves the unnamed axes under GSPMD inside the region.  The pinned
+jaxlib's SPMD partitioner cannot lower that mode on CPU (eager dispatch
+is ``NotImplementedError``; under jit ``axis_index`` lowers to a
+``PartitionId`` op the partitioner rejects and f32 psum CHECK-fails on
+``IsManualSubgroup``), so on legacy JAX the wrapper degrades to FULL
+manualization.  ``shard_map`` semantics are defined on global arrays —
+in_specs/out_specs describe the same global-to-local slicing either
+way — so results are identical; the axes you would have left auto are
+simply replicated inside the region (a memory/perf trade on multi-axis
+meshes, not a numerics one; MIGRATION.md "modern mesh idiom" has the
+full contract).  Set ``DSTPU_PARTIAL_MANUAL=1`` to pass ``auto=``
+through natively on stacks where the lowering works.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "shard_map", "axis_size", "resolve_shard_map", "install",
+    "make_mesh", "named_sharding", "pspec", "mesh_axis_sizes",
+    "host_device_count",
+]
+
+
+def resolve_shard_map():
+    """Locate the native shard_map: ``(callable, style)`` where style is
+    ``"modern"`` (top-level ``jax.shard_map``, axis_names/check_vma
+    keywords) or ``"legacy"`` (``jax.experimental.shard_map``,
+    auto/check_rep keywords).  A wrapper previously published by
+    :func:`install` is never mistaken for a native modern entrypoint."""
+    native = getattr(jax, "shard_map", None)
+    if native is not None and not getattr(native, "_dstpu_shim", False):
+        return native, "modern"
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy, "modern" if legacy is native else "legacy"
+
+
+_NATIVE, _STYLE = resolve_shard_map()
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, *,
+              axis_names=None, check_vma=None, check_rep=None,
+              auto=None, **kw):
+    """Portable ``shard_map`` accepting BOTH keyword dialects.
+
+    ``axis_names`` (modern): the axes the body manages manually; the
+    rest stay under GSPMD.  ``auto`` (legacy): the complement — axes
+    GSPMD keeps.  Pass either; the resolved native entrypoint gets the
+    spelling it understands.  ``check_vma``/``check_rep`` are the same
+    flag under its two names (default True, like both natives).
+
+    On legacy JAX a partial-manual request degrades to full
+    manualization unless ``DSTPU_PARTIAL_MANUAL=1`` (see the module
+    docstring for why that is semantics-preserving).
+    """
+    if mesh is None:
+        raise TypeError("shard_map requires mesh=")
+    check = True
+    if check_vma is not None:
+        check = bool(check_vma)
+    elif check_rep is not None:
+        check = bool(check_rep)
+    all_axes = frozenset(mesh.axis_names)
+    manual: frozenset = all_axes
+    if axis_names is not None and auto is not None:
+        raise TypeError("pass axis_names= or auto=, not both")
+    if axis_names is not None:
+        manual = frozenset(axis_names) & all_axes
+    elif auto is not None:
+        manual = all_axes - frozenset(auto)
+    if _STYLE == "modern":
+        mkw = dict(kw)
+        if manual != all_axes:
+            mkw["axis_names"] = set(manual)
+        return _NATIVE(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=check, **mkw)
+    legacy_auto = frozenset()
+    if manual != all_axes and os.environ.get("DSTPU_PARTIAL_MANUAL"):
+        legacy_auto = all_axes - manual
+    return _NATIVE(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check, auto=legacy_auto, **kw)
+
+
+shard_map._dstpu_shim = True  # type: ignore[attr-defined]
+
+
+def axis_size(axis_name: str):
+    """Portable ``jax.lax.axis_size`` (absent on the pinned JAX): the
+    size of a named mesh axis, from inside SPMD code.  ``psum(1, axis)``
+    is the classic spelling — it folds to a static int at trace time,
+    so the result is safe in shape positions (``jnp.arange(n)``)."""
+    native = getattr(jax.lax, "axis_size", None)
+    if native is not None:
+        return native(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+# ------------------------------------------------------------- helpers
+def make_mesh(axis_sizes: Dict[str, int],
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a :class:`jax.sharding.Mesh` from ``{axis: size}`` in dict
+    order over ``devices`` (default: all).  The named-axis Mesh is the
+    modern idiom's single topology object — every "process group" of
+    the reference is an axis of it."""
+    devices = list(devices if devices is not None else jax.devices())
+    names = tuple(axis_sizes)
+    shape = [int(axis_sizes[a]) for a in names]
+    total = int(np.prod(shape)) if shape else 1
+    if total != len(devices):
+        raise ValueError(
+            f"mesh {dict(axis_sizes)} needs {total} devices, "
+            f"have {len(devices)}")
+    return Mesh(np.array(devices).reshape(shape), names)
+
+
+def pspec(*axes) -> PartitionSpec:
+    """``PartitionSpec`` constructor passthrough (one import site)."""
+    return PartitionSpec(*axes)
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """``NamedSharding`` over ``mesh``; ``spec`` is either a single
+    PartitionSpec or the axes to build one from."""
+    if len(spec) == 1 and isinstance(spec[0], PartitionSpec):
+        return NamedSharding(mesh, spec[0])
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
+    """``{axis: size}`` of a live Mesh (statusz / observability)."""
+    return {a: int(s) for a, s in zip(mesh.axis_names,
+                                      mesh.devices.shape)}
+
+
+def host_device_count(n: int) -> None:
+    """Ask XLA for ``n`` virtual host (CPU) devices — must run BEFORE
+    the backend initializes.  The CPU-testable stand-in for a real
+    multi-chip mesh (``--xla_force_host_platform_device_count``).
+
+    A pre-existing flag asking for a DIFFERENT count raises here —
+    failing at the point of conflict beats failing mid-run with a
+    device-count mismatch after the flag silently lost."""
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+    if m is not None:
+        have = int(m.group(1))
+        if have != int(n):
+            raise ValueError(
+                f"XLA_FLAGS already forces {have} host devices but "
+                f"{int(n)} were requested — clear the flag (or match "
+                "it) before the backend initializes")
+        return
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={int(n)}")
+
+
+# ------------------------------------------------------------- install
+def install() -> bool:
+    """Publish the portable wrapper at ``jax.shard_map`` when the
+    pinned JAX predates the top-level entrypoint, so modern-idiom
+    callers (the package everywhere, the seed tests verbatim) never
+    see the AttributeError.  Never shadows a real native entrypoint.
+    Returns True when this call (or an earlier one) installed it."""
+    native = getattr(jax, "shard_map", None)
+    if native is None:
+        jax.shard_map = shard_map
+        return True
+    return bool(getattr(native, "_dstpu_shim", False))
+
+
+install()

@@ -6,7 +6,8 @@ full-precision warmup it freezes the Adam variance and communicates only
 the compression unbiased over time.
 
 TPU-native shape: compression lives INSIDE the SPMD program.
-:func:`onebit_allreduce` runs under ``shard_map`` — each chip all-gathers
+:func:`onebit_allreduce` runs under ``shard_map`` (the version-portable
+:func:`deepspeed_tpu.mesh.shard_map`) — each chip all-gathers
 int8 signs + f32 group scales over the dp axis (1/4 the f32 bytes on
 ICI) and averages locally.  The optimizers follow the reference's
 algorithm: local momentum update → compressed momentum allreduce → param

@@ -273,7 +273,8 @@ def from_config(name: str, params: dict) -> Optimizer:
     name = name.lower()
     if name.startswith("onebit") or name.startswith("zeroone"):
         _register_onebit()   # deferred: onebit imports this module
-        # Outside the engine's compressed shard_map path there is no bound
+        # Outside the engine's compressed step (which runs under the
+        # portable deepspeed_tpu.mesh.shard_map) there is no bound
         # named axis, so axis_name defaults to None — which means NO
         # compressed communication happens.  The engine passes
         # axis_name="data" itself when its compressed step is active

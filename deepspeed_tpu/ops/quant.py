@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from deepspeed_tpu.mesh import axis_size
+
 INT_BOUNDS = {8: 127.0, 4: 7.0, 2: 1.0, 1: 1.0}
 
 
@@ -155,7 +157,7 @@ def quantized_reduce_scatter(x: jnp.ndarray, axis_name: str, bits: int = 8,
     shard, ...] per-chip partial gradient; returns this chip's reduced
     [shard, ...] (mean over the axis).
     """
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
     shard = x.shape[0] // world
     parts = x.reshape((world, shard) + x.shape[1:])
     flat = parts.reshape(world, -1)

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deepspeed_tpu.mesh import shard_map
 from deepspeed_tpu.topology import MeshSpec
 
 PIPE_AXIS = "pipe"
@@ -143,7 +144,7 @@ def pipelined_scan(block_fn: Callable, stacked_params: Any, x: jnp.ndarray,
         out = jax.lax.psum(real, PIPE_AXIS)
         return out.astype(xs.dtype)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         run, mesh=mesh.mesh,
         in_specs=(jax.tree.map(lambda _: P(PIPE_AXIS), stacked_params), P()),
         out_specs=P(), axis_names={PIPE_AXIS}, check_vma=False)

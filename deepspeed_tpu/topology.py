@@ -35,13 +35,11 @@ class MeshSpec:
 
     @classmethod
     def build(cls, sizes: Dict[str, int], devices: Optional[Sequence] = None) -> "MeshSpec":
+        from deepspeed_tpu.mesh import make_mesh
+
         devices = list(devices if devices is not None else jax.devices())
         full = {a: int(sizes.get(a, 1)) for a in AXES}
-        total = int(np.prod(list(full.values())))
-        if total != len(devices):
-            raise ValueError(f"mesh {full} needs {total} devices, have {len(devices)}")
-        arr = np.array(devices).reshape([full[a] for a in AXES])
-        return cls(sizes=full, mesh=Mesh(arr, AXES))
+        return cls(sizes=full, mesh=make_mesh(full, devices=devices))
 
     # ------------------------------------------------------------ accessors
     def size(self, axis: str) -> int:

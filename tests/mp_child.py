@@ -30,6 +30,12 @@ import jax
 # the container's sitecustomize pre-registers the axon TPU backend; the
 # env var from --platform cpu is not enough (tests/conftest.py trick)
 jax.config.update("jax_platforms", "cpu")
+# match conftest.py's RNG implementation: partitionable threefry is the
+# default on newer JAX but opt-in on the pinned one, and it generates
+# DIFFERENT values — a child on the legacy impl would init different
+# params than the parent's single-process oracle and fail loss parity
+# by bf16-visible margins
+jax.config.update("jax_threefry_partitionable", True)
 
 import numpy as np  # noqa: E402
 

@@ -100,9 +100,14 @@ def render_fleet(status: dict, health: dict | None = None) -> list:
         elif ro.get("rolled_back"):
             line += f"  ROLLED-BACK {ro.get('version')}"
         L.append(line)
+    fm = fl.get("mesh", {})
+    if fm.get("tp", 1) > 1 or fm.get("sharded_replicas"):
+        L.append(f"mesh  tp={fm.get('tp', 1)}"
+                 f"  sharded {fm.get('sharded_replicas', 0)}"
+                 f"/{len(fl.get('replicas', []))} replicas")
     L.append("-" * 78)
     L.append(f"{'replica':<9}{'state':<13}{'role':<9}{'ver':<6}"
-             f"{'queue':>6}"
+             f"{'mesh':<7}{'queue':>6}"
              f"{'slots':>6}{'shed%':>7}{'failed':>7}{'aff':>5}"
              f"{'digest':>7}  reasons")
     for r in fl.get("replicas", []):
@@ -110,9 +115,14 @@ def render_fleet(status: dict, health: dict | None = None) -> list:
         if r.get("stalled_for_s"):
             reasons = (reasons + f" stall {r['stalled_for_s']:.1f}s"
                        ).strip()
+        rm = r.get("mesh", {})
+        mesh_col = ("x".join(f"{a}{s}" for a, s in
+                             sorted(rm.get("axes", {}).items()))
+                    or "1dev") if rm else "-"
         L.append(f"{r['replica']:<9}{r['state']:<13}"
                  f"{str(r.get('role') or '-')[:8]:<9}"
                  f"{str(r.get('version', '-'))[:5]:<6}"
+                 f"{mesh_col[:6]:<7}"
                  f"{r.get('queue_depth', 0):>6}"
                  f"{r.get('active_slots', 0):>6}"
                  f"{100 * r.get('shed_rate', 0.0):>6.1f}%"
@@ -183,6 +193,12 @@ def render(status: dict, health: dict | None = None) -> list:
         L.append(f"spec  sweeps {sp.get('verify_sweeps', 0)}"
                  f"  mean accept "
                  f"{mal if mal is not None else '-'}")
+    em = status.get("mesh", {})
+    if em.get("sharded"):
+        axes = " ".join(f"{a}={s}" for a, s in
+                        sorted(em.get("axes", {}).items()))
+        L.append(f"mesh  {em.get('devices', 1)} devices  {axes}"
+                 f"  (tp={em.get('tp', 1)} ep={em.get('ep', 1)})")
     rb = status.get("robustness", {})
     rkt = rb.get("kv_tier", {})
     if rb and (rb.get("degraded") or rb.get("shed_requests")

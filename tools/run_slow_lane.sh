@@ -19,8 +19,13 @@ DEADLINE="${SLOW_LANE_DEADLINE_S:-2700}"
 T0=$(date +%s)
 LOG=$(mktemp /tmp/dstpu_slow_lane.XXXXXX.log)
 
+# NO --continue-on-collection-errors: since the modern-mesh core
+# landed (deepspeed_tpu/mesh.py) every module imports on the pinned
+# JAX — the lane no longer tolerates the old shard_map failure floor,
+# so a collection error is a hard regression that fails the run
+# immediately instead of burning the deadline on the survivors
 timeout -k 30 "$DEADLINE" env JAX_PLATFORMS=cpu python -m pytest tests/ \
-  -q --runslow --continue-on-collection-errors -p no:cacheprovider \
+  -q --runslow -p no:cacheprovider \
   2>&1 | tee "$LOG"
 RC=${PIPESTATUS[0]}
 
@@ -131,6 +136,15 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py \
 # split.  Stamps DISAGG_BENCH.json, gated by bench_gate.
 timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_fleet.py --cpu \
   --disagg --json-out "$REPO/DISAGG_BENCH.json" >/dev/null 2>&1 || true
+
+# tensor-parallel serving A/B: the same traffic on a 1-device engine
+# vs a 2-device model-axis mesh (virtual host CPUs) — decode tokens/s,
+# TTFT, and the token-identity gate (tp_ab.mismatched_requests must
+# stay 0: sharding is an execution strategy).  Stamps TP_BENCH.json,
+# gated by bench_gate below.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_serving.py --cpu \
+  --tp 2 --requests 16 --new-tokens 32 --cpu-dim 256 --cpu-layers 2 \
+  --json-out "$REPO/TP_BENCH.json" >/dev/null 2>&1 || true
 
 # bench regression gate: AFTER the stamps above, diff the evidence
 # files against the committed BENCH_BASELINE.json and leave a verdict
