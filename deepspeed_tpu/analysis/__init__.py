@@ -1,0 +1,145 @@
+"""dstpu-lint: project-native static analysis for deepspeed_tpu.
+
+Four pass families over the package's own ASTs plus one runtime-
+evidence check, each encoding an invariant a past PR's review had to
+rediscover by hand (see each module's docstring for the incident):
+
+- :mod:`.hostsync` — ``# dstpu: hot-path`` regions may not host-sync
+  without an inline justification (PR 7's ``_flush_boundary``);
+- :mod:`.lockorder` — lock-acquisition graph must stay acyclic, and
+  no opaque callback / sleep runs under a held lock (PR 6's alert-hook
+  deadlock);
+- :mod:`.pagelifecycle` — page acquisition must be exception-guarded
+  to its matching release (PR 9's admission leak);
+- :mod:`.parity` — config ↔ CONFIG.md, metric names ↔ README/CONFIG/
+  dstpu_top citations, faults.py validation tables ↔ fault-rule docs,
+  and Chrome-trace begin/end pairing against the committed sample.
+
+Entry point: ``tools/dstpu_lint.py --check`` (tier-1 via
+``tests/test_analysis.py``, slow lane via ``tools/run_slow_lane.sh``
+which stamps ``LINT_REPORT.json``; ``BENCH_BASELINE.json`` pins
+violations = 0, waivers = 0, passes_run >= 4).  Stdlib-only by design:
+linting must not import the package it judges.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from . import hostsync, lockorder, pagelifecycle, parity
+from .core import (Finding, SourceFile, apply_baseline, from_source,
+                   load_baseline, load_file, load_package)
+
+PASSES = ("hostsync", "lockorder", "pagelifecycle", "parity")
+
+
+def _read(path: str) -> str:
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def run_repo(root: str, passes=PASSES,
+             budget_s: Optional[float] = None) -> Dict[str, object]:
+    """Run the selected passes over the repo at ``root``.  Returns the
+    report document (pre-baseline): findings, per-pass counts and
+    durations, hot-region stats, and the lock graph.
+
+    ``budget_s``: tier-1 budget awareness — passes run in fixed order
+    and any pass that would START past the budget is skipped and named
+    in ``demoted`` (the slow lane always runs everything).  Passes
+    already started always finish: a half-run pass would report a
+    misleading zero.
+    """
+    t0 = time.perf_counter()
+    files = load_package(root)
+    findings: List[Finding] = []
+    per_pass: Dict[str, dict] = {}
+    demoted: List[str] = []
+    graph_out: Optional[Dict[str, List[str]]] = None
+
+    def over_budget() -> bool:
+        return budget_s is not None and \
+            time.perf_counter() - t0 > budget_s
+
+    for name in passes:
+        if name not in PASSES:
+            raise ValueError(
+                f"unknown pass {name!r} (known: {PASSES})")
+        if over_budget():
+            demoted.append(name)
+            continue
+        p0 = time.perf_counter()
+        if name == "hostsync":
+            got = hostsync.run(files)
+        elif name == "lockorder":
+            got, graph_out = lockorder.analyze(files)
+        elif name == "pagelifecycle":
+            got = pagelifecycle.run(files)
+        else:
+            trace_path = os.path.join(root, "TRACE_SAMPLE.chrome.json")
+            trace_doc = None
+            if os.path.exists(trace_path):
+                with open(trace_path, encoding="utf-8") as f:
+                    trace_doc = json.load(f)
+            top_path = os.path.join(root, "tools", "dstpu_top.py")
+            got = parity.run(
+                files,
+                config_sf=load_file(
+                    os.path.join(root, "deepspeed_tpu", "config.py"),
+                    root),
+                faults_sf=load_file(
+                    os.path.join(root, "deepspeed_tpu", "faults.py"),
+                    root),
+                config_md=_read(os.path.join(root, "CONFIG.md")),
+                readme_md=_read(os.path.join(root, "README.md")),
+                dstpu_top_sf=(load_file(top_path, root)
+                              if os.path.exists(top_path) else None),
+                trace_doc=trace_doc)
+        findings.extend(got)
+        per_pass[name] = {
+            "findings": len(got),
+            "duration_s": round(time.perf_counter() - p0, 4),
+        }
+    report: Dict[str, object] = {
+        "passes_run": len(per_pass),
+        "demoted": demoted,
+        "per_pass": per_pass,
+        "findings": [f.to_dict() for f in findings],
+        "duration_s": round(time.perf_counter() - t0, 4),
+    }
+    report.update(hostsync.stats(files))
+    if graph_out is not None:
+        report["lock_graph"] = graph_out
+    report["_findings"] = findings      # live objects for callers
+    return report
+
+
+def check_repo(root: str, baseline_path: Optional[str] = None,
+               passes=PASSES,
+               budget_s: Optional[float] = None) -> Dict[str, object]:
+    """``run_repo`` + baseline application: the document
+    ``tools/dstpu_lint.py --check`` stamps into ``LINT_REPORT.json``
+    and the bench gate reads (``violations``, ``waivers``,
+    ``passes_run``)."""
+    if baseline_path is None:
+        baseline_path = os.path.join(root, "LINT_BASELINE.json")
+    baseline = load_baseline(baseline_path)
+    report = run_repo(root, passes=passes, budget_s=budget_s)
+    findings = report.pop("_findings")
+    unwaived, waived = apply_baseline(findings, baseline)
+    report["violations"] = len(unwaived)
+    report["waivers"] = len(baseline.get("waivers", []))
+    report["waived_findings"] = waived
+    report["ok"] = not unwaived
+    report["findings"] = [f.to_dict() for f in unwaived]
+    return report
+
+
+__all__ = [
+    "PASSES", "Finding", "SourceFile", "apply_baseline", "check_repo",
+    "from_source", "hostsync", "load_baseline", "load_file",
+    "load_package", "lockorder", "pagelifecycle", "parity", "run_repo",
+]

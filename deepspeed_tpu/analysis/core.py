@@ -1,0 +1,230 @@
+"""Shared plumbing for the dstpu-lint passes: findings, source loading,
+region markers, justification comments, and the committed baseline.
+
+Thirteen PRs of review hardening keep rediscovering the same invariant
+violations — a host sync snuck into the decode loop, an exception path
+that leaks pages between allocation and slot publish, an alert hook
+re-entering the tracker lock, config/doc surfaces drifting apart.  The
+``deepspeed_tpu.analysis`` package encodes each of those classes as a
+machine-checked pass over this package's own ASTs (plus one cheap
+runtime-evidence check against the committed Chrome trace sample), run
+by ``tools/dstpu_lint.py`` in tier-1 and the slow lane.
+
+Suppression contract: **justification comments in code are the only
+suppression mechanism** — the committed baseline
+(``LINT_BASELINE.json``) ships with zero waivers and the bench gate
+pins it there.  A justification names its reason inline where the
+reviewer reads the code:
+
+    host_toks = np.asarray(out)  # dstpu: host-sync-ok: the ONE sync
+
+Tags: ``host-sync-ok`` (hostsync pass), ``lock-ok`` (lockorder pass),
+``page-guard-ok`` (pagelifecycle pass).  An empty reason is itself a
+violation — "trust me" is not a justification.
+
+This module (and every sibling pass) is stdlib-only on purpose: the
+lint CLI must run without importing jax or the package under analysis,
+so it stays cheap enough for tier-1 and can never be broken by the
+code it is judging.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# region marker: a comment on (or directly above) a `def` line marks the
+# whole function as a hot region for the hostsync pass
+HOT_PATH_MARKER = re.compile(r"#\s*dstpu:\s*hot-path\b")
+
+# justification comments: `# dstpu: <tag>: <reason>` — the reason is
+# mandatory (group 2 empty = `empty-justification` finding)
+_JUSTIFY = r"#\s*dstpu:\s*({tag}):\s*(.*?)\s*$"
+
+
+@dataclasses.dataclass
+class Finding:
+    """One violation: which pass, which invariant, where, and why."""
+
+    pass_name: str          # hostsync | lockorder | pagelifecycle | parity
+    code: str               # short invariant slug (stable across lines)
+    path: str               # repo-relative file
+    line: int               # 1-indexed; 0 = file-level
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline-matching identity: line numbers churn on every
+        edit, so waivers (if anyone ever commits one) match on the
+        (pass, code, path) triple."""
+        return (self.pass_name, self.code, self.path)
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_name}/"
+                f"{self.code}] {self.message}")
+
+
+class SourceFile:
+    """One parsed source file plus its raw lines (the AST drops
+    comments, and both region markers and justifications live in
+    comments)."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+
+    # ------------------------------------------------------- comments
+    def _line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def justification(self, tag: str, start: int,
+                      end: Optional[int] = None
+                      ) -> Optional[Tuple[str, int]]:
+        """Find a ``# dstpu: <tag>: reason`` comment attached to the
+        statement spanning lines ``start..end``: trailing on any line
+        of the span, or anywhere in the contiguous comment block
+        directly above it (a justification often wraps over several
+        comment lines; the tag line may sit at the block's top).
+        Returns ``(reason, lineno)`` (reason may be empty — the caller
+        turns that into its own finding) or None."""
+        pat = re.compile(_JUSTIFY.format(tag=re.escape(tag)))
+        end = end or start
+        for ln in range(start, end + 1):
+            m = pat.search(self._line(ln))
+            if m:
+                return m.group(2), ln
+        ln = start - 1
+        while ln >= 1 and self._line(ln).strip().startswith("#"):
+            m = pat.search(self._line(ln).strip())
+            if m:
+                return m.group(2), ln
+            ln -= 1
+        return None
+
+    # ---------------------------------------------------- hot regions
+    def hot_functions(self) -> List[ast.AST]:
+        """Every function whose ``def`` line (or the comment line
+        directly above it / above its first decorator) carries the
+        ``# dstpu: hot-path`` marker."""
+        out = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            top = node.lineno
+            if node.decorator_list:
+                top = min(d.lineno for d in node.decorator_list)
+            if HOT_PATH_MARKER.search(self._line(node.lineno)) or \
+                    HOT_PATH_MARKER.search(self._line(top - 1)):
+                out.append(node)
+        return out
+
+    def orphan_hot_markers(self) -> List[int]:
+        """Marker lines not attached to any function def — a typo'd or
+        drifted marker silently un-protects its region, so it is a
+        violation in its own right."""
+        attached = set()
+        for node in self.hot_functions():
+            top = node.lineno
+            if node.decorator_list:
+                top = min(d.lineno for d in node.decorator_list)
+            attached.add(node.lineno)
+            attached.add(top - 1)
+        out = []
+        for i, line in enumerate(self.lines, start=1):
+            if HOT_PATH_MARKER.search(line) and i not in attached:
+                out.append(i)
+        return out
+
+
+# ---------------------------------------------------------------- loading
+def load_file(path: str, root: str) -> SourceFile:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    return SourceFile(path, os.path.relpath(path, root), text)
+
+
+def load_package(root: str, package: str = "deepspeed_tpu",
+                 exclude: Iterable[str] = ("analysis",)
+                 ) -> List[SourceFile]:
+    """Parse every ``.py`` under ``<root>/<package>`` (sorted, so runs
+    are deterministic).  ``exclude`` drops subpackage names — the
+    analyzer does not lint itself (its fixtures and heuristics would
+    be self-referential noise, and it holds no hot paths, locks or
+    pages)."""
+    base = os.path.join(root, package)
+    out: List[SourceFile] = []
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d != "__pycache__" and d not in exclude)
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(load_file(os.path.join(dirpath, fn), root))
+    return out
+
+
+def from_source(text: str, rel: str = "<fixture>") -> SourceFile:
+    """Build a SourceFile from an inline snippet (the test fixtures)."""
+    return SourceFile(rel, rel, text)
+
+
+# --------------------------------------------------------------- baseline
+def load_baseline(path: str) -> Dict[str, object]:
+    """Load ``LINT_BASELINE.json``; a missing file is an empty
+    zero-waiver baseline (the committed one is empty too — the file
+    exists to make that emptiness an explicit, diffable contract)."""
+    if not os.path.exists(path):
+        return {"version": 1, "waivers": []}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc.get("waivers"), list):
+        raise ValueError(
+            f"{path}: baseline must carry a 'waivers' list")
+    return doc
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[str, object]
+                   ) -> Tuple[List[Finding], int]:
+    """Split findings into (unwaived, waived_count).  A waiver matches
+    on ``{"pass": ..., "code": ..., "path": ...}`` and must name a
+    ``reason`` — though the shipped policy is zero waivers (the bench
+    gate pins ``waivers == 0``); justification comments in code are
+    the suppression mechanism."""
+    waivers = set()
+    for w in baseline.get("waivers", []):
+        if not w.get("reason"):
+            raise ValueError(
+                f"baseline waiver without a reason: {w!r}")
+        waivers.add((w.get("pass"), w.get("code"), w.get("path")))
+    unwaived = [f for f in findings if f.key() not in waivers]
+    return unwaived, len(findings) - len(unwaived)
+
+
+# ------------------------------------------------------------- AST helpers
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_span(node: ast.AST) -> Tuple[int, int]:
+    return node.lineno, getattr(node, "end_lineno", node.lineno)

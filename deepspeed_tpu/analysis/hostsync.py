@@ -1,0 +1,118 @@
+"""Hot-path host-sync lint (pass 1).
+
+The serving loop's perf contract (serving.py module docstring, and the
+ZeRO-Infinity/ZeRO++ framing in PAPER/PAPERS: the contract lives in
+*where* data moves and *when* the host blocks) is "exactly ONE
+device→host transfer per decode step".  PR 7's review caught a
+per-slot ``device_get`` on the prefill boundary that silently broke it
+— the class of bug this pass turns into a committed invariant.
+
+A function marked ``# dstpu: hot-path`` (comment on or directly above
+its ``def``) is a hot region.  Inside one, these are violations unless
+carrying a ``# dstpu: host-sync-ok: <reason>`` justification:
+
+- ``jax.device_get(...)`` (any ``*.device_get`` call) — an explicit
+  blocking device→host transfer;
+- ``<expr>.item()`` — the classic scalar sync;
+- ``np.asarray(...)`` / ``np.array(...)`` — materializes a device
+  array on host (``jnp.asarray`` stays on device and is not flagged);
+- ``float(x)`` / ``bool(x)`` on a non-literal — the implicit
+  conversion syncs when ``x`` is a device array (``bool`` is also how
+  a stray ``if tracer:`` would read).
+
+Unmarked functions are out of scope BY CONSTRUCTION: the repo's ~100
+other host-conversion call sites live on admission/demotion/teardown
+paths that are deliberately batched or off the decode loop, and
+marking is the act of putting a region under contract.  A marker that
+attaches to nothing (typo, drifted def) is itself a violation —
+silently un-protecting a region is how the contract rots.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, SourceFile, call_span
+
+PASS = "hostsync"
+TAG = "host-sync-ok"
+
+# numpy module aliases whose asarray/array calls materialize on host
+_NP_NAMES = ("np", "numpy", "onp")
+
+
+def _sync_kind(node: ast.Call) -> str:
+    """Classify a Call as a host-sync primitive; '' = not one."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "device_get":
+            return "device_get"
+        if fn.attr == "item" and not node.args and not node.keywords:
+            return ".item()"
+        if fn.attr in ("asarray", "array") and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in _NP_NAMES:
+            return f"{fn.value.id}.{fn.attr}"
+    elif isinstance(fn, ast.Name) and fn.id in ("float", "bool"):
+        if len(node.args) == 1 and not isinstance(
+                node.args[0], ast.Constant):
+            return f"{fn.id}()"
+    return ""
+
+
+def check_file(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for ln in sf.orphan_hot_markers():
+        findings.append(Finding(
+            PASS, "orphan-hot-path-marker", sf.rel, ln,
+            "`# dstpu: hot-path` marker not attached to a function "
+            "def — the region it meant to protect is unprotected"))
+    for fn in sf.hot_functions():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _sync_kind(node)
+            if not kind:
+                continue
+            start, end = call_span(node)
+            j = sf.justification(TAG, start, end)
+            if j is None:
+                findings.append(Finding(
+                    PASS, "host-sync-in-hot-path", sf.rel, start,
+                    f"{kind} inside hot region `{fn.name}` — the "
+                    f"decode-loop contract is one batched transfer "
+                    f"per step; batch it, move it off the hot path, "
+                    f"or justify with `# dstpu: {TAG}: <reason>`"))
+            elif not j[0]:
+                findings.append(Finding(
+                    PASS, "empty-justification", sf.rel, j[1],
+                    f"`# dstpu: {TAG}:` with no reason on {kind} in "
+                    f"`{fn.name}` — a justification must say WHY the "
+                    f"sync is allowed"))
+    return findings
+
+
+def run(files: List[SourceFile]) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in files:
+        out.extend(check_file(sf))
+    return out
+
+
+def stats(files: List[SourceFile]) -> dict:
+    """Coverage numbers for the report: how many regions are under
+    contract, and how many justified syncs they carry."""
+    regions = 0
+    justified = 0
+    for sf in files:
+        hot = sf.hot_functions()
+        regions += len(hot)
+        for fn in hot:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and _sync_kind(node):
+                    start, end = call_span(node)
+                    j = sf.justification(TAG, start, end)
+                    if j is not None and j[0]:
+                        justified += 1
+    return {"hot_regions": regions, "justified_syncs": justified}

@@ -1,0 +1,333 @@
+"""Lock-order + lock-scope checker (pass 2).
+
+The engine thread, the telemetry HTTP exporter thread, aio drain
+workers and pluggable alert hooks all interleave across
+``telemetry.py`` / ``slo.py`` / ``request_trace.py`` / ``serving.py``
+/ ``fleet.py``.  PR 6's review caught the canonical deadlock shape: an
+alert hook invoked while the tracker lock was held, calling back into
+a tracker method that re-acquires the same non-reentrant lock.  The
+fix (fire hooks AFTER releasing the lock — see
+``SLOTracker._refresh_tier``'s contract) is exactly the discipline
+this pass enforces on every commit:
+
+- **callback-under-lock**: an opaque callable (``*_hook``,
+  ``*_callback``, ``to_device``, ``on_wait``, ``on_retry``, or a
+  ``tracer.event`` emit) invoked while any lock is held.  The analyzer
+  cannot see inside a pluggable hook, so holding a lock across one is
+  the violation — collect under the lock, invoke after release.
+- **sleep-under-lock**: ``time.sleep`` while holding a lock stalls
+  every thread contending it (the fault injector's latency rules made
+  this an easy mistake: ``inject`` deliberately sleeps only after
+  ``poll`` released the plan lock).
+- **lock-reentry**: acquiring a ``threading.Lock`` (non-reentrant)
+  already held on the same control path — followed one level through
+  same-class/same-module calls, which is how the PR 6 deadlock
+  actually nested.
+- **lock-cycle**: the acquisition graph (lock A held while lock B is
+  taken, lexically or through the same call-following) must stay
+  acyclic across the whole package.
+
+- **manual-lock-acquire**: ``lock.acquire()`` on a known lock — the
+  analyzer models critical sections through ``with`` items only, so
+  the acquire/release idiom would make every shape above invisible;
+  the idiom itself is therefore the violation.
+
+Approximations, stated: the pass follows direct ``self.method()`` and
+same-module function calls (bounded depth); it cannot see acquisitions
+behind attribute indirection (e.g. a metric object's internal lock) —
+those stay leaves by construction here, which is also the design rule
+the hierarchy relies on.  Suppression: ``# dstpu: lock-ok: <reason>``
+on the call line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, SourceFile, call_span, dotted_name
+
+PASS = "lockorder"
+TAG = "lock-ok"
+
+CALLBACK_ATTRS = {"alert_hook", "demote_hook", "to_device", "on_wait",
+                  "on_retry", "hook", "callback"}
+_MAX_DEPTH = 8
+
+
+def _lock_ctor(node: ast.AST) -> Optional[bool]:
+    """Is this expression ``threading.Lock()`` / ``RLock()``?  Returns
+    rlock-ness, or None if it is not a lock constructor."""
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func) or ""
+        if name in ("threading.Lock", "Lock"):
+            return False
+        if name in ("threading.RLock", "RLock"):
+            return True
+    return None
+
+
+def _callback_name(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        a = fn.attr
+        if a in CALLBACK_ATTRS or a.endswith("_hook") or \
+                a.endswith("_callback"):
+            return a
+        if a == "event":
+            recv = (dotted_name(fn.value) or "").lower()
+            if "tracer" in recv:
+                return f"{dotted_name(fn.value)}.event"
+    elif isinstance(fn, ast.Name):
+        if fn.id in CALLBACK_ATTRS or fn.id.endswith("_hook") or \
+                fn.id.endswith("_callback"):
+            return fn.id
+    return None
+
+
+def _is_sleep(call: ast.Call) -> bool:
+    name = dotted_name(call.func) or ""
+    return name in ("time.sleep", "sleep")
+
+
+class _Module:
+    """Per-file symbol tables: module locks, per-class lock attrs and
+    methods, module-level functions."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.mod_locks: Dict[str, bool] = {}       # name -> rlock
+        self.functions: Dict[str, ast.AST] = {}
+        self.classes: Dict[str, Dict[str, object]] = {}
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign):
+                r = _lock_ctor(node.value)
+                if r is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.mod_locks[t.id] = r
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                locks: Dict[str, bool] = {}
+                methods: Dict[str, ast.AST] = {}
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        methods[sub.name] = sub
+                        for a in ast.walk(sub):
+                            if isinstance(a, ast.Assign):
+                                r = _lock_ctor(a.value)
+                                if r is None:
+                                    continue
+                                for t in a.targets:
+                                    if isinstance(t, ast.Attribute) \
+                                            and isinstance(
+                                                t.value, ast.Name) \
+                                            and t.value.id == "self":
+                                        locks[t.attr] = r
+                self.classes[node.name] = {"locks": locks,
+                                           "methods": methods}
+
+    def resolve_lock(self, expr: ast.AST,
+                     cls: Optional[str]) -> Optional[Tuple[str, bool]]:
+        """(lock id, rlock) for a with-item context expression."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and cls is not None:
+            locks = self.classes[cls]["locks"]
+            if expr.attr in locks:
+                return (f"{self.sf.rel}:{cls}.{expr.attr}",
+                        locks[expr.attr])
+        elif isinstance(expr, ast.Name) and expr.id in self.mod_locks:
+            return (f"{self.sf.rel}:{expr.id}",
+                    self.mod_locks[expr.id])
+        return None
+
+
+class _Analyzer:
+    def __init__(self, modules: List[_Module]):
+        self.modules = modules
+        self.findings: List[Finding] = []
+        # acquisition edges: (held, taken) -> first witness location
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    # ------------------------------------------------------------ walk
+    def run(self) -> None:
+        for mod in self.modules:
+            for fname, fn in mod.functions.items():
+                self._walk_fn(mod, None, fn, [], set(), 0)
+            for cname, info in mod.classes.items():
+                for mname, m in info["methods"].items():
+                    self._walk_fn(mod, cname, m, [], set(), 0)
+
+    def _walk_fn(self, mod: _Module, cls: Optional[str], fn: ast.AST,
+                 held: List[Tuple[str, bool]], visited: Set, depth: int
+                 ) -> None:
+        key = (mod.sf.rel, cls, fn.name)
+        if key in visited or depth > _MAX_DEPTH:
+            return
+        visited = visited | {key}
+        for stmt in fn.body:
+            self._walk(mod, cls, stmt, held, visited, depth)
+
+    def _walk(self, mod: _Module, cls: Optional[str], node: ast.AST,
+              held: List[Tuple[str, bool]], visited: Set, depth: int
+              ) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            # a nested def is not EXECUTED under the lock — only its
+            # definition is.  Analyzed separately if ever called.
+            return
+        if isinstance(node, ast.With):
+            acquired = 0
+            for item in node.items:
+                self._walk(mod, cls, item.context_expr, held, visited,
+                           depth)
+                lock = mod.resolve_lock(item.context_expr, cls)
+                if lock is None:
+                    continue
+                lid, rlock = lock
+                self._on_acquire(mod, node.lineno, lid, rlock, held)
+                held.append((lid, rlock))
+                acquired += 1
+            for stmt in node.body:
+                self._walk(mod, cls, stmt, held, visited, depth)
+            del held[len(held) - acquired:len(held)]
+            return
+        if isinstance(node, ast.Call):
+            self._check_manual_acquire(mod, cls, node)
+            if held:
+                self._check_call(mod, cls, node, held, visited, depth)
+            # fall through: arguments may hold further calls
+        for child in ast.iter_child_nodes(node):
+            self._walk(mod, cls, child, held, visited, depth)
+
+    # --------------------------------------------------------- events
+    def _on_acquire(self, mod: _Module, line: int, lid: str,
+                    rlock: bool, held: List[Tuple[str, bool]]) -> None:
+        for hid, _hr in held:
+            if hid == lid:
+                if not rlock:
+                    self.findings.append(Finding(
+                        PASS, "lock-reentry", mod.sf.rel, line,
+                        f"non-reentrant lock {lid} acquired while "
+                        f"already held on this control path — "
+                        f"self-deadlock (the PR 6 shape)"))
+            else:
+                self.edges.setdefault((hid, lid), (mod.sf.rel, line))
+
+    def _check_manual_acquire(self, mod: _Module, cls: Optional[str],
+                              node: ast.Call) -> None:
+        """Manual ``lock.acquire()`` on a known lock: the analyzer
+        models critical sections through ``with`` items only, so the
+        acquire/release idiom would make the PR 6 shape invisible —
+        flag the idiom itself rather than silently under-analyzing."""
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "acquire"):
+            return
+        if mod.resolve_lock(fn.value, cls) is None:
+            return
+        start, end = call_span(node)
+        if mod.sf.justification(TAG, start, end) is not None:
+            return
+        self.findings.append(Finding(
+            PASS, "manual-lock-acquire", mod.sf.rel, start,
+            "manual .acquire() on a known lock — the lock checker can "
+            "only model `with`-scoped critical sections, so this "
+            "region would escape callback/reentry/cycle analysis; use "
+            f"`with` (or justify with `# dstpu: {TAG}: <reason>`)"))
+
+    def _check_call(self, mod: _Module, cls: Optional[str],
+                    node: ast.Call, held: List[Tuple[str, bool]],
+                    visited: Set, depth: int) -> None:
+        start, end = call_span(node)
+        cb = _callback_name(node)
+        if cb is not None:
+            j = mod.sf.justification(TAG, start, end)
+            if j is None:
+                self.findings.append(Finding(
+                    PASS, "callback-under-lock", mod.sf.rel, start,
+                    f"opaque callback `{cb}` invoked while holding "
+                    f"{held[-1][0]} — a hook that re-enters the "
+                    f"owner deadlocks; collect under the lock, "
+                    f"invoke after release (or justify with "
+                    f"`# dstpu: {TAG}: <reason>`)"))
+            elif not j[0]:
+                self.findings.append(Finding(
+                    PASS, "empty-justification", mod.sf.rel, j[1],
+                    f"`# dstpu: {TAG}:` with no reason on `{cb}`"))
+        if _is_sleep(node):
+            j = mod.sf.justification(TAG, start, end)
+            if j is None:
+                self.findings.append(Finding(
+                    PASS, "sleep-under-lock", mod.sf.rel, start,
+                    f"time.sleep while holding {held[-1][0]} stalls "
+                    f"every contending thread"))
+        # one-level call-following: self.method() / module function()
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id == "self" and cls is not None:
+            target = mod.classes[cls]["methods"].get(fn.attr)
+            if target is not None:
+                self._walk_fn(mod, cls, target, held, visited,
+                              depth + 1)
+        elif isinstance(fn, ast.Name):
+            target = mod.functions.get(fn.id)
+            if target is not None:
+                self._walk_fn(mod, None, target, held, visited,
+                              depth + 1)
+
+    # ---------------------------------------------------------- cycles
+    def find_cycles(self) -> None:
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            if a != b:
+                adj.setdefault(a, []).append(b)
+        state: Dict[str, int] = {}       # 0 visiting, 1 done
+        stack: List[str] = []
+
+        def dfs(n: str) -> None:
+            state[n] = 0
+            stack.append(n)
+            for m in adj.get(n, ()):
+                if m not in state:
+                    dfs(m)
+                elif state[m] == 0:
+                    cyc = stack[stack.index(m):] + [m]
+                    where = self.edges.get((n, m), ("", 0))
+                    self.findings.append(Finding(
+                        PASS, "lock-cycle", where[0], where[1],
+                        "lock acquisition cycle: "
+                        + " -> ".join(cyc)
+                        + " — two threads taking these in opposite "
+                          "order deadlock"))
+            stack.pop()
+            state[n] = 1
+
+        for n in list(adj):
+            if n not in state:
+                dfs(n)
+
+
+def analyze(files: List[SourceFile]
+            ) -> Tuple[List[Finding], Dict[str, List[str]]]:
+    """One walk: (findings, lock-acquisition graph)."""
+    a = _Analyzer([_Module(sf) for sf in files])
+    a.run()
+    a.find_cycles()
+    graph: Dict[str, List[str]] = {}
+    for (x, y) in sorted(a.edges):
+        graph.setdefault(x, []).append(y)
+    return a.findings, graph
+
+
+def run(files: List[SourceFile]) -> List[Finding]:
+    return analyze(files)[0]
+
+
+def edges(files: List[SourceFile]) -> Dict[str, List[str]]:
+    """The extracted lock-acquisition graph (report payload)."""
+    return analyze(files)[1]

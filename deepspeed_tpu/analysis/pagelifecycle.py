@@ -1,0 +1,146 @@
+"""Page-lifecycle / exception-safety pass (pass 3).
+
+PR 9's audit found the leak class this pass encodes: an exception
+raised BETWEEN page allocation and slot publish left pages owned by a
+``seq_id`` no slot referenced — ``release(seq_id)`` was never going to
+run, and the pool bled until preemption storms.  The fix idiom
+(``_try_admit``'s ``except BaseException`` ledger: cancel quarantines,
+drop pins, release the seq, clear the table row, re-raise) is what the
+checker demands wherever pages are acquired.
+
+Rule: in any function that calls ``<allocator>.allocate(...)``,
+``<allocator>.share(...)`` or ``<allocator>.begin_promotion(...)``
+(receiver spelled ``*.allocator``, ``al`` or ``alloc`` — the package
+idiom; the PageAllocator's own internals are out of scope), every
+acquiring call must sit lexically inside a ``try`` whose handler or
+``finally`` reaches matching cleanup — a call to ``release`` /
+``cancel_promotion`` / ``unpin`` / ``_fail_slot`` — so every path from
+the acquire to an exception edge releases what it took.
+
+Functions that hold the invariant another way (ownership is recorded
+atomically by the allocator and a caller's guard releases it, as in
+``_grow_pages`` / ``_begin_promotion``) say so in place with
+``# dstpu: page-guard-ok: <reason>`` on or above the ``def`` (or on
+the acquiring call) — the reason must name the cleanup path a reviewer
+can check.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .core import Finding, SourceFile, call_span, dotted_name
+
+PASS = "pagelifecycle"
+TAG = "page-guard-ok"
+
+ACQUIRE = ("allocate", "share", "begin_promotion")
+# the cleanup each acquire kind demands: a handler that cancels
+# promotions but forgot release() still leaks the allocated pages
+CLEANUP = {
+    "allocate": ("release", "_fail_slot"),
+    "share": ("release", "_fail_slot"),
+    "begin_promotion": ("cancel_promotion", "_fail_slot"),
+}
+_RECEIVERS = ("allocator", "al", "alloc")
+
+
+def _acquire_call(node: ast.AST) -> Optional[str]:
+    if not (isinstance(node, ast.Call) and
+            isinstance(node.func, ast.Attribute) and
+            node.func.attr in ACQUIRE):
+        return None
+    recv = dotted_name(node.func.value) or ""
+    last = recv.rsplit(".", 1)[-1]
+    if last in _RECEIVERS:
+        return f"{recv}.{node.func.attr}"
+    return None
+
+
+def _has_cleanup(nodes, wanted) -> bool:
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr in wanted:
+                return True
+    return False
+
+
+def _catches_everything(handler: ast.ExceptHandler) -> bool:
+    """Only a bare ``except:`` / ``except Exception`` / ``except
+    BaseException`` (or a tuple containing one) covers EVERY path to
+    the exception edge — cleanup in an ``except KeyError`` still
+    leaks on a ValueError."""
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and \
+                n.id in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _guarded(fn: ast.AST, call: ast.Call, kind: str) -> bool:
+    """Is ``call`` lexically inside a Try that reaches the cleanup
+    ``kind`` demands on EVERY exception path — a ``finally`` block, or
+    a catch-all handler?  (Nested Trys each get a chance — the
+    innermost guard wins.)"""
+    wanted = CLEANUP[kind]
+    for t in ast.walk(fn):
+        if not isinstance(t, ast.Try):
+            continue
+        within = any(call is sub
+                     for stmt in t.body for sub in ast.walk(stmt))
+        if not within:
+            continue
+        broad = [h for h in t.handlers if _catches_everything(h)]
+        if _has_cleanup(broad, wanted) or \
+                _has_cleanup(t.finalbody, wanted):
+            return True
+    return False
+
+
+def check_file(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in ast.walk(sf.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        acquires = [(n, _acquire_call(n)) for n in ast.walk(fn)]
+        acquires = [(n, name) for n, name in acquires if name]
+        if not acquires:
+            continue
+        top = fn.lineno
+        if fn.decorator_list:
+            top = min(d.lineno for d in fn.decorator_list)
+        fn_just = sf.justification(TAG, top, fn.lineno)
+        for node, name in acquires:
+            start, end = call_span(node)
+            if _guarded(fn, node, node.func.attr):
+                continue
+            j = fn_just or sf.justification(TAG, start, end)
+            if j is None:
+                findings.append(Finding(
+                    PASS, "unguarded-page-acquire", sf.rel, start,
+                    f"`{name}` in `{fn.name}` is not inside a try "
+                    f"whose handler/finally reaches "
+                    f"release/cancel_promotion/unpin/_fail_slot — an "
+                    f"exception between acquire and publish leaks the "
+                    f"pages (the PR 9 class); guard it or justify "
+                    f"with `# dstpu: {TAG}: <reason>`"))
+            elif not j[0]:
+                findings.append(Finding(
+                    PASS, "empty-justification", sf.rel, j[1],
+                    f"`# dstpu: {TAG}:` with no reason on `{name}` "
+                    f"in `{fn.name}` — name the cleanup path"))
+    return findings
+
+
+def run(files: List[SourceFile]) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in files:
+        out.extend(check_file(sf))
+    return out

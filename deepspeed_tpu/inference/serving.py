@@ -1228,6 +1228,7 @@ class ServingEngine:
                 self._kv_pool.discard(key)
 
     # ----------------------------------------------------------- scheduling
+    # dstpu: hot-path
     def _upload_dirty(self) -> None:
         """One batched host→device upload of whatever changed (the whole
         table is [max_batch, pages_per_seq] int32 — tiny; uploading it
@@ -1530,6 +1531,9 @@ class ServingEngine:
                 self._c_pc_published.inc()
 
     # ------------------------------------------------ KV tier: promote
+    # dstpu: page-guard-ok: every quarantine lands in page_map first,
+    # and the caller (_try_admit)'s BaseException handler cancels each
+    # page_map entry, drops the tier pins and releases the seq
     def _begin_promotion(self, b: int, tier_keys: List[bytes],
                          page_map: Dict[bytes, int]) -> _Promotion:
         """Start streaming a tier-matched span back into the fresh HBM
@@ -1896,6 +1900,7 @@ class ServingEngine:
             return
         self._demote_warm_batch(al.oldest_warm(excess))
 
+    # dstpu: hot-path
     def _advance_prefill(self, b: int, s: "_Slot") -> None:
         """Absorb the next chunk of slot ``b``'s prompt (one fixed-shape
         continuation forward: history + chunk).  On the final chunk,
@@ -2006,6 +2011,7 @@ class ServingEngine:
         self._pending_boundary.append(
             (b, logits_row, key, slot.req.temperature))
 
+    # dstpu: hot-path
     def _flush_boundary(self) -> None:
         if not self._pending_boundary:
             return
@@ -2019,12 +2025,16 @@ class ServingEngine:
         keys = [p[2] for p in pend] + [pend[0][2]] * pad
         temps = np.zeros((self.max_batch,), np.float32)
         temps[:len(pend)] = [p[3] for p in pend]
+        # dstpu: host-sync-ok: boundary sample fetch, one batched
+        # transfer per step for every prefill completion (replaced
+        # PR 7's per-slot device round-trip)
         toks = np.asarray(_sample_rows(
             jnp.stack(rows), jnp.stack(keys), self._put(temps)))
         self._c_boundary_syncs.inc()
         for (b, _, _, _), tok in zip(pend, toks):
             self._append_token(b, int(tok))
 
+    # dstpu: hot-path
     def _append_token(self, b: int, tok: int) -> None:
         s = self.slots[b]
         s.generated.append(tok)
@@ -2071,6 +2081,7 @@ class ServingEngine:
             self._table_dirty = self._lens_dirty = True
             self.slots[b] = None
 
+    # dstpu: hot-path
     def _grow_pages(self, ahead: int = 1) -> None:
         """Before decode writes: map every page the next ``ahead`` token
         positions will touch (chunked decode provisions its whole window
@@ -2100,6 +2111,10 @@ class ServingEngine:
                 if self.slots[b] is None:
                     break
                 self._ensure_free(1)
+                # dstpu: page-guard-ok: allocate records the page in
+                # owned[seq_id] atomically, so _fail_slot / preemption
+                # / fleet abandon_inflight release it with the seq —
+                # there is no owned-but-untracked window here
                 pg = self.allocator.allocate(s.seq_id, 1)[0]
                 self._table_host[b, slot_idx] = pg
                 self._table_dirty = True
@@ -2127,6 +2142,7 @@ class ServingEngine:
             self.slo_tracker.maybe_refresh()
         return list(self._newly_finished)
 
+    # dstpu: hot-path
     def _step_inner(self) -> None:
         if self._shed_deadline and self.queue:
             # BEFORE admission: a request whose deadline already
@@ -2220,7 +2236,9 @@ class ServingEngine:
                 s.seq_len += K
             self._c_decode_steps.inc(K)
             self._c_decode_syncs.inc()
-            host_toks = np.asarray(out)         # the ONE host sync
+            # dstpu: host-sync-ok: the ONE device→host transfer per
+            # decode chunk (K tokens per sync — the module contract)
+            host_toks = np.asarray(out)
             if self._trace_on and any(s.req.traced for _, s in active):
                 # one event per BATCH sync (not per token): the decode
                 # timeline at chunk granularity, nothing hotter
@@ -2253,6 +2271,7 @@ class ServingEngine:
                         f"published page {pg} (slot {b}, table slot "
                         f"{slot_idx}) — COW invariant violated")
 
+    # dstpu: hot-path
     def _spec_step(self, active) -> None:
         """One draft-and-verify sweep over every decode-ready slot.
 
@@ -2306,7 +2325,9 @@ class ServingEngine:
         if traced_any:
             self.tracer.event("spec_verify", attrs={
                 "active": len(active), "positions": K + 1})
-        n_acc, stop = jax.device_get((n_acc_d, stop_d))  # the ONE sync
+        # dstpu: host-sync-ok: the ONE device→host transfer per verify
+        # sweep (accepted lengths + stop tokens for the whole batch)
+        n_acc, stop = jax.device_get((n_acc_d, stop_d))
         self._c_decode_syncs.inc()
         self._c_decode_steps.inc(K + 1)
         self._c_spec_sweeps.inc()

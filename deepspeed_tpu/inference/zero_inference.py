@@ -353,6 +353,7 @@ class ZeroInferenceServingEngine(ServingEngine):
         return self._bjits[phase]
 
     # ------------------------------------------------------ layer sweep
+    # dstpu: hot-path
     def _layer_sweep(self):
         """Yield ``(l, layer_params)`` over all layers in order;
         streamed layers come off the double-buffered reader pipeline
@@ -380,6 +381,7 @@ class ZeroInferenceServingEngine(ServingEngine):
     # announced PR 9 schedule — read `engine.registry.snapshot()`)
 
     # ------------------------------------------------ streamed executors
+    # dstpu: hot-path
     def _run_blocks(self, phase, x, cos, sin, k_list, v_list, table,
                     start):
         bj = self._block_jit(phase)
@@ -394,6 +396,7 @@ class ZeroInferenceServingEngine(ServingEngine):
                     len(self._streamed_ids) * self._layer_bytes / dt)
         return x
 
+    # dstpu: hot-path
     def _forward_view(self, phase, toks, view):
         k_list, v_list = list(view.k), list(view.v)
         start = view.seq_lens
@@ -417,6 +420,7 @@ class ZeroInferenceServingEngine(ServingEngine):
         # suffix) scores every position of every active slot
         return self._forward_view("chunk", toks, view)
 
+    # dstpu: hot-path
     def _streamed_decode_chunk(self, _params, toks, cache, keys, temps):
         """K decode steps, host-driven: each step sweeps the layer
         stack (streamed weights double-buffered ahead), samples on

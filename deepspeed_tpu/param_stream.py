@@ -196,6 +196,7 @@ class TierLayerReader:
         return [self.tier.get_submit(n, s, d)
                 for n, s, d in zip(names, shapes, dtypes)]
 
+    # dstpu: hot-path
     def _fence_retry(self, l: int, pending):
         """Fence item ``l``'s reads with graceful degradation: a
         transient IO failure resubmits the item's reads (bounded,
@@ -252,6 +253,7 @@ class TierLayerReader:
             f"({last!r}); flight-recorder postmortem: "
             f"{paths or 'no recorder live'}", postmortem_paths=paths)
 
+    # dstpu: hot-path
     def presubmit(self, l: int):
         """Submit item ``l``'s tier reads NOW, outside the sweep
         generator (generators are lazy — the first ``_submit`` would
@@ -263,6 +265,7 @@ class TierLayerReader:
         suffix-prefill chunk needs the pages."""
         return self._submit(l)
 
+    # dstpu: hot-path
     def sweep(self, order, on_wait=None, primed=None):
         """Yield ``(l, device_tree)`` over ``order`` with the next
         layer's reads/upload in flight; ``on_wait(seconds)`` reports

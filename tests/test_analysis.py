@@ -1,0 +1,521 @@
+"""dstpu-lint analyzer tests: fixture snippets per pass (known-
+violation / known-clean pairs, justification handling), baseline
+round-trip, CLI exit codes, and the whole-package run as the tier-1
+gate (budget-aware — over budget, the remaining passes self-demote to
+the slow lane, where the ``slow``-marked twin always runs all four).
+
+The analysis package is stdlib-only and loaded standalone (no jax, no
+``deepspeed_tpu.__init__``) via the CLI's own loader, so these tests
+cost parse time, not import time.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import dstpu_lint  # noqa: E402
+
+analysis = dstpu_lint.load_analysis()
+hostsync = analysis.hostsync
+lockorder = analysis.lockorder
+pagelifecycle = analysis.pagelifecycle
+parity = analysis.parity
+from_source = analysis.from_source
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# ------------------------------------------------------------- hostsync
+def test_hostsync_flags_every_sync_kind_in_hot_region():
+    sf = from_source('''
+import numpy as np
+# dstpu: hot-path
+def decode(arr):
+    a = arr.item()
+    b = np.asarray(arr)
+    c = np.array(arr)
+    d = float(arr)
+    e = bool(arr)
+    import jax
+    f = jax.device_get(arr)
+    return a, b, c, d, e, f
+''')
+    got = hostsync.run([sf])
+    assert codes(got) == ["host-sync-in-hot-path"] * 6
+
+
+def test_hostsync_unmarked_function_is_out_of_scope():
+    sf = from_source('''
+import numpy as np
+def cold(arr):
+    return np.asarray(arr).item()
+''')
+    assert hostsync.run([sf]) == []
+
+
+def test_hostsync_justification_and_device_side_calls_pass():
+    sf = from_source('''
+import numpy as np
+import jax.numpy as jnp
+# dstpu: hot-path
+def decode(arr, out):
+    # dstpu: host-sync-ok: the one batched transfer per step
+    toks = np.asarray(out)
+    dev = jnp.asarray(arr)          # device-side: not a sync
+    n = float(1.5)                  # literal: not a sync
+    return toks, dev, n
+''')
+    assert hostsync.run([sf]) == []
+    assert hostsync.stats([sf]) == {"hot_regions": 1,
+                                    "justified_syncs": 1}
+
+
+def test_hostsync_empty_justification_and_orphan_marker():
+    sf = from_source('''
+import numpy as np
+
+# dstpu: hot-path
+
+X = 1
+
+# dstpu: hot-path
+def decode(arr):
+    return np.asarray(arr)  # dstpu: host-sync-ok:
+''')
+    assert codes(hostsync.run([sf])) == ["empty-justification",
+                                         "orphan-hot-path-marker"]
+
+
+# ------------------------------------------------------------ lockorder
+def test_lockorder_callback_sleep_reentry():
+    sf = from_source('''
+import threading, time
+class T:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.alert_hook = None
+    def fire(self):
+        with self._lock:
+            self.alert_hook("x")
+            time.sleep(1)
+    def outer(self):
+        with self._lock:
+            self.inner()          # one-level call-through
+    def inner(self):
+        with self._lock:
+            pass
+''')
+    assert codes(lockorder.run([sf])) == [
+        "callback-under-lock", "lock-reentry", "sleep-under-lock"]
+
+
+def test_lockorder_callback_via_helper_under_lock():
+    # the PR 6 shape: the lock-holding method calls a helper which
+    # fires the pluggable hook — caught one call level deep
+    sf = from_source('''
+import threading
+class T:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.alert_hook = None
+    def refresh(self):
+        with self._lock:
+            self._emit(1)
+    def _emit(self, info):
+        self.alert_hook(info)
+''')
+    assert codes(lockorder.run([sf])) == ["callback-under-lock"]
+
+
+def test_lockorder_clean_fire_after_release_and_rlock():
+    sf = from_source('''
+import threading
+class T:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rlock = threading.RLock()
+        self.alert_hook = None
+    def fire(self):
+        with self._lock:
+            info = 1
+        self.alert_hook(info)     # after release: the blessed idiom
+    def reenter(self):
+        with self._rlock:
+            self.inner()
+    def inner(self):
+        with self._rlock:
+            pass
+''')
+    assert lockorder.run([sf]) == []
+
+
+def test_lockorder_cycle_and_justified_callback():
+    sf = from_source('''
+import threading
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+def f():
+    with a_lock:
+        with b_lock:
+            pass
+def g():
+    with b_lock:
+        with a_lock:
+            pass
+''')
+    assert codes(lockorder.run([sf])) == ["lock-cycle"]
+    sf = from_source('''
+import threading
+class T:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.demote_hook = None
+    def fire(self):
+        with self._lock:
+            # dstpu: lock-ok: hook is a pure dict update by contract
+            self.demote_hook(1)
+''')
+    assert lockorder.run([sf]) == []
+
+
+def test_lockorder_manual_acquire_is_flagged():
+    # the analyzer models critical sections through `with` only, so
+    # the acquire()/release() idiom — which would make the PR 6 shape
+    # invisible — is itself a violation
+    sf = from_source('''
+import threading
+class T:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.alert_hook = None
+    def fire(self):
+        self._lock.acquire()
+        try:
+            self.alert_hook("x")
+        finally:
+            self._lock.release()
+''')
+    assert "manual-lock-acquire" in codes(lockorder.run([sf]))
+    sf = from_source('''
+import threading
+class T:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def fire(self, cond):
+        # dstpu: lock-ok: conditional hand-off, released by consumer
+        self._lock.acquire()
+''')
+    assert lockorder.run([sf]) == []
+
+
+def test_lockorder_extracts_acquisition_graph():
+    sf = from_source('''
+import threading
+a_lock = threading.Lock()
+b_lock = threading.Lock()
+def f():
+    with a_lock:
+        with b_lock:
+            pass
+''')
+    g = lockorder.edges([sf])
+    assert g == {"<fixture>:a_lock": ["<fixture>:b_lock"]}
+
+
+# -------------------------------------------------------- pagelifecycle
+def test_pagelifecycle_unguarded_guarded_justified():
+    sf = from_source('''
+class E:
+    def bad(self):
+        pages = self.allocator.allocate(1, 4)
+        self.table[0] = pages
+
+    def good(self):
+        self.allocator.share(1, [2])
+        try:
+            pages = self.allocator.allocate(1, 4)
+            self.publish(pages)
+        except BaseException:
+            self.allocator.release(1)
+            raise
+
+    def good_finally(self):
+        try:
+            self.allocator.begin_promotion(3, b"k")
+        finally:
+            self.allocator.cancel_promotion(3)
+
+    # dstpu: page-guard-ok: ownership recorded atomically by allocate
+    def justified(self):
+        return self.allocator.allocate(1, 1)
+
+    def not_an_allocator(self, reader):
+        return reader.share(1)     # receiver is not allocator-shaped
+''')
+    got = pagelifecycle.run([sf])
+    # `good` has one acquire OUTSIDE its try (the share) — by design:
+    # share-before-allocate must still be covered by the guard
+    assert codes(got) == ["unguarded-page-acquire",
+                          "unguarded-page-acquire"]
+    assert sorted(f.line for f in got) == [4, 8]
+
+
+def test_pagelifecycle_guard_must_match_kind_and_catch_everything():
+    # a handler that cancels promotions but forgot release() still
+    # leaks the allocated pages
+    sf = from_source('''
+class E:
+    def wrong_cleanup(self):
+        try:
+            self.allocator.allocate(1, 4)
+        except BaseException:
+            self.allocator.cancel_promotion(3)
+            raise
+''')
+    assert codes(pagelifecycle.run([sf])) == ["unguarded-page-acquire"]
+    # a narrow handler covers only ONE path to the exception edge —
+    # a ValueError between acquire and publish still leaks
+    sf = from_source('''
+class E:
+    def narrow(self):
+        try:
+            self.allocator.allocate(1, 4)
+        except KeyError:
+            self.allocator.release(1)
+            raise
+''')
+    assert codes(pagelifecycle.run([sf])) == ["unguarded-page-acquire"]
+    # finally and tuple-with-catch-all both cover every path
+    sf = from_source('''
+class E:
+    def fin(self):
+        try:
+            self.allocator.allocate(1, 4)
+        finally:
+            self.allocator.release(1)
+    def tup(self):
+        try:
+            self.allocator.allocate(1, 4)
+        except (KeyError, BaseException):
+            self.allocator.release(1)
+            raise
+''')
+    assert pagelifecycle.run([sf]) == []
+
+
+# --------------------------------------------------------------- parity
+_CFG_SRC = '''
+import dataclasses
+@dataclasses.dataclass
+class DemoConfig:
+    enabled: bool = False
+    knob_a: int = 1
+    knob_b: float = 0.5
+'''
+
+_MD_OK = """
+## `demo` (a demo block)
+
+| key | default | notes |
+|---|---|---|
+| `enabled` | false | opt-in |
+| `knob_a` | 1 | the a knob |
+
+prose mentioning `knob_b` counts as documentation too.
+"""
+
+_MD_DRIFT = """
+## `demo` (a demo block)
+
+| key | default | notes |
+|---|---|---|
+| `knob_a` | 1 | the a knob |
+| `ghost_key` | 0 | documented but nonexistent |
+"""
+
+
+def test_parity_config_doc_clean_and_drift():
+    cfg = from_source(_CFG_SRC, rel="config.py")
+    blocks = {"DemoConfig": "demo"}
+    assert parity.check_config_doc(cfg, _MD_OK, blocks=blocks) == []
+    got = parity.check_config_doc(cfg, _MD_DRIFT, blocks=blocks)
+    msgs = " | ".join(f.message for f in got)
+    assert codes(got) == ["config-doc-drift", "config-doc-drift"]
+    assert "knob_b" in msgs and "ghost_key" in msgs
+
+
+def test_parity_metric_citations():
+    src = from_source('''
+class E:
+    def __init__(self, r):
+        self.c = r.counter("serving_decode_syncs", "h")
+        self.g = r.gauge(f"slo_{name}_attainment", "h")
+    def go(self):
+        self.tracer.event("kv_promote_failed", 1)
+''')
+    docs_ok = {"DOC.md": "cites `serving_decode_syncs`, "
+                         "`slo_<tier>_attainment`, `serving_*` and "
+                         "`kv_promote_failed` — wait, that last one "
+                         "is an event: `slo_interactive_attainment`"}
+    assert parity.check_metric_citations([src], docs_ok) == []
+    docs_bad = {"DOC.md": "cites `serving_decode_stalls_total`"}
+    got = parity.check_metric_citations([src], docs_bad)
+    assert codes(got) == ["metric-doc-drift"]
+    # 2-segment API names sharing a family prefix are not citations
+    assert parity.check_metric_citations(
+        [src], {"DOC.md": "`serving_engine` builds on `aio_read`"}) == []
+
+
+_FAULTS_SRC = '''
+"""table:
+
+sub_a   hook a
+sub_b   hook b
+"""
+SUBSYSTEMS = ("sub_a", "sub_b")
+MODES = ("error", "latency")
+_KEYED_SUBSYSTEMS = ("sub_b",)
+'''
+
+_FAULTS_MD = """
+## `faults` (chaos)
+
+| key | notes |
+|---|---|
+| `rules` | `subsystem` (`sub_a`/`sub_b`), `mode` (`error`\\|`latency`) |
+| `match` | keyed subsystems only: `sub_b` |
+"""
+
+
+def test_parity_faults_doc_clean_and_drift():
+    f = from_source(_FAULTS_SRC, rel="faults.py")
+    assert parity.check_faults_doc(f, _FAULTS_MD) == []
+    bad_md = _FAULTS_MD.replace("only: `sub_b`", "only: `sub_a`")
+    got = parity.check_faults_doc(f, bad_md)
+    assert "fault-table-drift" in codes(got)
+
+
+def test_parity_trace_pairing():
+    ok = {"traceEvents": [
+        {"ph": "M", "pid": 1, "tid": 0, "name": "process_name"},
+        {"ph": "b", "cat": "r", "id": "0", "name": "request", "ts": 0.0},
+        {"ph": "i", "cat": "r", "ts": 1.0, "name": "tick"},
+        {"ph": "e", "cat": "r", "id": "0", "name": "request", "ts": 2.0},
+    ]}
+    assert parity.check_trace_pairing(ok, "t") == []
+    unpaired = {"traceEvents": [
+        {"ph": "b", "cat": "r", "id": "0", "name": "request", "ts": 0.0},
+    ]}
+    assert codes(parity.check_trace_pairing(unpaired, "t")) == \
+        ["trace-unpaired"]
+    backwards = {"traceEvents": [
+        {"ph": "b", "cat": "r", "id": "0", "name": "request", "ts": 5.0},
+        {"ph": "e", "cat": "r", "id": "0", "name": "request", "ts": 1.0},
+    ]}
+    assert codes(parity.check_trace_pairing(backwards, "t")) == \
+        ["trace-nonmonotonic"]
+
+
+# ------------------------------------------------------------- baseline
+def test_baseline_roundtrip(tmp_path):
+    f = analysis.Finding("hostsync", "host-sync-in-hot-path",
+                         "pkg/x.py", 3, "m")
+    unwaived, waived = analysis.apply_baseline(
+        [f], {"version": 1, "waivers": []})
+    assert (len(unwaived), waived) == (1, 0)
+    waiver = {"pass": "hostsync", "code": "host-sync-in-hot-path",
+              "path": "pkg/x.py", "reason": "fixture"}
+    unwaived, waived = analysis.apply_baseline(
+        [f], {"version": 1, "waivers": [waiver]})
+    assert (len(unwaived), waived) == (0, 1)
+    with pytest.raises(ValueError):
+        analysis.apply_baseline(
+            [f], {"waivers": [{k: v for k, v in waiver.items()
+                               if k != "reason"}]})
+    p = tmp_path / "LINT_BASELINE.json"
+    p.write_text(json.dumps({"version": 1, "waivers": [waiver]}))
+    doc = analysis.load_baseline(str(p))
+    assert doc["waivers"] == [waiver]
+    assert analysis.load_baseline(str(tmp_path / "missing.json")) == \
+        {"version": 1, "waivers": []}
+
+
+def test_committed_baseline_has_zero_waivers():
+    doc = analysis.load_baseline(
+        os.path.join(REPO, "LINT_BASELINE.json"))
+    assert doc["waivers"] == []
+
+
+# ------------------------------------------------------------------ CLI
+def _fixture_tree(tmp_path, source: str) -> str:
+    pkg = tmp_path / "deepspeed_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "mod.py").write_text(source)
+    return str(tmp_path)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = _fixture_tree(tmp_path / "bad", '''
+# dstpu: hot-path
+def decode(arr):
+    return arr.item()
+''')
+    assert dstpu_lint.main(
+        ["--check", "--root", bad, "--pass", "hostsync"]) == 1
+    clean = _fixture_tree(tmp_path / "clean", '''
+def cold(arr):
+    return arr.item()
+''')
+    out = str(tmp_path / "clean" / "LINT_REPORT.json")
+    assert dstpu_lint.main(
+        ["--check", "--root", clean, "--pass", "hostsync",
+         "--json-out", out]) == 0
+    rep = json.loads(open(out).read())
+    assert rep["ok"] and rep["violations"] == 0 and rep["waivers"] == 0
+    assert rep["passes_run"] == 1
+    broken = _fixture_tree(tmp_path / "broken", "def broken(:\n")
+    assert dstpu_lint.main(
+        ["--check", "--root", broken, "--pass", "hostsync"]) == 2
+    capsys.readouterr()
+
+
+# ------------------------------------------------- whole-package (tier-1)
+# tier-1 headroom is ~19 s (ROADMAP baseline note); the analyzer runs
+# in well under 2 s, but if it ever grows past this budget the
+# remaining passes self-demote — the slow twin below always runs all 4
+_TIER1_BUDGET_S = 12.0
+
+
+def test_whole_package_lint_clean_tier1():
+    rep = analysis.check_repo(REPO, budget_s=_TIER1_BUDGET_S)
+    assert rep["violations"] == 0, "\n".join(
+        "%(path)s:%(line)s [%(pass_name)s/%(code)s] %(message)s" % f
+        for f in rep["findings"])
+    assert rep["waivers"] == 0
+    assert rep["passes_run"] >= 1
+    if not rep["demoted"]:
+        assert rep["passes_run"] == len(analysis.PASSES)
+    # the hot-path contract stays in force: the marked regions of
+    # serving.py / param_stream.py / zero_inference.py
+    assert rep["hot_regions"] >= 10
+    assert rep["justified_syncs"] >= 3
+    # the acquisition graph stays a forest of leaves (no edges today);
+    # an edge appearing is fine, a cycle is a violation caught above.
+    # (only present when the lockorder pass was not demoted)
+    if "lockorder" not in rep["demoted"]:
+        assert isinstance(rep["lock_graph"], dict)
+
+
+@pytest.mark.slow
+def test_whole_package_lint_all_passes_slow():
+    rep = analysis.check_repo(REPO)           # no budget: all four
+    assert rep["passes_run"] == len(analysis.PASSES)
+    assert rep["demoted"] == []
+    assert rep["violations"] == 0, rep["findings"]

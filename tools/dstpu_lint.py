@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""dstpu-lint CLI: project-native static analysis over deepspeed_tpu.
+
+Runs the four pass families of ``deepspeed_tpu.analysis`` (hot-path
+host-sync lint, lock-order/lock-scope checker, page-lifecycle
+exception-safety pass, surface-parity gates incl. the Chrome-trace
+pairing check) against the repo and diffs the result against the
+committed zero-waiver baseline (``LINT_BASELINE.json``).
+
+    python tools/dstpu_lint.py --check                  # exit 1 on any
+                                                        # violation
+    python tools/dstpu_lint.py --check --json-out LINT_REPORT.json
+    python tools/dstpu_lint.py --check --pass hostsync --pass parity
+
+Exit codes: 0 clean, 1 violations found, 2 usage/internal error.
+
+``tools/run_slow_lane.sh`` runs ``--check`` each cadence and stamps
+``LINT_REPORT.json``; ``BENCH_BASELINE.json`` rows pin
+``violations == 0``, ``waivers == 0`` and ``passes_run >= 4`` so the
+bench gate fails on lint regression.  Tier-1 runs the same check
+in-process via ``tests/test_analysis.py`` (budget-aware).
+
+Implementation note: the analysis package is loaded straight off its
+files, NOT via ``import deepspeed_tpu`` — the package ``__init__``
+pulls in jax and the engines, and a linter that imports its subject is
+both slow and breakable by the very bugs it hunts.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_analysis(root: str = REPO):
+    """Load ``deepspeed_tpu/analysis`` as a standalone package (no
+    parent ``__init__`` execution, no jax)."""
+    name = "dstpu_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(root, "deepspeed_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _atomic_write_json(doc: dict, path: str) -> None:
+    # local copy of utils/evidence.atomic_write_json: this tool must
+    # not import the package under analysis
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="project-native static analysis (dstpu-lint)")
+    ap.add_argument("--check", action="store_true",
+                    help="run the passes and gate against the "
+                         "baseline (default action)")
+    ap.add_argument("--root", default=REPO,
+                    help="repo root to analyze")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default: "
+                         "<root>/LINT_BASELINE.json)")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    default=None, metavar="NAME",
+                    help="run only this pass (repeatable); default: "
+                         "all four")
+    ap.add_argument("--budget-s", type=float, default=None,
+                    help="skip passes that would start past this "
+                         "many seconds (tier-1 budget awareness)")
+    ap.add_argument("--json-out", default=None,
+                    help="also stamp the report document (atomic)")
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+
+    # the pass implementations always come from THIS repo; --root only
+    # selects the tree under analysis (fixture trees in tests)
+    analysis = load_analysis()
+    if args.list_passes:
+        for p in analysis.PASSES:
+            print(p)
+        return 0
+
+    try:
+        report = analysis.check_repo(
+            args.root, baseline_path=args.baseline,
+            passes=tuple(args.passes) if args.passes
+            else analysis.PASSES,
+            budget_s=args.budget_s)
+    except (OSError, ValueError, SyntaxError) as e:
+        print(f"dstpu_lint: internal error: {e}", file=sys.stderr)
+        return 2
+
+    for f in report["findings"]:
+        print(f"{f['path']}:{f['line']}: [{f['pass_name']}/"
+              f"{f['code']}] {f['message']}")
+    demoted = report.get("demoted") or []
+    print(f"dstpu_lint: {report['passes_run']} passes, "
+          f"{report['violations']} violations, "
+          f"{report['waivers']} waivers, "
+          f"{report.get('hot_regions', 0)} hot regions "
+          f"({report.get('justified_syncs', 0)} justified syncs)"
+          + (f", demoted to slow lane: {demoted}" if demoted else ""))
+    if args.json_out:
+        import time
+
+        report["t"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        _atomic_write_json(report, args.json_out)
+        print("→", args.json_out)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

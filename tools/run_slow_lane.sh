@@ -146,6 +146,16 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_serving.py --cpu \
   --tp 2 --requests 16 --new-tokens 32 --cpu-dim 256 --cpu-layers 2 \
   --json-out "$REPO/TP_BENCH.json" >/dev/null 2>&1 || true
 
+# static analysis: the four dstpu-lint pass families (hot-path
+# host-sync lint, lock-order/scope, page lifecycle, surface parity
+# incl. the Chrome-trace pairing check against the selftest stamp
+# above) against the committed zero-waiver baseline.  Stamps
+# LINT_REPORT.json; bench_gate pins violations == 0, waivers == 0,
+# passes_run >= 4.  No JAX needed — the linter never imports the
+# package it judges.
+timeout -k 10 300 python tools/dstpu_lint.py --check \
+  --json-out "$REPO/LINT_REPORT.json" || true
+
 # bench regression gate: AFTER the stamps above, diff the evidence
 # files against the committed BENCH_BASELINE.json and leave a verdict
 # in BENCH_GATE.json — the perf trajectory as an enforced contract.
