@@ -193,6 +193,9 @@ def elastic_main(args) -> int:
               prefix_cache=True, slo=slo,
               shed_queue_depth=args.replica_shed)
 
+    import tempfile
+
+    inc_dir = tempfile.mkdtemp(prefix="dstpu_elastic_bench_inc_")
     router = fleet_router(
         params, cfg,
         fleet={"replicas": 1, "retry_budget": 2,
@@ -202,7 +205,18 @@ def elastic_main(args) -> int:
                # elastic response to crest-of-wave shed activity
                "quarantine_after": 10_000,
                "digest_refresh_steps": 2},
-        tracing={"ring_capacity": 262144}, seed=args.seed, **kw)
+        tracing={"ring_capacity": 262144}, seed=args.seed,
+        # fault-free arm of the incident gate (ISSUE 15): history +
+        # incidents run live through the wave with ONLY the hard
+        # triggers armed (crest-of-wave sheds are expected load
+        # behavior here, not an incident; no anomaly detectors) — a
+        # fault-free bench that writes any bundle is a false positive,
+        # gated at 0 in BENCH_BASELINE
+        history={"sample_interval_s": 0.25},
+        incidents={"dir": inc_dir, "eval_interval_s": 0.25,
+                   "shed_storm_threshold": 0, "detect": (),
+                   "pre_window_s": 60.0},
+        **kw)
 
     def factory(rid, streamed=False):
         return serving_engine(
@@ -264,6 +278,11 @@ def elastic_main(args) -> int:
             break
         time.sleep(0.002)
 
+    # final evaluation: a trigger event landed during the wave's last
+    # steps (after the last 0.25 s tick) must still be classified, or
+    # the incident_bundles == 0 gate passes on an undrained ring
+    router.incident_mgr.evaluate()
+
     fin = router.finished
     completed = [k for k, v in fin.items() if isinstance(v, list)]
     failed = [k for k, v in fin.items()
@@ -311,10 +330,15 @@ def elastic_main(args) -> int:
         if first_tok else None,
         "rollout": dict(auto.last_rollout or {}),
         # the gate rows: an elastic fleet that drops, strands or leaks
-        # even one request regressed
+        # even one request regressed — and a fault-free wave that
+        # writes an incident bundle is a false positive (gated at 0)
         "rollout_dropped": len(failed),
         "orphaned_requests": len(router.orphaned()),
         "leak_count": len(router.check_leaks()),
+        "incident_bundles": len(router.incident_mgr.bundles),
+        "incident_suppressed": int(
+            router.incident_mgr.snapshot().get("suppressed", 0)),
+        "history_series": len(router.history.series_names()),
         "replica_buckets": [
             {"t_s": round(b * 0.5, 1), **rec}
             for b, rec in sorted(buckets.items())],
@@ -329,6 +353,7 @@ def elastic_main(args) -> int:
     ok = (out["rollout_dropped"] == 0 and out["orphaned_requests"] == 0
           and out["leak_count"] == 0 and out["scale_ups"] >= 1
           and out["scale_downs"] >= 1
+          and out["incident_bundles"] == 0
           and (auto.last_rollout or {}).get("completed", False))
     return 0 if ok else 1
 

@@ -51,6 +51,8 @@ CONFIG_BLOCKS = {
     "AutoscaleConfig": "autoscale",
     "TelemetryConfig": "telemetry",
     "TracingConfig": "tracing",
+    "HistoryConfig": "history",
+    "IncidentsConfig": "incidents",
     "MeshConfig": "mesh",
 }
 
@@ -60,7 +62,7 @@ CONFIG_BLOCKS = {
 METRIC_FAMILIES = (
     "serving_", "prefix_cache_", "spec_", "kv_tier_", "slo_",
     "fleet_", "autoscale_", "zi_", "pstream_", "aio_",
-    "tier_reader_", "comm_", "infinity_",
+    "tier_reader_", "comm_", "infinity_", "history_", "incident_",
 )
 # bench-evidence JSON namespaces and row labels that share a family
 # prefix but are not registry metrics (cited next to the metrics in
@@ -198,7 +200,11 @@ def registered_metrics(files: List[SourceFile]
                 record(node.args[0])
             elif attr == "span":
                 record(node.args[0], suffix="_seconds")
-            elif attr == "event":
+            elif attr in ("event", "_event"):
+                # `_event`: the autoscaler's ledger+tracer wrapper —
+                # its literal kinds are trace events too (the docs
+                # cite them; `event` alone would miss every emit that
+                # goes through the wrapper)
                 a = node.args[0]
                 if isinstance(a, ast.Constant) and \
                         isinstance(a.value, str):

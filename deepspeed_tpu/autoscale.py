@@ -170,6 +170,13 @@ class FleetAutoscaler:
                             "t": time.perf_counter(), **attrs})
         if self._tracer.enabled:
             self._tracer.event(kind, attrs=attrs)
+        # scale/rollout decisions double as history annotations: the
+        # /historyz timeline (and any incident bundle's pre-window)
+        # shows WHEN the fleet breathed next to the series that made
+        # it breathe
+        h = getattr(self.router, "history", None)
+        if h is not None:
+            h.annotate(kind, attrs)
 
     # ------------------------------------------------------------- drive
     def step(self) -> List[Any]:

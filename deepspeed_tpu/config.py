@@ -1009,6 +1009,182 @@ class TracingConfig:
 
 
 @dataclasses.dataclass
+class HistoryConfig:
+    """Time-series metric history block (no reference analogue; the
+    fourth observability pillar next to ``telemetry``/``tracing``/
+    ``slo`` — retained trajectories instead of point-in-time gauges,
+    see :mod:`deepspeed_tpu.history`).
+
+    Multi-resolution ring buffers over the engine's registry, sampled
+    on the :class:`~deepspeed_tpu.telemetry.TelemetryExporter` tick —
+    never the decode hot path.  ``rings`` is a tuple of
+    ``(period_s, samples)`` pairs (default: 1 s × 120 plus 10 s × 360 —
+    two minutes fine, one hour coarse, fixed memory).  Counters record
+    as RATES (reset-tolerant), gauges as last value, histograms as
+    p50/p95 of the samples landed since the previous tick.
+    ``sample_interval_s`` sets the tick cadence; ``metrics`` restricts
+    the tracked names (None = every registry metric, bounded by
+    ``max_series``); ``max_annotations`` bounds the event-annotation
+    ring (autoscaler scale/rollout marks).
+    """
+
+    enabled: bool = False
+    sample_interval_s: float = 1.0       # tick cadence (exporter-driven)
+    rings: tuple = ((1.0, 120), (10.0, 360))   # (period_s, samples)
+    metrics: Optional[tuple] = None      # None = all registry metrics
+    max_series: int = 256                # hard cap on tracked series
+    max_annotations: int = 256           # scale/rollout marks kept
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "HistoryConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        h = cls(**{k: v for k, v in d.items() if k in known})
+        h.sample_interval_s = float(h.sample_interval_s)
+        if h.sample_interval_s <= 0:
+            raise ValueError(
+                f"history.sample_interval_s must be positive, got "
+                f"{h.sample_interval_s}")
+        rings = tuple((float(p), int(n)) for p, n in h.rings)
+        if not rings or any(p <= 0 or n < 1 for p, n in rings):
+            raise ValueError(
+                f"history.rings must be non-empty (period_s > 0, "
+                f"samples >= 1) pairs, got {h.rings}")
+        if list(p for p, _ in rings) != sorted(set(p for p, _ in rings)):
+            raise ValueError(
+                f"history.rings periods must be strictly increasing, "
+                f"got {h.rings}")
+        h.rings = rings
+        if h.metrics is not None:
+            h.metrics = tuple(str(m) for m in h.metrics)
+        h.max_series = int(h.max_series)
+        h.max_annotations = int(h.max_annotations)
+        if h.max_series < 1 or h.max_annotations < 1:
+            raise ValueError(
+                "history.max_series and history.max_annotations must "
+                f"be >= 1, got {h.max_series}/{h.max_annotations}")
+        return h
+
+    @classmethod
+    def coerce(cls, obj) -> "HistoryConfig":
+        """Accept None (disabled), a bool, a dict (writing the block is
+        the opt-in, like ``slo``), or a HistoryConfig."""
+        if obj is None:
+            return cls(enabled=False)
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, bool):
+            return cls.from_dict({"enabled": obj}) if obj \
+                else cls(enabled=False)
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("enabled", True)   # passing a block opts in
+            if not d["enabled"]:
+                return cls(enabled=False)
+            return cls.from_dict(d)
+        raise TypeError(
+            f"history must be a bool, dict or HistoryConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
+class IncidentsConfig:
+    """Incident-capture block (no reference analogue; the black-box
+    flight recorder's trip logic — see
+    :mod:`deepspeed_tpu.incidents`).
+
+    An :class:`~deepspeed_tpu.incidents.IncidentManager` subscribes to
+    the structured events the stack already emits (``slo_burn_alert``,
+    KV-tier promotion failures, replica failover, rollout rollbacks,
+    watchdog fires, shed storms) plus lightweight EWMA z-score
+    detectors over ``detect`` history series, and on a trip captures an
+    atomic JSON **incident bundle** into ``dir``: the triggering event,
+    ``pre_window_s`` of metric history, the last ``ring_events``
+    flight-recorder events around t0, and the /statusz + SLO snapshot.
+    ``dedup_window_s`` rate-limits per incident class (a burn storm
+    yields one bundle, not hundreds) and ``max_bundles`` caps bundles
+    per process.  ``shed_storm_threshold`` sheds per evaluation tick
+    that count as a storm (0 disables the storm trigger);
+    ``z_threshold``/``ewma_alpha``/``min_samples`` tune the anomaly
+    detectors, evaluated every ``eval_interval_s``.
+    """
+
+    enabled: bool = False
+    dir: str = "/tmp/dstpu_incidents"    # bundle output directory
+    pre_window_s: float = 60.0           # history window in the bundle
+    dedup_window_s: float = 30.0         # per-class rate limit
+    max_bundles: int = 16                # per-process bundle cap
+    ring_events: int = 256               # flight-recorder slice size
+    # history series for the EWMA z detectors: None = the consumer's
+    # defaults (engines watch TTFT p95 + per-tier goodput); an
+    # EXPLICIT empty list disables the detectors — with
+    # shed_storm_threshold 0 that arms only the hard triggers
+    detect: Optional[tuple] = None
+    z_threshold: float = 4.0             # |z| trip bound
+    ewma_alpha: float = 0.2              # EWMA smoothing factor
+    min_samples: int = 12                # warmup before a z can trip
+    eval_interval_s: float = 1.0         # detector/evaluation cadence
+    shed_storm_threshold: int = 8        # sheds/tick = storm; 0 = off
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "IncidentsConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        c = cls(**{k: v for k, v in d.items() if k in known})
+        for name in ("pre_window_s", "dedup_window_s", "z_threshold",
+                     "ewma_alpha", "eval_interval_s"):
+            setattr(c, name, float(getattr(c, name)))
+        for name in ("max_bundles", "ring_events", "min_samples",
+                     "shed_storm_threshold"):
+            setattr(c, name, int(getattr(c, name)))
+        if c.pre_window_s <= 0 or c.eval_interval_s <= 0:
+            raise ValueError(
+                "incidents.pre_window_s and incidents.eval_interval_s "
+                f"must be positive, got {c.pre_window_s}/"
+                f"{c.eval_interval_s}")
+        if c.dedup_window_s < 0 or c.shed_storm_threshold < 0:
+            raise ValueError(
+                "incidents.dedup_window_s and "
+                "incidents.shed_storm_threshold must be >= 0, got "
+                f"{c.dedup_window_s}/{c.shed_storm_threshold}")
+        if c.max_bundles < 1 or c.ring_events < 1 or c.min_samples < 1:
+            raise ValueError(
+                "incidents.max_bundles, incidents.ring_events and "
+                "incidents.min_samples must be >= 1, got "
+                f"{c.max_bundles}/{c.ring_events}/{c.min_samples}")
+        if not 0.0 < c.ewma_alpha <= 1.0:
+            raise ValueError(
+                f"incidents.ewma_alpha must be in (0, 1], got "
+                f"{c.ewma_alpha}")
+        if c.z_threshold <= 0:
+            raise ValueError(
+                f"incidents.z_threshold must be positive, got "
+                f"{c.z_threshold}")
+        if c.detect is not None:
+            c.detect = tuple(str(s) for s in c.detect)
+        return c
+
+    @classmethod
+    def coerce(cls, obj) -> "IncidentsConfig":
+        """Accept None (disabled), a bool, a dict (writing the block is
+        the opt-in, like ``history``), or an IncidentsConfig."""
+        if obj is None:
+            return cls(enabled=False)
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, bool):
+            return cls.from_dict({"enabled": obj}) if obj \
+                else cls(enabled=False)
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("enabled", True)   # passing a block opts in
+            if not d["enabled"]:
+                return cls(enabled=False)
+            return cls.from_dict(d)
+        raise TypeError(
+            f"incidents must be a bool, dict or IncidentsConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class PrecisionConfig:
     """ref: deepspeed/runtime/fp16/loss_scaler.py + config fp16/bf16 blocks."""
 
@@ -1170,6 +1346,10 @@ class Config:
         default_factory=TelemetryConfig)
     tracing: TracingConfig = dataclasses.field(
         default_factory=TracingConfig)
+    history: HistoryConfig = dataclasses.field(
+        default_factory=HistoryConfig)
+    incidents: IncidentsConfig = dataclasses.field(
+        default_factory=IncidentsConfig)
     raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     # ---------------------------------------------------------------- parse
@@ -1311,6 +1491,14 @@ class Config:
             c.telemetry = TelemetryConfig.coerce(d["telemetry"])
         if "tracing" in d:
             c.tracing = TracingConfig.coerce(d["tracing"])
+        if "history" in d:
+            # coerce, not from_dict: writing the block IS the opt-in
+            # (same contract as slo / faults above); an explicit
+            # "enabled": false still disables
+            c.history = HistoryConfig.coerce(d["history"])
+        if "incidents" in d:
+            # coerce, not from_dict: writing the block IS the opt-in
+            c.incidents = IncidentsConfig.coerce(d["incidents"])
         return c
 
     @classmethod

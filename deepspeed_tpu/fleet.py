@@ -80,9 +80,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from deepspeed_tpu import faults as faults_mod
 from deepspeed_tpu.config import (FabricConfig, FaultsConfig,
-                                  FleetConfig, TelemetryConfig,
+                                  FleetConfig, HistoryConfig,
+                                  IncidentsConfig, TelemetryConfig,
                                   TracingConfig)
 from deepspeed_tpu.faults import FaultPlan, InjectedFault
+from deepspeed_tpu.history import (NULL_HISTORY, MetricHistory,
+                                   history_rollup)
+from deepspeed_tpu.incidents import NULL_INCIDENTS, IncidentManager
 from deepspeed_tpu.kv_fabric import KVFabric
 from deepspeed_tpu.inference.prefix_cache import (matchable_pages,
                                                   page_keys)
@@ -204,7 +208,8 @@ class FleetRouter:
     """
 
     def __init__(self, engines, *, fleet=None, telemetry=None,
-                 faults=None, tracer=None, fabric=None):
+                 faults=None, tracer=None, fabric=None,
+                 history=None, incidents=None):
         self.cfg = FleetConfig.coerce(fleet)
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
@@ -397,14 +402,61 @@ class FleetRouter:
         self._steps = 0
         self._t_start = time.perf_counter()
 
+        # ---- fleet-level history + incidents (PR 15): rings over the
+        # ROUTER registry (fleet_* aggregates), and an IncidentManager
+        # on the SHARED flight recorder — replica engines built by
+        # fleet_router emit into one ring, so replica burn alerts,
+        # kv-tier faults, failovers and rollout rollbacks all trip
+        # here without per-replica wiring.  Both ride the exporter's
+        # tick-hook pass (inline in step() when no exporter exists).
+        hcfg = HistoryConfig.coerce(history)
+        icfg = IncidentsConfig.coerce(incidents)
+        if hcfg.enabled and not self.registry.enabled:
+            raise ValueError(
+                "fleet history needs an enabled telemetry registry — "
+                "the rings sample the router's fleet_* metrics")
+        self.history = (MetricHistory(hcfg, self.registry)
+                        if hcfg.enabled else NULL_HISTORY)
+        if icfg.enabled:
+            if not self.tracer.enabled:
+                raise ValueError(
+                    "fleet incidents needs the shared tracing block — "
+                    "the trigger events (replica_dead, rollout_halt, "
+                    "slo_burn_alert) live in the fleet flight recorder")
+            self.incident_mgr = IncidentManager(
+                icfg, registry=self.registry, tracer=self.tracer,
+                history=self.history if self.history.enabled else None,
+                statusz_fn=self.statusz, source="fleet")
+        else:
+            self.incident_mgr = NULL_INCIDENTS
+
         self._tel_exporter = None
+        self._tick_inline = (self.history.enabled
+                             or self.incident_mgr.enabled)
         if tcfg is not None and self.registry.enabled and (
-                tcfg.prometheus_path or tcfg.http_port is not None):
+                tcfg.prometheus_path or tcfg.http_port is not None
+                or hcfg.enabled or icfg.enabled):
             self._tel_exporter = TelemetryExporter(
                 self.registry, prometheus_path=tcfg.prometheus_path,
                 interval_s=tcfg.interval_s, http_port=tcfg.http_port)
             self._tel_exporter.register_provider("statusz", self.statusz)
             self._tel_exporter.register_provider("healthz", self.healthz)
+            if self._tick_inline:
+                self._tel_exporter.register_provider("historyz",
+                                                     self.historyz)
+                # shared timed pass: history sampling feeds the
+                # incident detectors evaluated right after it
+                if self.history.enabled:
+                    self._tel_exporter.register_tick_hook(
+                        self.history.maybe_sample,
+                        interval_s=hcfg.sample_interval_s,
+                        name="fleet_history_sample")
+                if self.incident_mgr.enabled:
+                    self._tel_exporter.register_tick_hook(
+                        self.incident_mgr.maybe_evaluate,
+                        interval_s=icfg.eval_interval_s,
+                        name="fleet_incident_evaluate")
+                self._tick_inline = False
             # one scrape = rollup + every replica's family (collision-
             # free when replicas carry per-id namespaces, as
             # fleet_router builds them)
@@ -1323,7 +1375,13 @@ class FleetRouter:
             self.refresh_digests()
         self._update_gauges()
         if self._tel_exporter is not None:
+            # the exporter tick also drives the shared hook pass
+            # (history sampling + incident evaluation)
             self._tel_exporter.maybe_export()
+        elif self._tick_inline:
+            now_m = time.monotonic()
+            self.history.maybe_sample(now_m)
+            self.incident_mgr.maybe_evaluate(now_m)
         return list(self._newly_finished)
 
     def _update_gauges(self) -> None:
@@ -1513,6 +1571,11 @@ class FleetRouter:
                                 if self._roles_on else None),
             "metrics": self.registry.snapshot(),
         }
+        status["history"] = {
+            "enabled": self.history.enabled,
+            "series": len(self.history.series_names()),
+        }
+        status["incidents"] = self.incident_mgr.snapshot()
         if self._autoscaler is not None:
             status["elastic"] = self._autoscaler.status()
         if self._fault_plan is not None:
@@ -1533,6 +1596,24 @@ class FleetRouter:
         return {"alive": True, "ready": ready, "degraded": degraded,
                 "reasons": reasons, "replicas": states,
                 "in_flight": len(self.requests)}
+
+    def historyz(self) -> Dict[str, Any]:
+        """The fleet ``/historyz`` document: the router's own ring set
+        (fleet_* aggregates + scale/rollout annotations), recent
+        incident-bundle metadata, and the cross-replica rollup of every
+        live replica's history (rate/gauge series SUM per aligned
+        bucket, percentile series take the MAX — the same discipline
+        :func:`~deepspeed_tpu.slo.fleet_rollup` applies to SLO state).
+        Host-side bookkeeping only, safe to poll."""
+        rep_snaps = [rep.engine.history.snapshot()
+                     for rep in self.replicas.values()
+                     if rep.state != DEAD
+                     and rep.engine.history.enabled]
+        return {
+            "history": self.history.snapshot(),
+            "incidents": self.incident_mgr.snapshot(),
+            "replica_rollup": history_rollup(rep_snaps),
+        }
 
     # --------------------------------------------------------- lifecycle
     def shutdown(self) -> None:
@@ -1587,6 +1668,7 @@ def tp_replica_mesh(index: int, tp: int, devices=None):
 
 def fleet_router(params, cfg, *, fleet=None, telemetry=None,
                  tracing=None, faults=None, fabric=None,
+                 history=None, incidents=None,
                  engine_builder=None, **engine_kw) -> FleetRouter:
     """Build a fleet of homogeneous replicas over one model + config.
 
@@ -1638,7 +1720,8 @@ def fleet_router(params, cfg, *, fleet=None, telemetry=None,
                 params, cfg, replica_id=f"r{i}", tracing=tracer,
                 faults=plan, **kw_i))
         router = FleetRouter(engines, fleet=fc, telemetry=telemetry,
-                             faults=plan, tracer=tracer, fabric=fabric)
+                             faults=plan, tracer=tracer, fabric=fabric,
+                             history=history, incidents=incidents)
     except Exception:
         for e in engines:
             try:

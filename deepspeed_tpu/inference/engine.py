@@ -218,6 +218,17 @@ def init_serving(params, model_config, *, config: Any = None,
         # robustness/chaos machinery (an explicit faults= kw still
         # wins); a TEST facility — see CONFIG.md before enabling
         kw.setdefault("faults", config.faults)
+    if config is not None and config.history.enabled:
+        # `history` block → multi-resolution metric-history rings
+        # sampled on the exporter tick (an explicit history= kw still
+        # wins); serves /historyz and the incident bundles' pre-trip
+        # windows
+        kw.setdefault("history", config.history)
+    if config is not None and config.incidents.enabled:
+        # `incidents` block → the incident engine: trigger-event
+        # subscription + EWMA anomaly detectors, deduped atomic
+        # incident bundles (an explicit incidents= kw still wins)
+        kw.setdefault("incidents", config.incidents)
     if config is not None:
         # `telemetry` config block → the engine's MetricsRegistry (an
         # explicit telemetry= kw still wins)

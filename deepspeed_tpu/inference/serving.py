@@ -77,11 +77,14 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu import faults as faults_mod
-from deepspeed_tpu.config import (FaultsConfig, KVTierConfig,
+from deepspeed_tpu.config import (FaultsConfig, HistoryConfig,
+                                  IncidentsConfig, KVTierConfig,
                                   PrefixCacheConfig, SLOConfig,
                                   SpeculativeConfig, TelemetryConfig,
                                   TracingConfig)
 from deepspeed_tpu.faults import ChecksumError, FaultPlan, InjectedFault
+from deepspeed_tpu.history import NULL_HISTORY, MetricHistory
+from deepspeed_tpu.incidents import NULL_INCIDENTS, IncidentManager
 from deepspeed_tpu.inference.kernels import PagedKVCache, PageAllocator
 from deepspeed_tpu.inference.prefix_cache import (extend_page_keys,
                                                   key_hex,
@@ -255,7 +258,8 @@ class ServingEngine:
                  slo=None, kv_tier=None, faults=None,
                  shed_queue_depth: int = 0,
                  shed_expired_deadline: bool = False,
-                 replica_id: Optional[str] = None):
+                 replica_id: Optional[str] = None,
+                 history=None, incidents=None):
         # Sharded serving (ref: deepspeed/module_inject/replace_module.py
         # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
         # inference): with a mesh, params arrive pre-sharded from the
@@ -497,11 +501,39 @@ class ServingEngine:
             "serving_step_seconds",
             "scheduler iteration wall time (admit -> decode sync)")
         self._span_label = f"{r.namespace}/serving_step"
+        # ---- time-series history + incidents (PR 15): both blocks
+        # ride the exporter's tick-hook pass, so enabling either needs
+        # an exporter even without Prometheus/HTTP sinks (a sink-less
+        # exporter is just the shared timed pass — one monotonic read
+        # per step).  Coerced here; constructed below once the tracer
+        # and SLO tracker they observe exist.
+        hcfg = HistoryConfig.coerce(history)
+        icfg = IncidentsConfig.coerce(incidents)
+        if hcfg.enabled and not self._tel_on:
+            raise ValueError(
+                "history needs the telemetry block — the rings sample "
+                "the metrics registry; enable telemetry (or drop the "
+                "history block)")
+        if icfg.enabled and not (
+                tracing.enabled
+                if isinstance(tracing, (RequestTracer, BoundTracer))
+                else TracingConfig.coerce(tracing).enabled):
+            # validated BEFORE the exporter below: raising after it
+            # would leak the bound HTTP port + server thread with no
+            # handle left for the caller to shut down
+            raise ValueError(
+                "incidents needs the tracing block — the trigger "
+                "events (slo_burn_alert, kv_promote_failed, replica "
+                "failover, rollbacks) live in the flight recorder; "
+                "enable tracing (or drop the incidents block)")
+        self.history_cfg = hcfg
+        self.incidents_cfg = icfg
         # telemetry sinks for serving loops: the exporter ticks from
         # step() (a monotonic compare until interval_s elapses)
         self._tel_exporter = None
         if tcfg is not None and self._tel_on and (
-                tcfg.prometheus_path or tcfg.http_port is not None):
+                tcfg.prometheus_path or tcfg.http_port is not None
+                or hcfg.enabled or icfg.enabled):
             self._tel_exporter = TelemetryExporter(
                 self.registry, prometheus_path=tcfg.prometheus_path,
                 interval_s=tcfg.interval_s, http_port=tcfg.http_port)
@@ -695,9 +727,67 @@ class ServingEngine:
         self._n_kvt_checksum = 0
         self._kvt_fault_streak = 0
 
+        # ---- time-series history + incident capture (the black-box
+        # flight recorder): rings over this registry sampled on the
+        # exporter tick, an IncidentManager subscribed to the ring's
+        # structured events plus EWMA detectors over key series.  Both
+        # evaluate on the shared tick-hook pass — never the decode hot
+        # path.  With no exporter (telemetry=MetricsRegistry, the
+        # fleet-replica pattern) step() drives them inline.
+        # (incidents-without-tracing already rejected above, before
+        # the exporter existed to leak)
+        self.history = (MetricHistory(hcfg, self.registry)
+                        if hcfg.enabled else NULL_HISTORY)
+        # subclasses adding their own default watch series (ZI's
+        # prefetch-wait p95) must respect an operator's EXPLICIT
+        # detect list — only a None (defaults in play) invites them
+        self._detect_defaulted = icfg.enabled and icfg.detect is None
+        if icfg.enabled:
+            # None = engine defaults; an EXPLICIT empty detect list
+            # disables the anomaly detectors (hard triggers only)
+            detect = icfg.detect if icfg.detect is not None else (
+                ("serving_ttft_seconds:p95",)
+                + tuple(f"slo_{t}_goodput_tokens_per_s"
+                        for t in self.slo_tracker.tiers))
+            icfg = dataclasses.replace(icfg, detect=tuple(detect))
+            self.incidents_cfg = icfg
+            self.incident_mgr = IncidentManager(
+                icfg, registry=self.registry, tracer=self.tracer,
+                history=self.history if self.history.enabled else None,
+                statusz_fn=self.statusz,
+                source=self.replica_id or "engine")
+        else:
+            self.incident_mgr = NULL_INCIDENTS
+        # shared timed pass: SLO window refresh + history sampling +
+        # incident evaluation ride ONE exporter tick-hook walk (the
+        # register_tick_hook contract) instead of three per-step paths
+        self._slo_tick_hooked = False
+        self._tick_inline = (self._tel_exporter is None and
+                             (self.history.enabled
+                              or self.incident_mgr.enabled))
+        if self._tel_exporter is not None:
+            ex = self._tel_exporter
+            if self._slo_on:
+                ex.register_tick_hook(
+                    lambda now: self.slo_tracker.maybe_refresh(),
+                    interval_s=1.0, name="slo_refresh")
+                self._slo_tick_hooked = True
+            if self.history.enabled:
+                ex.register_tick_hook(
+                    self.history.maybe_sample,
+                    interval_s=hcfg.sample_interval_s,
+                    name="history_sample")
+            if self.incident_mgr.enabled:
+                # after history: detectors judge THIS tick's sample
+                ex.register_tick_hook(
+                    self.incident_mgr.maybe_evaluate,
+                    interval_s=icfg.eval_interval_s,
+                    name="incident_evaluate")
+
         # ---- introspection: /statusz (live engine snapshot),
         # /healthz (liveness/readiness, watchdog-fed), /requestz?id=
-        # (one request's ring events) ride the telemetry HTTP server
+        # (one request's ring events), /historyz (metric-history rings
+        # + incident ticker) ride the telemetry HTTP server
         self._t_start = time.perf_counter()
         self._last_step_t: Optional[float] = None
         self._watchdog = None
@@ -707,6 +797,9 @@ class ServingEngine:
             self._tel_exporter.register_provider("healthz", self.healthz)
             self._tel_exporter.register_provider("requestz",
                                                  self.requestz)
+            if self.history.enabled or self.incident_mgr.enabled:
+                self._tel_exporter.register_provider("historyz",
+                                                     self.historyz)
 
     # (the `stats` deprecation shim from PR 2/PR 6 was removed on its
     # announced schedule — read `engine.registry.snapshot()` instead)
@@ -2132,13 +2225,25 @@ class ServingEngine:
             with Span(self._h_step_span, self._span_label):
                 self._step_inner()
             if self._tel_exporter is not None:
+                # one monotonic read drives the WHOLE timed control
+                # plane: sink exports plus the tick hooks (SLO window
+                # refresh, history sampling, incident evaluation)
                 self._tel_exporter.maybe_export()
+            elif self._tick_inline:
+                # no exporter (telemetry= was a bare registry — the
+                # fleet-replica pattern): drive the same pass inline
+                now = time.monotonic()
+                self.history.maybe_sample(now)
+                self.incident_mgr.maybe_evaluate(now)
         else:
             self._step_inner()
-        if self._slo_on:
+            if self._tick_inline:
+                self.incident_mgr.maybe_evaluate()
+        if self._slo_on and not self._slo_tick_hooked:
             # time-driven window refresh (rate-limited to ~1/s inside):
             # an idle engine's burn gauges must decay as violations age
-            # out, not stay latched at their last finish-time values
+            # out, not stay latched at their last finish-time values.
+            # (With an exporter this runs as a tick hook instead.)
             self.slo_tracker.maybe_refresh()
         return list(self._newly_finished)
 
@@ -2391,6 +2496,42 @@ class ServingEngine:
         watchdog fires, so a fleet probe drains traffic off a hung
         engine before the abort lands."""
         self._watchdog = watchdog
+        if self.incident_mgr.enabled:
+            # a watchdog fire is an incident class of its own: the
+            # probe trips ONCE (latched — `fired` stays true for the
+            # process's lifetime, and re-tripping every dedup window
+            # would eat the max_bundles budget)
+            tripped = []
+
+            def _wd_probe():
+                if watchdog.fired and not tripped:
+                    tripped.append(True)
+                    return "watchdog", {"phase": "watchdog_fired",
+                                        **watchdog.health()}
+                return None
+
+            self.incident_mgr.add_probe(_wd_probe)
+            # the probe alone only runs if the engine keeps stepping —
+            # a genuinely hung scheduler thread (the case the watchdog
+            # exists for) never reaches another tick, and an
+            # abort_on_timeout fire kills the process right after
+            # on_timeout.  Chaining the fire callback captures the
+            # bundle from the WATCHDOG thread before any abort: safe
+            # because the single writer has, by the fire's definition,
+            # stopped stepping for timeout_s — worst case on a slow-
+            # not-hung engine resuming mid-capture is one duplicate
+            # bundle on a once-per-process path, vs losing the capture
+            prev_timeout = watchdog.on_timeout
+
+            def _on_timeout():
+                try:
+                    self.incident_mgr.evaluate()
+                except Exception:
+                    pass        # never mask the watchdog's own path
+                if prev_timeout is not None:
+                    prev_timeout()
+
+            watchdog.on_timeout = _on_timeout
 
     def mesh_info(self) -> Dict[str, Any]:
         """The /statusz ``mesh`` block: is this replica an SPMD-sharded
@@ -2517,6 +2658,11 @@ class ServingEngine:
                 if spec_slots else None,
             },
             "mesh": self.mesh_info(),
+            "history": {
+                "enabled": self.history.enabled,
+                "series": len(self.history.series_names()),
+            },
+            "incidents": self.incident_mgr.snapshot(),
         }
         metrics = self.registry.snapshot()
         status["slo"] = self.slo_tracker.snapshot(now=now)
@@ -2653,6 +2799,17 @@ class ServingEngine:
             if rows:
                 out["breakdown"] = next(iter(rows.values()))
         return out
+
+    def historyz(self) -> Dict[str, Any]:
+        """The ``/historyz`` document: every metric-history ring
+        (multi-resolution time series sampled on the exporter tick)
+        plus recent incident-bundle metadata — the machine-readable
+        feed behind ``dstpu_top``'s sparklines and incident ticker.
+        Host-side bookkeeping only, safe to poll."""
+        return {
+            "history": self.history.snapshot(),
+            "incidents": self.incident_mgr.snapshot(),
+        }
 
     def shutdown(self) -> None:
         """Idempotent teardown: final sink flush, then stop the
@@ -2922,8 +3079,13 @@ def serving_engine(params, cfg, **kw):
     # per-request tracing lives in the paged-KV decode scheduler's
     # lifecycle (queued/admitted/first-token/finish edges); the encoder
     # engines are fixed-shape batch scorers with no such lifecycle —
-    # the block is accepted and unused there, never an error
+    # the block is accepted and unused there, never an error.  The
+    # history/incidents blocks ride the same lifecycle (exporter tick
+    # hooks + flight-recorder triggers) and are likewise accepted and
+    # unused on the encoder path.
     kw.pop("tracing", None)
+    kw.pop("history", None)
+    kw.pop("incidents", None)
     sp = kw.pop("speculative", None)
     kw.pop("drafter", None)
     if sp is not None and SpeculativeConfig.coerce(sp).enabled:

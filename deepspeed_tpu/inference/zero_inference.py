@@ -231,6 +231,16 @@ class ZeroInferenceServingEngine(ServingEngine):
             "zi_h2d_bandwidth_bytes_per_s",
             "streamed bytes / sweep wall time (lower bound: the sweep "
             "window includes the compute the stream hides behind)")
+        # incident wiring (PR 15): a streamed engine's trajectory
+        # pathology of interest is the tier fence — watch the
+        # prefetch-wait p95 history series so a developing stall trend
+        # trips an anomaly bundle before the burn alert fires.  Only
+        # when the detector set is the DEFAULT one: an operator's
+        # explicit `detect` list (incl. the hard-triggers-only `()`)
+        # must not be re-armed behind their back
+        if self.incident_mgr.enabled and self._detect_defaulted:
+            self.incident_mgr.watch_series(
+                "zi_prefetch_wait_seconds:p95")
         self._resident = {
             l: self._upload_layer([a[l] for a in leaves], l)
             for l in range(n_res)}

@@ -130,6 +130,30 @@ class FlightRecorder:
             i = n % self.capacity
             return self._buf[i:] + self._buf[:i]
 
+    def events_since(self, cursor: int) -> Tuple[int, List[Event]]:
+        """Incremental poll: events with sequence index >= ``cursor``
+        (oldest surviving first) plus the new cursor (``total``).  A
+        caller more than ``capacity`` events behind gets just the
+        surviving window — the incident engine's per-tick drain never
+        re-reads what it has already classified, and the lock is held
+        for a copy of only the RETURNED slots (never the whole ring —
+        a 256k-capacity ring must not stall every decode-path append
+        for a full-buffer copy per tick)."""
+        with self._lock:
+            total = self._n
+            k = min(total - cursor, self.capacity, total)
+            if k <= 0:
+                return total, []
+            start = total - k
+            cap = self.capacity
+            return total, [self._buf[(start + j) % cap]
+                           for j in range(k)]
+
+    def tail(self, n: int) -> List[Event]:
+        """The newest ``n`` events, oldest → newest, copying only
+        those slots (the incident bundle's ring slice)."""
+        return self.events_since(max(self._n - int(n), 0))[1]
+
     def clear(self) -> None:
         """Forget everything (benchmarks drop warmup traffic here)."""
         with self._lock:

@@ -485,6 +485,10 @@ class TelemetryExporter:
         # here (distinct namespaces keep the families collision-free),
         # so ONE scrape carries the rollup plus every per-replica view
         self._sources: List[MetricsRegistry] = []
+        # tick hooks: the shared timed pass driven from maybe_export
+        # (SLO refresh, history sampling, incident evaluation) — each
+        # entry is [fn, interval_s, last_t, name, alive]
+        self._tick_hooks: List[list] = []
         if http_port is not None and registry.enabled:
             self._start_http(int(http_port))
         # postmortem flushing: the watchdog's timeout path (and any
@@ -493,11 +497,66 @@ class TelemetryExporter:
         # last interval tick; weak so dead engines release theirs
         _exporters.add(self)
 
+    # dstpu: hot-path
+    def run_tick_hooks(self, now: Optional[float] = None) -> int:
+        """Drive every registered tick hook that is due — the ONE
+        timed pass shared by SLO window refresh, history sampling and
+        incident-detector evaluation (each hook rate-limits on its own
+        ``interval_s``; until due it costs one compare).  Called from
+        :meth:`maybe_export` so a serving loop pays a single
+        ``time.monotonic()`` read per step for the whole control
+        plane.  Hooks are individually guarded: a broken one logs and
+        is disabled rather than taking down the serving loop."""
+        if not self._tick_hooks:
+            return 0
+        if now is None:
+            now = time.monotonic()
+        ran = 0
+        for hook in self._tick_hooks:
+            # hook = [fn, interval_s, last_t, name, alive]
+            if not hook[4] or (hook[2] is not None
+                               and now - hook[2] < hook[1]):
+                continue
+            hook[2] = now
+            try:
+                hook[0](now)
+                ran += 1
+            except Exception:
+                hook[4] = False
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.exception(
+                    "telemetry: tick hook %s raised — disabled",
+                    hook[3])
+        return ran
+
+    def register_tick_hook(self, fn, interval_s: float = 1.0,
+                           name: str = "") -> None:
+        """Attach ``fn(now_monotonic)`` to the exporter's per-step
+        timed pass (see :meth:`run_tick_hooks`).  ``interval_s``
+        rate-limits the hook independently of the sink
+        ``interval_s`` — history samples at 1 s while Prometheus
+        writes at 10 s."""
+        interval_s = float(interval_s)
+        if interval_s < 0:
+            raise ValueError(
+                f"tick hook interval_s must be >= 0, got {interval_s}")
+        self._tick_hooks.append(
+            [fn, interval_s, None, name or getattr(fn, "__name__", "?"),
+             True])
+
     def maybe_export(self, step: Optional[int] = None,
                      force: bool = False) -> bool:
         if not self.registry.enabled:
             return False
         now = time.monotonic()
+        if not force:
+            # hooks run only on the owner's per-step path: a forced
+            # flush (watchdog postmortem, shutdown) arrives on ANOTHER
+            # thread, and the hook consumers (IncidentManager, SLO
+            # tracker state) are single-writer by contract — the
+            # forced path wants the sinks, not the control plane
+            self.run_tick_hooks(now)
         if not force and self._last is not None and \
                 now - self._last < self.interval_s:
             return False
@@ -532,15 +591,16 @@ class TelemetryExporter:
                 return
 
     def register_provider(self, name: str, fn) -> None:
-        """Attach an introspection provider: ``statusz``/``healthz``
-        take no args and return a JSON dict (healthz may include
-        ``"ready": false`` to force a 503); ``requestz`` takes the
-        request-id string.  Re-registering a name replaces it (the
-        engine owns its endpoints)."""
-        if name not in ("statusz", "healthz", "requestz"):
+        """Attach an introspection provider: ``statusz``/``healthz``/
+        ``historyz`` take no args and return a JSON dict (healthz may
+        include ``"ready": false`` to force a 503; historyz serves the
+        metric-history rings + recent incident metadata); ``requestz``
+        takes the request-id string.  Re-registering a name replaces
+        it (the engine owns its endpoints)."""
+        if name not in ("statusz", "healthz", "requestz", "historyz"):
             raise ValueError(
                 f"unknown introspection provider {name!r} — expected "
-                "statusz, healthz or requestz")
+                "statusz, healthz, historyz or requestz")
         self._providers[name] = fn
 
     # ------------------------------------------------------------- http
@@ -582,6 +642,9 @@ class TelemetryExporter:
                                    "text/plain; version=0.0.4")
                     elif route == "/statusz" and "statusz" in providers:
                         self._send_json(providers["statusz"]())
+                    elif route == "/historyz" and \
+                            "historyz" in providers:
+                        self._send_json(providers["historyz"]())
                     elif route == "/healthz" and "healthz" in providers:
                         h = providers["healthz"]()
                         self._send_json(

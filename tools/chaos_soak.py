@@ -45,6 +45,64 @@ MAX_NEW = 6
 STEP_CAP = 3000
 WALL_CAP_S = 480.0
 
+# history + incidents blocks for the soaks (ISSUE 15): fast cadences
+# AND a 50 ms fine ring so the short CPU run records real trajectories
+# (the default 1 s ring would fold a whole soak wave into one bucket),
+# a dedup window longer than any soak so each incident class yields
+# EXACTLY one bundle, and a 60 s pre-window on a ring set whose span
+# covers the >= 30 s acceptance bound
+HISTORY_BLOCK = {"sample_interval_s": 0.05,
+                 "rings": ((0.05, 600), (1.0, 120), (10.0, 360))}
+
+
+def incidents_block(out_dir):
+    return {"dir": out_dir, "eval_interval_s": 0.05,
+            "pre_window_s": 60.0, "dedup_window_s": 600.0,
+            "max_bundles": 8}
+
+
+def incidents_summary(mgr, oracle_bundles=None):
+    """Per-class bundle accounting for a soak stamp."""
+    by_class = {}
+    for b in mgr.bundles:
+        by_class[b["incident"]] = by_class.get(b["incident"], 0) + 1
+    out = {
+        "bundles": len(mgr.bundles),
+        "by_class": by_class,
+        "suppressed": int(mgr.snapshot().get("suppressed", 0)),
+        "pre_window_s": mgr.cfg.pre_window_s,
+    }
+    if oracle_bundles is not None:
+        out["oracle_bundles"] = oracle_bundles
+    return out
+
+
+def load_bundle(mgr, cls):
+    """First on-disk bundle of one incident class (None if absent)."""
+    for b in mgr.bundles:
+        if b["incident"] == cls and b.get("path"):
+            with open(b["path"]) as f:
+                return json.load(f)
+    return None
+
+
+def bundle_well_formed(bundle, trigger_phase):
+    """The acceptance shape: the bundle's timeline carries the
+    triggering event and the configured pre-window covers >= 30 s of
+    history for the tracked series."""
+    if bundle is None:
+        return False
+    trig = bundle.get("trigger", {})
+    if trig.get("phase") != trigger_phase:
+        return False
+    if bundle.get("pre_window_s", 0) < 30.0:
+        return False
+    hist = bundle.get("history", {})
+    rings = hist.get("rings", [])
+    span_ok = any(r["period_s"] * r["capacity"] >= 30.0 for r in rings)
+    return span_ok and bool(hist.get("series")) and \
+        bool(bundle.get("ring"))
+
 
 def build_traffic(vocab):
     """Deterministic phased workload: warm a shared prefix, flush it
@@ -634,6 +692,7 @@ def elastic_main(args) -> int:
         "default_tier": "lax", "window_s": 8.0,
         "burn_windows_s": [8.0]}
     ekw = dict(prefix_cache=True, slo=slo, shed_queue_depth=6, **kw)
+    inc_dir = tempfile.mkdtemp(prefix="dstpu_elastic_inc_")
     router = fleet_router(
         params, cfg,
         fleet={"replicas": 2, "retry_budget": 2,
@@ -644,6 +703,12 @@ def elastic_main(args) -> int:
                "digest_refresh_steps": 2},
         tracing={"ring_capacity": 131072},
         faults={"seed": args.seed, "rules": ELASTIC_FAULT_RULES},
+        # fleet-level incident engine (ISSUE 15): the shared flight
+        # recorder carries every replica's slo_burn_alert plus the
+        # autoscaler's rollout_halt/rolled_back — the scripted burn
+        # rollback below must land a "rollback" bundle
+        history=dict(HISTORY_BLOCK),
+        incidents=incidents_block(inc_dir),
         **ekw)
 
     def factory(rid, streamed=False):
@@ -775,6 +840,8 @@ def elastic_main(args) -> int:
     rollout2 = dict(auto.last_rollout or {})
     # ---- phase E: final trough — the fleet settles at its floor
     idle_until_live(auto.cfg.min_replicas)
+    # final evaluation: classify anything the last steps landed
+    router.incident_mgr.evaluate()
 
     # ---- reconcile
     finished = dict(router.finished)
@@ -790,6 +857,16 @@ def elastic_main(args) -> int:
     orphaned = router.orphaned()
     cnt = router.registry.snapshot()["counters"]
     st = auto.status()
+    # incidents (ISSUE 15): the burn-tripped rollback must have
+    # produced a (deduped) rollback bundle carrying the rollout_halt
+    # trigger and the pre-trip history window
+    inc = incidents_summary(router.incident_mgr)
+    inc["rollback_bundles"] = inc["by_class"].get("rollback", 0)
+    rb_bundle = load_bundle(router.incident_mgr, "rollback")
+    inc["rollback_bundle_well_formed"] = int(
+        bundle_well_formed(rb_bundle, "rollout_halt"))
+    incidents_ok = (inc["rollback_bundles"] >= 1
+                    and inc["rollback_bundle_well_formed"] == 1)
     live_versions = {rep.id: str(rep.version)
                      for rep in router.replicas.values()
                      if rep.state != DEAD}
@@ -832,7 +909,8 @@ def elastic_main(args) -> int:
     plan_snap = router._fault_plan.snapshot()
     router.shutdown()
     ok = (not mismatched and not hang and not leaks and not orphaned
-          and all(checks.values()) and plan_snap["injected"] >= 2)
+          and all(checks.values()) and plan_snap["injected"] >= 2
+          and incidents_ok)
     stamp = {
         "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "backend": jax.default_backend(),
@@ -860,6 +938,7 @@ def elastic_main(args) -> int:
         "rollout_v3": rollout2,
         "live_versions": live_versions,
         "event_counts": dict(led_kinds),
+        "incidents": inc,
         "injected": plan_snap,
         "duration_s": round(time.perf_counter() - t_start, 2),
     }
@@ -930,8 +1009,15 @@ def main():
     kw = dict(max_batch=2, page_size=8, num_pages=12, max_seq=64,
               prefill_bucket=8)
 
-    # ---- fault-free oracle: every distinct prompt's greedy completion
-    oracle_eng = serving_engine(params, cfg, prefix_cache=True, **kw)
+    # ---- fault-free oracle: every distinct prompt's greedy completion.
+    # The oracle ALSO runs history+incidents (same cadences as the
+    # chaos arm): it is the false-positive gate — a fault-free run must
+    # produce ZERO bundles (gated in BENCH_BASELINE).
+    oracle_inc_dir = tempfile.mkdtemp(prefix="dstpu_chaos_oracle_inc_")
+    oracle_eng = serving_engine(params, cfg, prefix_cache=True,
+                                history=dict(HISTORY_BLOCK),
+                                incidents=incidents_block(oracle_inc_dir),
+                                **kw)
     distinct = []
     seen = set()
     for p in [p for w in waves for p in w] + burst + expired:
@@ -944,11 +1030,17 @@ def main():
     oracle_out = oracle_eng.run()
     oracle = {tuple(p): oracle_out[f"o{i}"]
               for i, p in enumerate(distinct)}
+    oracle_bundles = len(oracle_eng.incident_mgr.bundles)
     oracle_eng.shutdown()
 
-    # ---- the chaos engine: full I/O-tier stack + shedding + faults
+    # ---- the chaos engine: full I/O-tier stack + shedding + faults +
+    # the incident engine.  burn_threshold 1.5 makes the expired tier's
+    # burn (violation rate 1.0 / budget 0.5 = 2.0) a SCRIPTED trip in
+    # every window — the slo_burn_alert the incident engine must turn
+    # into exactly one bundle.
     nvme_dir = tempfile.mkdtemp(prefix="dstpu_chaos_nvme_")
     dump_dir = tempfile.mkdtemp(prefix="dstpu_chaos_dump_")
+    inc_dir = tempfile.mkdtemp(prefix="dstpu_chaos_inc_")
     eng = serving_engine(
         params, cfg, prefix_cache=True,
         kv_tier={"enabled": True, "host_pool_bytes": 4096,
@@ -957,9 +1049,11 @@ def main():
         slo={"tiers": {
             "interactive": {"ttft_s": 60.0, "deadline_s": 300.0},
             "expired": {"deadline_s": 0.001, "target": 0.5}},
-            "default_tier": "interactive"},
+            "default_tier": "interactive", "burn_threshold": 1.5},
         tracing={"ring_capacity": 65536, "dump_dir": dump_dir},
         faults={"seed": args.seed, "rules": FAULT_RULES},
+        history=dict(HISTORY_BLOCK),
+        incidents=incidents_block(inc_dir),
         shed_queue_depth=6, shed_expired_deadline=True, **kw)
     wd = Watchdog(timeout_s=120.0, abort_on_timeout=False).start()
     eng.attach_watchdog(wd)
@@ -1003,6 +1097,10 @@ def main():
     time.sleep(0.05)
     hang = hang or not drive()
     wd.stop()
+    # one final evaluation: a trigger event landed by the very last
+    # step must still be classified (the drive loop exits before the
+    # next tick would have drained it)
+    eng.incident_mgr.evaluate()
 
     # ---- reconcile
     finished = dict(eng.finished)
@@ -1039,6 +1137,29 @@ def main():
         "trace_events":
             ring_shed == len(shed) and ring_failed == len(failed),
     }
+    # ---- incidents (ISSUE 15 acceptance): the scripted burn trip
+    # (slot faults -> interactive-tier violations -> multiwindow burn)
+    # must yield EXACTLY ONE slo_burn bundle whose timeline carries the
+    # triggering event plus a >= 30 s pre-window of history; the
+    # fault-free oracle arm must have produced ZERO bundles.
+    inc = incidents_summary(eng.incident_mgr,
+                            oracle_bundles=oracle_bundles)
+    burn_bundle = load_bundle(eng.incident_mgr, "slo_burn")
+    inc["burn_bundles"] = inc["by_class"].get("slo_burn", 0)
+    inc["burn_bundle_well_formed"] = int(
+        bundle_well_formed(burn_bundle, "slo_burn_alert"))
+    incidents_ok = (inc["burn_bundles"] == 1
+                    and inc["burn_bundle_well_formed"] == 1
+                    and inc["oracle_bundles"] == 0)
+    if burn_bundle is not None:
+        # the committed sample the slow lane re-stamps each cadence:
+        # incident_report renders it, tier-1 parses it
+        sample_path = os.path.join(REPO, "INCIDENT_SAMPLE.json")
+        from deepspeed_tpu.utils.evidence import atomic_write_json \
+            as _awj
+        _awj(burn_bundle, sample_path)
+        inc["sample"] = os.path.basename(sample_path)
+
     plan_snap = eng._fault_plan.snapshot()
     eng.shutdown()
 
@@ -1047,7 +1168,7 @@ def main():
     ok = (not mismatched and not hang and not wd.fired
           and not leaks and all(checks.values())
           and plan_snap["injected"] > 0 and len(failed) > 0
-          and len(shed) > 0)
+          and len(shed) > 0 and incidents_ok)
     stamp = {
         "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "backend": jax.default_backend(),
@@ -1078,6 +1199,7 @@ def main():
         "io_retries": {k: int(v) for k, v in cnt.items()
                        if k.endswith(("_io_retries", "_sync_fallbacks",
                                       "_write_retries")) and v},
+        "incidents": inc,
         "injected": plan_snap,
         "degraded_at_end": healthz["degraded"],
         "robustness": robustness,

@@ -37,10 +37,92 @@ def _bar(frac: float, width: int = 20) -> str:
     return "[" + "#" * n + "." * (width - n) + f"] {100 * frac:5.1f}%"
 
 
-def render_fleet(status: dict, health: dict | None = None) -> list:
+_SPARK_GLYPHS = " .:-=+*#%@"
+
+
+def _spark(values, width: int = 32) -> str:
+    """ASCII sparkline over the trailing ``width`` points (min-max
+    scaled; flat series render mid-glyph so 'no variation' doesn't
+    read as 'no data')."""
+    vals = [float(v) for v in values if v is not None][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_GLYPHS[len(_SPARK_GLYPHS) // 2] * len(vals)
+    return "".join(
+        _SPARK_GLYPHS[min(int((v - lo) / span * (len(_SPARK_GLYPHS) - 1)
+                              + 0.5), len(_SPARK_GLYPHS) - 1)]
+        for v in vals)
+
+
+# key series rendered as sparklines when /historyz is available —
+# label -> history series name (fine ring)
+_ENGINE_SPARKS = (
+    ("queue", "serving_queue_depth"),
+    ("kv util", "serving_kv_page_utilization"),
+    ("ttft p95", "serving_ttft_seconds:p95"),
+    ("decode/s", "serving_decode_steps:rate"),
+)
+_FLEET_SPARKS = (
+    ("queue", "fleet_queue_depth"),
+    ("slots", "fleet_active_slots"),
+    ("routable", "fleet_routable_replicas"),
+    ("done/s", "fleet_completed_requests:rate"),
+)
+
+
+def _series_points(historyz: dict, name: str):
+    """Fine-ring values of one series from a /historyz document."""
+    h = (historyz or {}).get("history", {})
+    rec = h.get("series", {}).get(name)
+    if not rec or not rec.get("rings"):
+        return []
+    return [v for _t, v in rec["rings"][0].get("points", [])]
+
+
+def render_history(historyz: dict, sparks, now_monotonic=None) -> list:
+    """Sparkline block + incident ticker from a /historyz document.
+    Empty list when the document is absent/disabled — callers append
+    unconditionally."""
+    if not historyz:
+        return []
+    L = []
+    h = historyz.get("history", {})
+    if h.get("enabled"):
+        for label, name in sparks:
+            pts = _series_points(historyz, name)
+            if not pts:
+                continue
+            L.append(f"hist  {label:<9}[{_spark(pts)}]"
+                     f"  now {pts[-1]:.3g}")
+    inc = historyz.get("incidents", {})
+    if inc.get("enabled"):
+        recent = inc.get("recent", [])
+        line = (f"incid bundles {inc.get('bundles', 0)}"
+                f"  suppressed {inc.get('suppressed', 0)}")
+        if recent:
+            now = (now_monotonic
+                   if now_monotonic is not None
+                   else (h.get("t_monotonic") or 0.0))
+            ticker = "  ".join(
+                f"[{b.get('incident', '?')}"
+                + (f" {max(now - b.get('t0_monotonic', now), 0.0):.0f}s"
+                   if now else "")
+                + "]"
+                for b in recent[-4:])
+            line += "  " + ticker
+        L.append(line)
+    return L
+
+
+def render_fleet(status: dict, health: dict | None = None,
+                 historyz: dict | None = None) -> list:
     """One frame for a FleetRouter /statusz snapshot: fleet totals +
     one row per replica (state, queue, shed rate, affinity hit rate)
-    + the cross-replica SLO rollup."""
+    + the cross-replica SLO rollup + history sparklines and the
+    incident ticker when /historyz is served."""
     L = []
     fl = status.get("fleet", {})
     states = " ".join(f"{k}={v}" for k, v in
@@ -105,6 +187,7 @@ def render_fleet(status: dict, health: dict | None = None) -> list:
         L.append(f"mesh  tp={fm.get('tp', 1)}"
                  f"  sharded {fm.get('sharded_replicas', 0)}"
                  f"/{len(fl.get('replicas', []))} replicas")
+    L.extend(render_history(historyz, _FLEET_SPARKS))
     L.append("-" * 78)
     L.append(f"{'replica':<9}{'state':<13}{'role':<9}{'ver':<6}"
              f"{'mesh':<7}{'queue':>6}"
@@ -146,10 +229,12 @@ def render_fleet(status: dict, health: dict | None = None) -> list:
     return L
 
 
-def render(status: dict, health: dict | None = None) -> list:
-    """One frame of text lines from a /statusz snapshot."""
+def render(status: dict, health: dict | None = None,
+           historyz: dict | None = None) -> list:
+    """One frame of text lines from a /statusz snapshot (plus the
+    optional /historyz document for sparklines + incident ticker)."""
     if status.get("engine") == "FleetRouter" or "fleet" in status:
-        return render_fleet(status, health)
+        return render_fleet(status, health, historyz)
     L = []
     hdr = (f"{status.get('engine', '?')}  up {status.get('uptime_s', 0):.0f}s"
            f"  step age {status.get('last_step_age_s')}s")
@@ -222,6 +307,7 @@ def render(status: dict, health: dict | None = None) -> list:
                  f"  stalls {zi.get('stream_stalls', 0)}"
                  f" ({zi.get('stream_stall_s', 0.0):.2f}s)"
                  f"  {zi.get('bytes_uploaded', 0) / 1e6:.0f} MB up")
+    L.extend(render_history(historyz, _ENGINE_SPARKS))
 
     slo = status.get("slo", {})
     if slo.get("enabled"):
@@ -272,14 +358,20 @@ def one_frame(base: str):
         health = fetch(base + "/healthz")
     except urllib.error.HTTPError as e:       # 503 = not ready, still JSON
         health = json.loads(e.read().decode())
-    return status, health
+    try:
+        # served only when the history/incidents blocks are on —
+        # a 404 just means no sparkline/ticker rows this frame
+        historyz = fetch(base + "/historyz")
+    except Exception:
+        historyz = None
+    return status, health, historyz
 
 
 def loop_plain(base: str, interval: float, once: bool) -> int:
     while True:
         try:
-            status, health = one_frame(base)
-            lines = render(status, health)
+            status, health, historyz = one_frame(base)
+            lines = render(status, health, historyz)
         except Exception as e:
             lines = [f"dstpu_top: {base} unreachable: {e}"]
         if not once:
@@ -298,8 +390,8 @@ def loop_curses(base: str, interval: float) -> int:
         scr.nodelay(True)
         while True:
             try:
-                status, health = one_frame(base)
-                lines = render(status, health)
+                status, health, historyz = one_frame(base)
+                lines = render(status, health, historyz)
             except Exception as e:
                 lines = [f"dstpu_top: {base} unreachable: {e}"]
             scr.erase()

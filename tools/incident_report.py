@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""incident_report: render an incident bundle into a human timeline.
+
+An incident bundle (written by
+:class:`deepspeed_tpu.incidents.IncidentManager` — one atomic JSON per
+deduped trip) holds the triggering event, the pre-trip metric-history
+windows, the flight-recorder ring slice around t0, and the /statusz
+snapshot.  This tool turns that JSON into the postmortem an operator
+actually reads:
+
+- a header (incident class, capture time, source, trigger details);
+- the **event timeline** ordered around t0 (seconds relative to the
+  trip; the trigger row is marked), interleaved with the history
+  annotations (scale/rollout marks) that fell inside the window;
+- the **top metric deltas**: each history series' mean over the
+  pre-window vs its last pre-trip value, ranked by relative change —
+  the "what was moving before it broke" list;
+- a one-line /statusz digest (queue depth, active slots, SLO alert
+  states) when the bundle carries one.
+
+    python tools/incident_report.py INCIDENT_SAMPLE.json
+    python tools/incident_report.py /tmp/dstpu_incidents/incident_*.json
+    python tools/incident_report.py bundle.json --top 10 --events 40
+
+Pure stdlib; multiple paths render back-to-back (a soak's bundle dir).
+"""
+
+import argparse
+import json
+import sys
+
+
+def _rel_s(t_ns, base_ns):
+    return (t_ns - base_ns) / 1e9
+
+
+def _fmt_attrs(attrs, limit=100):
+    if not attrs:
+        return ""
+    s = " ".join(f"{k}={v}" for k, v in attrs.items())
+    return s if len(s) <= limit else s[:limit - 3] + "..."
+
+
+def metric_deltas(history, top=8):
+    """Rank series by |last pre-trip value vs pre-window mean|
+    relative change.  A series that APPEARED from a zero pre-window
+    (burn rate 0 -> 33) has no finite relative change — those rank
+    first (by absolute delta) and render as "new"; all-zero series
+    and single-point series are skipped."""
+    rows = []
+    for name, rec in (history or {}).get("series", {}).items():
+        rings = rec.get("rings") or []
+        pts = [v for _t, v in rings[0].get("points", [])] if rings else []
+        if len(pts) < 2:
+            continue
+        pre, last = pts[:-1], pts[-1]
+        mean = sum(pre) / len(pre)
+        delta = last - mean
+        if abs(mean) < 1e-9 and abs(delta) < 1e-9:
+            continue                     # flat zero: nothing to read
+        rows.append({
+            "series": name,
+            "pre_mean": round(mean, 6),
+            "last": round(last, 6),
+            "delta": round(delta, 6),
+            "rel": (round(delta / abs(mean), 4)
+                    if abs(mean) >= 1e-9 else None),
+            "points": len(pts),
+        })
+    rows.sort(key=lambda r: (0, -abs(r["delta"])) if r["rel"] is None
+              else (1, -abs(r["rel"])))
+    return rows[:top]
+
+
+def render_bundle(bundle, top=8, max_events=32):
+    """One bundle -> list of text lines (the test drives this
+    directly; main() prints it)."""
+    L = []
+    cls = bundle.get("incident", "?")
+    L.append(f"INCIDENT [{cls}]  captured {bundle.get('t', '?')}  "
+             f"source={bundle.get('source', '?')}  "
+             f"seq={bundle.get('seq', '?')}")
+    trig = bundle.get("trigger", {})
+    if "phase" in trig:
+        L.append(f"trigger: event `{trig['phase']}`  "
+                 f"{_fmt_attrs(trig.get('attrs'))}")
+    elif "detector" in trig:
+        L.append(f"trigger: detector {trig['detector']}  "
+                 f"value={trig.get('value')}  z={trig.get('z')}  "
+                 f"baseline mean={trig.get('mean')} "
+                 f"std={trig.get('std')}")
+    else:
+        L.append(f"trigger: {_fmt_attrs(trig)}")
+    L.append(f"pre-window: {bundle.get('pre_window_s', '?')} s of "
+             f"history for "
+             f"{len((bundle.get('history') or {}).get('series', {}))} "
+             f"series")
+    L.append("-" * 72)
+
+    # ---- timeline: ring events relative to t0 (the trigger's own
+    # timestamp when it carries one, else the capture time)
+    ring = bundle.get("ring") or []
+    if ring:
+        trig_ns = trig.get("t_ns")
+        base_ns = trig_ns if trig_ns is not None else ring[-1]["t_ns"]
+        events = ring[-max_events:]
+        L.append(f"timeline ({len(events)} of {len(ring)} ring events, "
+                 "seconds relative to t0; >>> marks the trigger):")
+        for e in events:
+            mark = ">>>" if trig_ns is not None and \
+                e["t_ns"] == trig_ns and e["phase"] == \
+                trig.get("phase") else "   "
+            req = f" req={e['req']}" if "req" in e else ""
+            L.append(f" {mark} {_rel_s(e['t_ns'], base_ns):+9.3f}s  "
+                     f"{e['phase']:<22}{req}  "
+                     f"{_fmt_attrs(e.get('attrs'), 60)}")
+    anns = (bundle.get("history") or {}).get("annotations", [])
+    if anns:
+        L.append(f"annotations in window ({len(anns)}):")
+        for a in anns[-12:]:
+            L.append(f"     t={a.get('t')}  {a.get('label')}  "
+                     f"{_fmt_attrs(a.get('attrs'), 60)}")
+    L.append("-" * 72)
+
+    # ---- top metric deltas vs the pre-window
+    deltas = metric_deltas(bundle.get("history"), top=top)
+    if deltas:
+        L.append(f"top metric deltas (last pre-trip value vs "
+                 f"pre-window mean, top {len(deltas)}):")
+        L.append(f"  {'series':<44}{'pre-mean':>12}{'last':>12}"
+                 f"{'rel':>9}")
+        for r in deltas:
+            rel = ("     new" if r["rel"] is None
+                   else f"{100 * r['rel']:>7.1f}%")
+            L.append(f"  {r['series'][:43]:<44}{r['pre_mean']:>12.4g}"
+                     f"{r['last']:>12.4g} {rel}")
+    else:
+        L.append("no history series in the bundle (history block off "
+                 "at capture time)")
+
+    # ---- statusz digest
+    st = bundle.get("statusz")
+    if isinstance(st, dict) and "error" not in st:
+        if "fleet" in st:
+            fl = st["fleet"]
+            L.append(f"statusz: fleet queue={fl.get('queue_depth')}  "
+                     f"in-flight={fl.get('in_flight')}  "
+                     f"states={fl.get('states')}")
+        else:
+            q = st.get("queue", {})
+            L.append(f"statusz: queue={q.get('depth')}  "
+                     f"active_slots={st.get('active_slots')}  "
+                     f"uptime={st.get('uptime_s')}s")
+        slo = st.get("slo", {})
+        if slo.get("enabled"):
+            firing = [name for name, t in slo.get("tiers", {}).items()
+                      if t.get("alert_active")]
+            L.append("statusz: slo alerts firing: "
+                     + (", ".join(firing) if firing else "none"))
+    return L
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render incident bundles into human timelines")
+    ap.add_argument("bundles", nargs="+",
+                    help="incident bundle JSON path(s)")
+    ap.add_argument("--top", type=int, default=8,
+                    help="metric-delta rows to show")
+    ap.add_argument("--events", type=int, default=32,
+                    help="timeline events to show")
+    args = ap.parse_args(argv)
+    rc = 0
+    for i, path in enumerate(args.bundles):
+        if i:
+            print()
+        try:
+            with open(path) as f:
+                bundle = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"incident_report: {path}: {e}", file=sys.stderr)
+            rc = 2
+            continue
+        try:
+            print("\n".join(render_bundle(bundle, top=args.top,
+                                          max_events=args.events)))
+        except BrokenPipeError:     # `| head` closed the pipe: fine
+            return rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,7 +34,7 @@ sys.path.insert(0, REPO)
 
 def measure_point(model_name, slots, decode_chunk, prompt_len=8,
                   new_tokens=48, requests=None, telemetry=True,
-                  tracing=True, slo=False):
+                  tracing=True, slo=False, history=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -60,11 +60,24 @@ def measure_point(model_name, slots, decode_chunk, prompt_len=8,
     slo_block = {"tiers": {"default": {
         "ttft_s": 30.0, "itl_s": 5.0, "deadline_s": 120.0}}} \
         if slo else None
+    # the history arm runs BOTH new blocks at their production
+    # cadences (1 s sampling / 1 s evaluation): the claim under test is
+    # that the per-step cost of the shared tick pass is one monotonic
+    # compare, whatever the rings record when a tick lands
+    history_block = {"sample_interval_s": 1.0} if history else None
+    incidents_block = None
+    if history:
+        import tempfile
+
+        incidents_block = {
+            "dir": tempfile.mkdtemp(prefix="dstpu_overhead_inc_"),
+            "eval_interval_s": 1.0}
     eng = serving_engine(
         params, cfg, max_batch=slots, page_size=8,
         num_pages=slots * (-(-max_seq // 8)) + 8, max_seq=max_seq,
         prefill_bucket=prompt_len, decode_chunk=decode_chunk,
-        telemetry=telemetry, tracing=tracing, slo=slo_block)
+        telemetry=telemetry, tracing=tracing, slo=slo_block,
+        history=history_block, incidents=incidents_block)
 
     def decode_steps():
         return int(eng.registry.snapshot()["counters"]
@@ -122,7 +135,7 @@ def measure_point(model_name, slots, decode_chunk, prompt_len=8,
         "model": model_name, "slots": slots, "decode_chunk": K,
         "requests": requests, "generated": generated,
         "telemetry": bool(telemetry), "tracing": bool(tracing),
-        "slo": bool(slo),
+        "slo": bool(slo), "history": bool(history),
         "decode_steps": steps,
         "prefill_chunks": int(eng.registry.snapshot()["counters"]
                               .get("serving_prefill_chunks", 0)),
@@ -224,6 +237,20 @@ def main():
         "build (telemetry+tracing on in both arms); disabled path = "
         "shared no-op tracker")
 
+    # history+incidents-overhead A/B (ISSUE 15 acceptance): rings +
+    # incident detectors on vs off, telemetry/tracing/slo on in BOTH
+    # arms — the enabled delta is the price of the exporter tick-hook
+    # pass in the step loop (one monotonic compare until a hook is
+    # due; sampling itself lands at most once per second, off the
+    # decode hot path).
+    _, history_overhead = _ab("history", slo=True)
+    history_overhead["backend"] = jax.default_backend()
+    history_overhead["note"] = (
+        "best-of-3 ms/decode-step, history rings + incident engine "
+        "enabled (1 s sampling / 1 s evaluation cadence) vs disabled "
+        "on the same build (telemetry+tracing+slo on in both arms); "
+        "the enabled path adds one tick-hook compare per step")
+
     if args.ab_only and os.path.exists(args.json_out):
         with open(args.json_out) as f:
             out = json.load(f)
@@ -240,6 +267,7 @@ def main():
     out["telemetry_overhead"] = telemetry_overhead
     out["tracing_overhead"] = tracing_overhead
     out["slo_overhead"] = slo_overhead
+    out["history_overhead"] = history_overhead
     with open(args.json_out, "w") as f:
         json.dump(out, f, indent=1)
     print("→", args.json_out)
