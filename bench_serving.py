@@ -49,6 +49,16 @@ honestly (``truncated: true``) rather than burning the window.
         # ON — hit rate, p50 TTFT, demote/promote volume, and a
         # token-identity check between the arms (the bit-exact spill
         # contract); the slow lane stamps this as KV_TIER_BENCH.json
+    python bench_serving.py --kernels
+        # forced-kernel serving A/B: the same traffic with the kernels
+        # block pinned to the XLA twins vs forced Pallas (pallas_v2
+        # paged attention + fused sampling) — tokens/s, TTFT, the
+        # resolved policy each engine baked, and THE greedy identity
+        # gate (kernel_ab.mismatched_requests must be 0: a kernel is
+        # an execution strategy).  On CPU the forced arm runs the
+        # kernels in interpret mode — a correctness stamp, not a perf
+        # claim (rows carry backend).  The slow lane stamps this as
+        # KERNEL_SERVING_BENCH.json
 """
 
 import argparse
@@ -172,7 +182,7 @@ def build_prompts(args, cfg):
 
 def measure_config(name, args, params, mod, cfg, phase, prompts,
                    zero_inference=None, prefix_cache=None,
-                   speculative=None, kv_tier=None, tp=0):
+                   speculative=None, kv_tier=None, tp=0, kernels=None):
     """Build one engine flavor, warm it, drive the request stream under
     the wall-clock cap; returns ``(evidence row, finished outputs)`` —
     the outputs feed the kv-tier A/B's token-identity check."""
@@ -192,6 +202,8 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
         config["speculative"] = speculative
     if kv_tier is not None:
         config["kv_tier"] = kv_tier
+    if kernels is not None:
+        config["kernels"] = kernels
     # SLO classification rides every row (--slo-ttft-ms 0 disables):
     # the same engine that reports tokens/s reports how many of those
     # tokens came from requests that met their latency objective —
@@ -325,6 +337,10 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
             "telemetry": snap,
         },
     }
+    if hasattr(engine, "_kernels"):
+        # the policy this engine's compiled programs actually baked
+        # (same object /statusz reports — resolved once at build)
+        row["detail"]["kernels"] = engine._kernels.as_dict()
     ttft = snap["histograms"].get("serving_ttft_seconds", {})
     d_count = int(ttft.get("count", 0)) - int(ttft0.get("count", 0))
     if d_count > 0:
@@ -510,6 +526,16 @@ def main():
                          "With --cpu the N virtual host devices are "
                          "forced before the backend comes up; the slow "
                          "lane stamps this as TP_BENCH.json")
+    ap.add_argument("--kernels", action="store_true",
+                    help="A/B the same traffic with the serving kernels "
+                         "pinned to the XLA twins vs forced Pallas "
+                         "(paged_attention=pallas_v2 + "
+                         "fused_sampling=on) — tokens/s, TTFT, the "
+                         "resolved policy per arm, and a greedy token-"
+                         "identity gate (a kernel is an execution "
+                         "strategy, so mismatched_requests must be 0). "
+                         "The slow lane stamps this as "
+                         "KERNEL_SERVING_BENCH.json")
     ap.add_argument("--zero-inference", action="store_true",
                     help="also measure the ZeRO-Inference weight-streamed "
                          "engine (host-tier layer streaming) next to the "
@@ -557,6 +583,9 @@ def main():
     if args.kv_tier and (args.prefix_cache or args.speculative
                          or args.zero_inference):
         raise SystemExit("--kv-tier is its own A/B")
+    if args.kernels and (args.tp or args.kv_tier or args.prefix_cache
+                         or args.speculative or args.zero_inference):
+        raise SystemExit("--kernels is its own A/B")
     if args.prefix_cache:
         if args.zero_inference:
             raise SystemExit(
@@ -618,6 +647,20 @@ def main():
             # original pages — a pre-existing cross-strategy property
             # of the prefix cache, reported as off_path_divergences.)
             ("kv_tier_ref", None, {"enabled": True}, None, None)]
+    kernels_by_name = {}
+    if args.kernels:
+        # BOTH arms pin their policy explicitly (no auto gate): the A/B
+        # races the forced Pallas hot path against its XLA twins on
+        # identical traffic.  On CPU the forced arm runs the kernels in
+        # interpret mode — the identity gate is the point there.
+        kernels_by_name = {
+            "kernel_xla": {"paged_attention": "xla",
+                           "fused_sampling": "off"},
+            "kernel_forced": {"paged_attention": "pallas_v2",
+                              "fused_sampling": "on"},
+        }
+        configs = [("kernel_xla", None, None, None, None),
+                   ("kernel_forced", None, None, None, None)]
     spec_on = {"enabled": True, "draft_tokens": args.draft_tokens}
     if args.speculative:
         configs = [("spec_off", None, None, None, None),
@@ -650,7 +693,8 @@ def main():
             cand, c_outs = measure_config(
                 name, args, params, mod, cfg, phase, prompts,
                 zero_inference=zi, prefix_cache=pc, speculative=spec,
-                kv_tier=kvt, tp=tp)
+                kv_tier=kvt, tp=tp,
+                kernels=kernels_by_name.get(name))
             if row is None or cand["value"] > row["value"]:
                 row, outs = cand, c_outs
         outputs_by_config[name] = outs
@@ -706,6 +750,29 @@ def main():
                 "mean_accepted_len": zon["detail"]["speculative"][
                     "mean_accepted_len"],
             }
+    if args.kernels and len(out["rows"]) == 2:
+        xla_r, frc_r = out["rows"]
+        o_x = outputs_by_config["kernel_xla"]
+        o_f = outputs_by_config["kernel_forced"]
+        # identity over the requests both arms completed (the wall
+        # cap can truncate different subsets)
+        both = sorted(set(o_x) & set(o_f))
+        mismatched = sum(1 for k in both if o_x[k] != o_f[k])
+        out["kernel_ab"] = {
+            "forced": kernels_by_name["kernel_forced"],
+            "tokens_per_s_xla": xla_r["value"],
+            "tokens_per_s_forced": frc_r["value"],
+            "speedup": (round(frc_r["value"] / xla_r["value"], 3)
+                        if xla_r["value"] else None),
+            "ttft_xla_ms": xla_r["detail"].get("ttft_ms"),
+            "ttft_forced_ms": frc_r["detail"].get("ttft_ms"),
+            "policy_xla": xla_r["detail"].get("kernels"),
+            "policy_forced": frc_r["detail"].get("kernels"),
+            "compared_requests": len(both),
+            # THE gate: a kernel is an execution strategy — greedy
+            # tokens must be identical, any mismatch is a bug
+            "mismatched_requests": mismatched,
+        }
     if args.tp and len(out["rows"]) == 2:
         one, sh = out["rows"]
         o_one = outputs_by_config["tp1"]

@@ -43,6 +43,7 @@ CONFIG_BLOCKS = {
     "ZeroInferenceConfig": "zero_inference",
     "PrefixCacheConfig": "prefix_cache",
     "KVTierConfig": "kv_tier",
+    "KernelsConfig": "kernels",
     "SpeculativeConfig": "speculative",
     "SLOConfig": "slo",
     "FaultsConfig": "faults",

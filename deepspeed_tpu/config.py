@@ -230,7 +230,16 @@ class KVTierConfig:
     ``quantize_cold``: int8-quantize pages on demote (per-token-row
     scales; dequantized on promote) so the cold tiers hold ~2x the
     pages.  Off by default — the spill path is then bit-exact and
-    served tokens are identical to tiering off.  ``demote_watermark``
+    served tokens are identical to tiering off.
+    ``quantized_resident`` (requires ``quantize_cold``): keep promoted
+    pages int8 IN HBM — the promotion publishes the stored codes +
+    per-token-row scales directly (no dequant, no f32 scatter) and the
+    attention kernel dequantizes in VMEM per block
+    (``paged_chunk_attention_v2_quant``), so the resident KV pool holds
+    ~2x the pages per HBM byte; accuracy stays within the same
+    documented ``KV_TIER_QUANT_RTOL`` bound as ``quantize_cold``
+    because the codes round-trip losslessly once quantized.
+    ``demote_watermark``
     is a fraction of the warm-pool cap: occupancy above it demotes the
     oldest warm pages proactively (1.0 = demote only under allocation
     pressure).  ``promote_group_pages`` is the double-buffer granule of
@@ -242,6 +251,7 @@ class KVTierConfig:
     nvme_dir: Optional[str] = None
     nvme_pool_bytes: Optional[int] = None    # None = unbounded
     quantize_cold: bool = False
+    quantized_resident: bool = False
     demote_watermark: float = 1.0
     promote_group_pages: int = 8
     aio_threads: int = 4
@@ -300,6 +310,15 @@ class KVTierConfig:
         if k.aio_threads < 1:
             raise ValueError(
                 f"kv_tier.aio_threads must be >= 1, got {k.aio_threads}")
+        k.quantized_resident = bool(k.quantized_resident)
+        k.quantize_cold = bool(k.quantize_cold)
+        if k.quantized_resident and not k.quantize_cold:
+            # the resident pool holds the SAME int8 codes the cold tier
+            # stores — without quantize_cold there is nothing to publish
+            raise ValueError(
+                "kv_tier.quantized_resident requires "
+                "kv_tier.quantize_cold: true (it serves the cold tier's "
+                "int8 pages in place)")
         return k
 
     @classmethod
@@ -318,6 +337,67 @@ class KVTierConfig:
             return cls.from_dict(d)
         raise TypeError(
             f"kv_tier must be a bool, dict or KVTierConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
+class KernelsConfig:
+    """Serving kernel-dispatch policy (the config-first replacement for
+    the ``DSTPU_FORCE_PAGED_PALLAS`` / ``DSTPU_PAGED_V1`` env-flag
+    folklore).
+
+    ``paged_attention`` picks the paged decode/chunk attention
+    implementation: ``auto`` (the shape-measured crossover gate,
+    ``pallas_paged_gate`` — XLA gather below the crossover, the Pallas
+    v2 DMA kernel above it), ``xla`` (always the gather reference
+    composition), ``pallas_v1`` (the one-page-per-grid-step kernel,
+    kept for A/B), or ``pallas_v2`` (force the double-buffered DMA
+    kernel).  ``fused_sampling`` picks the boundary/decode sampler:
+    ``auto`` (crossover gate on batch x vocab), ``off`` (the jitted XLA
+    ``_sample_rows``), ``on`` (force the fused Pallas greedy kernel;
+    greedy output is bit-exact either way).
+
+    Resolution happens ONCE at engine build (``resolve_serving_kernels``
+    in :mod:`deepspeed_tpu.inference.kernels`): env vars still win as
+    overrides at that point, the resolved policy is baked into the
+    compiled programs and surfaced in ``/statusz`` under ``kernels``,
+    and a forced Pallas choice that the build must demote (tensor
+    parallelism — the kernel is per-device) falls back VISIBLY with a
+    recorded reason + counter instead of silently.
+    """
+
+    paged_attention: str = "auto"
+    fused_sampling: str = "auto"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "KernelsConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        k = cls(**{kk: v for kk, v in d.items() if kk in known})
+        k.paged_attention = str(k.paged_attention)
+        k.fused_sampling = str(k.fused_sampling)
+        if k.paged_attention not in ("auto", "xla", "pallas_v1",
+                                     "pallas_v2"):
+            raise ValueError(
+                f"kernels.paged_attention must be one of auto|xla|"
+                f"pallas_v1|pallas_v2, got {k.paged_attention!r}")
+        if k.fused_sampling not in ("auto", "off", "on"):
+            raise ValueError(
+                f"kernels.fused_sampling must be one of auto|off|on, "
+                f"got {k.fused_sampling!r}")
+        return k
+
+    @classmethod
+    def coerce(cls, obj) -> "KernelsConfig":
+        """Accept None (all-auto defaults), a dict, or a KernelsConfig —
+        there is no enabled switch: ``auto`` IS the default policy."""
+        if obj is None:
+            return cls()
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, dict):
+            return cls.from_dict(dict(obj))
+        raise TypeError(
+            f"kernels must be a dict or KernelsConfig, got "
             f"{type(obj).__name__}")
 
 
@@ -1332,6 +1412,8 @@ class Config:
         default_factory=PrefixCacheConfig)
     kv_tier: KVTierConfig = dataclasses.field(
         default_factory=KVTierConfig)
+    kernels: KernelsConfig = dataclasses.field(
+        default_factory=KernelsConfig)
     speculative: SpeculativeConfig = dataclasses.field(
         default_factory=SpeculativeConfig)
     slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
@@ -1462,6 +1544,10 @@ class Config:
             # (same contract as prefix_cache above); an explicit
             # "enabled": false still disables
             c.kv_tier = KVTierConfig.coerce(d["kv_tier"])
+        if "kernels" in d:
+            # no enabled switch here: "auto" is the default policy and
+            # writing the block just overrides fields of it
+            c.kernels = KernelsConfig.coerce(d["kernels"])
         if "speculative" in d:
             # coerce, not from_dict: writing the block IS the opt-in
             # (same contract as zero_inference / prefix_cache above);

@@ -236,4 +236,10 @@ def init_serving(params, model_config, *, config: Any = None,
         # `tracing` block → the engine's RequestTracer flight recorder
         # (per-request event timelines + hang postmortems)
         kw.setdefault("tracing", config.tracing)
+        # `kernels` block → the serving kernel-dispatch policy
+        # (paged_attention / fused_sampling), resolved ONCE at engine
+        # build with env vars as overrides of last resort.  No
+        # .enabled guard: "auto" IS the default policy, so the block
+        # always passes through (an explicit kernels= kw still wins)
+        kw.setdefault("kernels", config.kernels)
     return serving_engine(params, model_config, mesh=mesh, **kw)

@@ -45,6 +45,11 @@ class PagedKVCache(NamedTuple):
     table: jnp.ndarray
     seq_lens: jnp.ndarray
     page_size: int
+    # int8-resident mode (kv_tier.quantized_resident): k/v hold int8
+    # codes and these hold the per-token-row f32 scales
+    # [L, KV, num_pages, page_size, 1]; None on the plain path.
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
 
     @classmethod
     def alloc(cls, n_layers: int, n_kv: int, num_pages: int, page_size: int,
@@ -431,6 +436,121 @@ def write_chunk_pages(pages_k, pages_v, new_k, new_v, table, start,
     return upd(pages_k, new_k), upd(pages_v, new_v)
 
 
+# ------------------------------------------- int8-resident page helpers
+# (kv_tier.quantized_resident: the resident pool holds the SAME symmetric
+# per-token-row int8 codec kv_tier.quantize_page uses on demote, so a
+# promotion publishes stored codes directly and the attention kernel
+# dequantizes in VMEM.  These are the jnp twins of the numpy codec in
+# deepspeed_tpu/inference/kv_tier.py — keep the rounding identical or the
+# lossless demote→promote→demote round trip breaks.)
+# dstpu: hot-path
+def quantize_kv_rows(x):
+    """Symmetric per-last-dim-row int8 quantization of K/V rows on
+    device: ``x [..., Dh]`` → ``(codes int8 [..., Dh], scales f32
+    [..., 1])``.  Matches ``kv_tier.quantize_page`` bit-for-bit
+    (``scale = amax/127``, zero rows get scale 1.0, round-half-even)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    codes = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return codes, scale
+
+
+# dstpu: hot-path
+def dequantize_pages(codes, scales, dtype):
+    """Dequantize int8 page codes with their per-row scales back to
+    ``dtype`` — the XLA twin of the in-kernel VMEM dequant (and the
+    oracle the quant-kernel identity tests reference against)."""
+    return (codes.astype(jnp.float32) * scales).astype(dtype)
+
+
+def write_token_pages_quant(pages_k, pages_ks, pages_v, pages_vs,
+                            new_k, new_v, table, seq_lens,
+                            page_size: int):
+    """:func:`write_token_pages` for the int8-resident store: quantize
+    the appended rows on device and scatter codes + scales with the
+    same frontier/overflow-drop math.  Scale stores are
+    ``[KV, P, ps, 1]`` f32."""
+    max_pages = table.shape[1]
+    num_pages = pages_k.shape[1]
+    capacity = max_pages * page_size
+    valid = seq_lens < capacity
+    page_slot = jnp.minimum(seq_lens // page_size, max_pages - 1)
+    in_page = seq_lens % page_size
+    page_id = jnp.take_along_axis(table, page_slot[:, None], axis=1)[:, 0]
+    page_id = jnp.where(valid, page_id, num_pages)
+
+    def upd(store, sstore, new):
+        codes, scale = quantize_kv_rows(new)          # [B, KV, Dh/1]
+        return (store.at[:, page_id, in_page].set(
+                    codes.transpose(1, 0, 2), mode="drop"),
+                sstore.at[:, page_id, in_page].set(
+                    scale.transpose(1, 0, 2), mode="drop"))
+
+    pk, pks = upd(pages_k, pages_ks, new_k)
+    pv, pvs = upd(pages_v, pages_vs, new_v)
+    return pk, pks, pv, pvs
+
+
+def write_prompt_pages_quant(pages_k, pages_ks, pages_v, pages_vs,
+                             new_k, new_v, table, page_size: int):
+    """:func:`write_prompt_pages` for the int8-resident store (prefill
+    of an empty cache, quantizing per token row)."""
+    B, T, KV, Dh = new_k.shape
+    np_used = -(-T // page_size)
+    pad = np_used * page_size - T
+
+    def upd(store, sstore, new):
+        codes, scale = quantize_kv_rows(new)     # [B,T,KV,Dh], [B,T,KV,1]
+        if pad:
+            codes = jnp.concatenate(
+                [codes, jnp.zeros((B, pad, KV, Dh), codes.dtype)], axis=1)
+            # zero rows carry scale 1.0 by the codec's convention
+            scale = jnp.concatenate(
+                [scale, jnp.ones((B, pad, KV, 1), scale.dtype)], axis=1)
+        ids = table[:, :np_used].reshape(-1)
+
+        def blocks(x, d):
+            return x.reshape(B, np_used, page_size, KV, d) \
+                .transpose(3, 0, 1, 2, 4).reshape(KV, B * np_used,
+                                                  page_size, d)
+
+        return (store.at[:, ids].set(blocks(codes, Dh)),
+                sstore.at[:, ids].set(blocks(scale, 1)))
+
+    pk, pks = upd(pages_k, pages_ks, new_k)
+    pv, pvs = upd(pages_v, pages_vs, new_v)
+    return pk, pks, pv, pvs
+
+
+def write_chunk_pages_quant(pages_k, pages_ks, pages_v, pages_vs,
+                            new_k, new_v, table, start, page_size: int):
+    """:func:`write_chunk_pages` for the int8-resident store (split-fuse
+    continuation chunks at per-row frontiers)."""
+    B, C, KV, Dh = new_k.shape
+    max_pages = table.shape[1]
+    num_pages = pages_k.shape[1]
+    capacity = max_pages * page_size
+    pos = start[:, None] + jnp.arange(C, dtype=jnp.int32)[None]
+    valid = pos < capacity
+    page_slot = jnp.minimum(pos // page_size, max_pages - 1)
+    in_page = pos % page_size
+    page_id = jnp.take_along_axis(table, page_slot, axis=1)
+    page_id = jnp.where(valid, page_id, num_pages)
+
+    def upd(store, sstore, new):
+        codes, scale = quantize_kv_rows(new)
+        cvals = codes.transpose(2, 0, 1, 3).reshape(KV, B * C, Dh)
+        svals = scale.transpose(2, 0, 1, 3).reshape(KV, B * C, 1)
+        ids, ip = page_id.reshape(-1), in_page.reshape(-1)
+        return (store.at[:, ids, ip].set(cvals, mode="drop"),
+                sstore.at[:, ids, ip].set(svals, mode="drop"))
+
+    pk, pks = upd(pages_k, pages_ks, new_k)
+    pv, pvs = upd(pages_v, pages_vs, new_v)
+    return pk, pks, pv, pvs
+
+
 # -------------------------------------------------------- numerics oracle
 def paged_chunk_attention_reference(q, k_pages, v_pages, table, start,
                                     scale: Optional[float] = None):
@@ -671,6 +791,169 @@ def paged_chunk_attention_v2(q, k_pages, v_pages, table, start,
     return out.reshape(B, C, H, Dh)
 
 
+# ------------------------- int8-dequant-fused multi-page chunked kernel
+def _chunk_v2_quant_kernel(table_ref, start_ref, q_ref, kq_hbm, ks_hbm,
+                           vq_hbm, vs_hbm, o_ref, *, scale, ps, kv_heads,
+                           max_pages, cg8, group, chunk, ppcb):
+    """:func:`_chunk_v2_kernel` over int8-resident pages: per page the
+    DMA streams the int8 codes AND the per-token-row f32 scales
+    (``[ps, 1]`` — the same (N, 1) VMEM layout the v1 kernel's m/l
+    scratch uses), and the dequant ``codes * scale`` happens in VMEM
+    right before the dot — the gathered f32 K/V transient never exists
+    in HBM.  Everything else (double buffering, live-page sweep, online
+    softmax, masking) is the v2 kernel unchanged."""
+    bk = pl.program_id(0)
+    b = bk // kv_heads
+    h = bk % kv_heads
+    start = start_ref[b]
+    live = start + chunk
+    pages_live = (live + ps - 1) // ps
+    nch = (pages_live + ppcb - 1) // ppcb
+
+    def body(kqb, ksb, vqb, vsb, sem):
+        def chunk_dmas(c, slot):
+            dmas = []
+            for j in range(ppcb):                   # static unroll
+                p = c * ppcb + j
+                psafe = jnp.minimum(p, max_pages - 1)
+                pid = jnp.where(p < pages_live, table_ref[b, psafe], 0)
+                dmas.append(pltpu.make_async_copy(
+                    kq_hbm.at[h, pid],
+                    kqb.at[slot, pl.ds(j * ps, ps), :], sem.at[slot, 0]))
+                dmas.append(pltpu.make_async_copy(
+                    ks_hbm.at[h, pid],
+                    ksb.at[slot, pl.ds(j * ps, ps), :], sem.at[slot, 1]))
+                dmas.append(pltpu.make_async_copy(
+                    vq_hbm.at[h, pid],
+                    vqb.at[slot, pl.ds(j * ps, ps), :], sem.at[slot, 2]))
+                dmas.append(pltpu.make_async_copy(
+                    vs_hbm.at[h, pid],
+                    vsb.at[slot, pl.ds(j * ps, ps), :], sem.at[slot, 3]))
+            return dmas
+
+        @pl.when(nch > 0)
+        def _():
+            for d in chunk_dmas(0, 0):
+                d.start()
+
+        q = q_ref[0].astype(jnp.float32)            # [cg8, Dh]
+
+        def loop(c, carry):
+            m, l, acc = carry
+            slot = jax.lax.rem(c, 2)
+
+            @pl.when(c + 1 < nch)
+            def _():
+                for d in chunk_dmas(c + 1, jax.lax.rem(c + 1, 2)):
+                    d.start()
+
+            for d in chunk_dmas(c, slot):
+                d.wait()
+            # VMEM dequant: per-token-row scales broadcast over Dh
+            k = kqb[slot].astype(jnp.float32) * ksb[slot]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            kpos = c * (ppcb * ps) + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            qpos = start + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0) // group
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            pr = jnp.exp(s - m_new)
+            pr = jnp.where(s > NEG_INF / 2, pr, 0.0)
+            l = l * alpha + jnp.sum(pr, axis=1, keepdims=True)
+            v = vqb[slot].astype(jnp.float32) * vsb[slot]
+            acc = acc * alpha + jax.lax.dot_general(
+                pr, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l, acc
+
+        init = (jnp.full((cg8, 1), NEG_INF, jnp.float32),
+                jnp.zeros((cg8, 1), jnp.float32),
+                jnp.zeros((cg8, q_ref.shape[2]), jnp.float32))
+        m, l, acc = jax.lax.fori_loop(0, nch, loop, init)
+        l = jnp.where(l == 0.0, 1.0, l)             # empty rows → zeros
+        o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        body,
+        kqb=pltpu.VMEM((2, ppcb * ps, q_ref.shape[2]), kq_hbm.dtype),
+        ksb=pltpu.VMEM((2, ppcb * ps, 1), jnp.float32),
+        vqb=pltpu.VMEM((2, ppcb * ps, q_ref.shape[2]), vq_hbm.dtype),
+        vsb=pltpu.VMEM((2, ppcb * ps, 1), jnp.float32),
+        sem=pltpu.SemaphoreType.DMA((2, 4)),
+    )
+
+
+# dstpu: hot-path
+def paged_chunk_attention_v2_quant(q, kq_pages, ks_pages, vq_pages,
+                                   vs_pages, table, start,
+                                   scale: Optional[float] = None,
+                                   pages_per_block: int = 8,
+                                   interpret: bool = False):
+    """Int8-dequant-fused chunked-prefill attention: same contract as
+    :func:`paged_chunk_attention_reference` over
+    ``dequantize_pages(kq, ks) / (vq, vs)``, but the dequant happens in
+    VMEM inside the page sweep — the ~2x-smaller int8 pages are what
+    crosses HBM.  ``kq/vq_pages``: int8 ``[KV, P, ps, Dh]``;
+    ``ks/vs_pages``: f32 ``[KV, P, ps, 1]`` per-token-row scales (the
+    ``kv_tier.quantize_page`` codec)."""
+    B, C, H, Dh = q.shape
+    KV, P, ps, _ = kq_pages.shape
+    G = H // KV
+    mp = table.shape[1]
+    scale = scale if scale is not None else Dh ** -0.5
+    ppcb = max(1, min(pages_per_block, mp))
+    CG = C * G
+    cg8 = -(-CG // 8) * 8
+    qg = q.reshape(B, C, KV, G, Dh).transpose(0, 2, 1, 3, 4) \
+        .reshape(B * KV, CG, Dh)
+    if cg8 != CG:
+        qg = jnp.concatenate(
+            [qg, jnp.zeros((B * KV, cg8 - CG, Dh), q.dtype)], axis=1)
+
+    kernel = functools.partial(
+        _chunk_v2_quant_kernel, scale=scale, ps=ps, kv_heads=KV,
+        max_pages=mp, cg8=cg8, group=G, chunk=C, ppcb=ppcb)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,   # table, start
+            grid=(B * KV,),
+            in_specs=[
+                pl.BlockSpec((1, cg8, Dh), lambda bk, tbl, st: (bk, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, cg8, Dh), lambda bk, tbl, st: (bk, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * KV, cg8, Dh), q.dtype),
+        interpret=interpret,
+    )(table, start, qg, kq_pages, ks_pages, vq_pages, vs_pages)
+    out = out[:, :CG].reshape(B, KV, C, G, Dh).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, C, H, Dh)
+
+
+# dstpu: hot-path
+def paged_decode_attention_v2_quant(q, kq_pages, ks_pages, vq_pages,
+                                    vs_pages, table, seq_lens,
+                                    scale: Optional[float] = None,
+                                    pages_per_block: int = 8,
+                                    interpret: bool = False):
+    """Int8-dequant-fused paged decode attention — the C=1 chunked case,
+    exactly as :func:`paged_decode_attention_v2` delegates."""
+    return paged_chunk_attention_v2_quant(
+        q[:, None], kq_pages, ks_pages, vq_pages, vs_pages, table,
+        seq_lens - 1, scale=scale, pages_per_block=pages_per_block,
+        interpret=interpret)[:, 0]
+
+
 # ------------------------------------------- pallas chunked-prefill kernel
 def _chunk_kernel(table_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
                   m_scr, l_scr, acc_scr, *, scale, page_size, kv_heads,
@@ -781,69 +1064,245 @@ def paged_chunk_attention(q, k_pages, v_pages, table, start,
 
 
 # --------------------------------------------- shared per-layer dispatch
+# Crossover for the auto policy: total live-KV bytes (K+V pages a full-
+# occupancy decode sweep reads) below which the XLA gather composition
+# wins.  Anchored on KERNEL_BENCH.json: the r5 v5e rows show the gather
+# at ~1-6 ms across every small/mid decode shape (nothing for a kernel
+# to claw back below ~256 MiB of live KV), and the paged_v2_vs_xla
+# crossover-sweep rows carry the forced-on v2 arm next to the gather at
+# each shape so the threshold is re-derivable from committed evidence.
+# The v2 kernel fixes the measured v1 failure (one 16-token page per
+# grid step = B*KV*mp tiny dispatches, 25x slower at the largest shape)
+# by streaming ppcb pages per inner iteration through double-buffered
+# DMA — the regime where that pays is big-KV decode, where the sweep is
+# HBM-bandwidth-bound and the gather's materialized transient stops
+# fitting anywhere useful.  Re-stamp the sweep on chip before lowering
+# this.
+_PAGED_V2_MIN_KV_BYTES = 1 << 28
+
+
 def pallas_paged_gate(B: int, n_kv: int, head_dim: int, page_size: int,
                       max_pages: int, kv_itemsize: int,
                       interpret: bool, tp: bool) -> bool:
-    """One policy for when the pallas paged kernels beat the XLA gather
-    references, shared by every model's paged forward.
+    """The shape-dependent ``auto`` policy for the paged Pallas kernels,
+    shared by every model's paged forward: True when the multi-page v2
+    kernel should replace the XLA gather composition for this shape.
 
-    Measured policy (KERNEL_BENCH.json r5, v5e): the XLA gather path
-    wins at EVERY tested decode shape — ~1.1-1.2x at small/mid sizes
-    and 25x at the largest (B=16 H=32 seq=4096: gather 5.8 ms vs
-    pallas 145 ms).  The old premise — "the kernel pays off once the
-    gathered K/V transient is too big to materialize" — is false: XLA
-    fuses the page gather into the attention without materializing it,
-    while the pallas grid walks one 16-token page per step (B*KV*mp
-    tiny DMAs).  So the gather is the default everywhere; the kernel
-    remains opt-in (DSTPU_FORCE_PAGED_PALLAS=1 — set it BEFORE the
-    first forward: the flag is read at trace time, so already-compiled
-    shapes keep whatever policy they were traced with) as the base for
-    a multi-page-per-step rewrite.  The shape parameters are unused by
-    the current policy but intentionally kept: that rewrite's gate will
-    be shape-dependent, and the call sites already plumb them."""
+    Pure shape math — no env reads.  Env/config overrides are resolved
+    ONCE at engine build by :func:`resolve_serving_kernels` (which
+    passes an explicit ``paged_kernel`` down, bypassing this gate), so
+    an already-compiled program can never disagree with the visible
+    policy.  The crossover is total live-KV bytes per decode sweep
+    (``_PAGED_V2_MIN_KV_BYTES``, see the comment above): below it the
+    measured XLA gather is already ~ms-fast; above it the double-
+    buffered DMA sweep streams the pages the gather would materialize.
+
+    ``interpret`` (CPU) always takes the reference path — interpret-mode
+    kernels are a correctness harness, not a fast path.  ``tp`` also
+    returns False: the kernel is per-device and the serving engines
+    surface that demotion VISIBLY (``serving_kernel_fallbacks`` counter
+    + a ``/statusz`` reason via :func:`resolve_serving_kernels`) rather
+    than silently as before."""
     if interpret or tp:
         return False
-    return os.environ.get("DSTPU_FORCE_PAGED_PALLAS", "") == "1"
+    live_kv_bytes = (2 * B * n_kv * max_pages * page_size * head_dim
+                     * kv_itemsize)
+    return live_kv_bytes >= _PAGED_V2_MIN_KV_BYTES
+
+
+class ServingKernelPolicy(NamedTuple):
+    """The kernel-dispatch policy an engine build resolved — baked into
+    the compiled programs and surfaced verbatim in ``/statusz``."""
+
+    paged_attention: str            # auto | xla | pallas_v1 | pallas_v2
+    fused_sampling: str             # off | on
+    # (field, value, source) for every env var that overrode the config
+    env_overrides: Tuple[Tuple[str, str, str], ...] = ()
+    # (field, demoted_to, reason) for forced choices the build demoted
+    fallbacks: Tuple[Tuple[str, str, str], ...] = ()
+
+    def as_dict(self) -> dict:
+        return {
+            "paged_attention": self.paged_attention,
+            "fused_sampling": self.fused_sampling,
+            "env_overrides": [list(o) for o in self.env_overrides],
+            "fallbacks": [{"field": f, "demoted_to": d, "reason": r}
+                          for f, d, r in self.fallbacks],
+        }
+
+
+def resolve_serving_kernels(kernels=None, *, tp: bool = False,
+                            interpret: bool = False) -> ServingKernelPolicy:
+    """Resolve the serving kernel-dispatch policy ONCE, at engine build.
+
+    ``kernels``: a ``KernelsConfig`` / dict / None (all-auto).  Env vars
+    are the overrides of last resort and are read HERE — never again at
+    trace time — so the policy a program compiled with is exactly the
+    policy ``/statusz`` reports: ``DSTPU_PAGED_ATTENTION`` /
+    ``DSTPU_FUSED_SAMPLING`` name a mode directly, and the legacy
+    spellings ``DSTPU_FORCE_PAGED_PALLAS=1`` (→ ``pallas_v2``, or
+    ``pallas_v1`` when ``DSTPU_PAGED_V1=1`` rides along) and
+    ``DSTPU_FORCE_FUSED_SAMPLING=1`` (→ ``on``) keep working.
+
+    A forced Pallas paged kernel under tensor parallelism is demoted to
+    ``xla`` with a recorded reason — the kernel dereferences the full
+    page table per (batch, kv_head) grid step and KV heads are sharded
+    over the mesh, so per-device it would read pages it does not hold;
+    the demotion is VISIBLE (``fallbacks`` row + the engine's
+    ``serving_kernel_fallbacks`` counter), fixing the old silent
+    ``tp → False``.
+
+    An already-resolved :class:`ServingKernelPolicy` passes through
+    untouched — the model builders resolve once and hand the SAME
+    policy to the engine, so the kernels the closures baked and the
+    policy ``/statusz`` reports can never drift."""
+    from deepspeed_tpu.config import KernelsConfig
+
+    if isinstance(kernels, ServingKernelPolicy):
+        return kernels
+    cfg = KernelsConfig.coerce(kernels)
+    paged = cfg.paged_attention
+    fused = cfg.fused_sampling
+    env_overrides = []
+    env_pa = os.environ.get("DSTPU_PAGED_ATTENTION", "")
+    if env_pa:
+        if env_pa not in ("auto", "xla", "pallas_v1", "pallas_v2"):
+            raise ValueError(
+                f"DSTPU_PAGED_ATTENTION must be auto|xla|pallas_v1|"
+                f"pallas_v2, got {env_pa!r}")
+        paged = env_pa
+        env_overrides.append(
+            ("paged_attention", env_pa, "DSTPU_PAGED_ATTENTION"))
+    elif os.environ.get("DSTPU_FORCE_PAGED_PALLAS", "") == "1":
+        paged = ("pallas_v1"
+                 if os.environ.get("DSTPU_PAGED_V1", "") == "1"
+                 else "pallas_v2")
+        env_overrides.append(
+            ("paged_attention", paged, "DSTPU_FORCE_PAGED_PALLAS"))
+    env_fs = os.environ.get("DSTPU_FUSED_SAMPLING", "")
+    if env_fs:
+        if env_fs not in ("auto", "off", "on"):
+            raise ValueError(
+                f"DSTPU_FUSED_SAMPLING must be auto|off|on, got "
+                f"{env_fs!r}")
+        fused = env_fs
+        env_overrides.append(
+            ("fused_sampling", env_fs, "DSTPU_FUSED_SAMPLING"))
+    elif os.environ.get("DSTPU_FORCE_FUSED_SAMPLING", "") == "1":
+        fused = "on"
+        env_overrides.append(
+            ("fused_sampling", "on", "DSTPU_FORCE_FUSED_SAMPLING"))
+
+    fallbacks = []
+    if tp and paged in ("pallas_v1", "pallas_v2"):
+        fallbacks.append((f"paged_attention={paged}", "xla",
+                          "tp_unsupported: KV heads are sharded over "
+                          "the mesh; the kernel reads the full page "
+                          "table per device"))
+        paged = "xla"
+    if fused == "auto":
+        # the measured policy (KERNEL_BENCH.json fused_sample_vs_xla):
+        # sampling is one [B, V] argmax — the jitted XLA twin wins at
+        # every serving shape in the committed sweep, so auto resolves
+        # off and the fused kernel stays a forced arm until a chip
+        # re-stamp says otherwise (see ops/sampling_pallas.py)
+        from deepspeed_tpu.ops.sampling_pallas import pallas_sample_gate
+
+        fused = "on" if pallas_sample_gate(interpret=interpret) else "off"
+    return ServingKernelPolicy(
+        paged_attention=paged, fused_sampling=fused,
+        env_overrides=tuple(env_overrides), fallbacks=tuple(fallbacks))
 
 
 def paged_attention_step(q, k, v, kp, vp, table, start, page_size: int, *,
                          continuation: bool, prefill: bool,
-                         use_pallas: bool, flash_force_reference: bool):
+                         paged_kernel: str,
+                         flash_force_reference: bool,
+                         interpret: bool = False,
+                         kps=None, vps=None):
     """The per-layer paged-attention step every model family shares:
     page writes + the right attention for the phase.
 
     q: [B, T, H, Dh]; k/v: [B, T, KV, Dh]; kp/vp: one layer's pages.
-    Phases: chunked-prefill continuation (split-fuse), whole-prompt
-    prefill (empty cache), or single-token decode.  Returns
-    (attn [B, T, H, Dh], kp, vp)."""
+    ``paged_kernel`` is the RESOLVED dispatch ("xla" | "pallas_v1" |
+    "pallas_v2" — the gate/policy decided before the trace; no env
+    reads here).  A forced Pallas kernel with ``interpret=True`` runs
+    in interpret mode — that is an explicit request and exactly how the
+    CPU identity gates exercise the kernels.  ``kps``/``vps`` non-None
+    selects the int8-resident path: kp/vp hold int8 codes, kps/vps the
+    per-token-row f32 scales, writes quantize on device, and
+    "pallas_v2" dispatches the dequant-fused kernel ("xla" dequantizes
+    with :func:`dequantize_pages` and runs the references; there is no
+    quantized v1).  Phases: chunked-prefill continuation (split-fuse),
+    whole-prompt prefill (empty cache), or single-token decode.
+    Returns (attn [B, T, H, Dh], kp, vp, kps, vps)."""
     from deepspeed_tpu.ops.attention import flash_attention
 
+    quant = kps is not None
+    if quant and paged_kernel == "pallas_v1":
+        raise ValueError("int8-resident pages have no pallas_v1 kernel "
+                         "(use xla or pallas_v2)")
     if continuation and q.shape[1] > 1:
-        kp, vp = write_chunk_pages(kp, vp, k, v, table, start, page_size)
-        if use_pallas:
-            pa = (paged_chunk_attention
-                  if os.environ.get("DSTPU_PAGED_V1", "") == "1"
-                  else paged_chunk_attention_v2)
+        if quant:
+            kp, kps, vp, vps = write_chunk_pages_quant(
+                kp, kps, vp, vps, k, v, table, start, page_size)
+            if paged_kernel == "pallas_v2":
+                attn = paged_chunk_attention_v2_quant(
+                    q, kp, kps, vp, vps, table, start,
+                    interpret=interpret)
+            else:
+                attn = paged_chunk_attention_reference(
+                    q, dequantize_pages(kp, kps, q.dtype),
+                    dequantize_pages(vp, vps, q.dtype), table, start)
         else:
-            pa = paged_chunk_attention_reference
-        attn = pa(q, kp, vp, table, start)
+            kp, vp = write_chunk_pages(kp, vp, k, v, table, start,
+                                       page_size)
+            if paged_kernel == "pallas_v1":
+                attn = paged_chunk_attention(q, kp, vp, table, start,
+                                             interpret=interpret)
+            elif paged_kernel == "pallas_v2":
+                attn = paged_chunk_attention_v2(q, kp, vp, table, start,
+                                                interpret=interpret)
+            else:
+                attn = paged_chunk_attention_reference(q, kp, vp, table,
+                                                       start)
     elif prefill:
         attn = flash_attention(q, k, v, causal=True,
                                force_reference=flash_force_reference)
-        kp, vp = write_prompt_pages(kp, vp, k, v, table, page_size)
-    else:
-        kp, vp = write_token_pages(kp, vp, k[:, 0], v[:, 0], table, start,
-                                   page_size)
-        if use_pallas:
-            # v2 (multi-page DMA streaming) unless explicitly pinned to
-            # the one-page-per-grid-step v1 (DSTPU_PAGED_V1=1)
-            pa = (paged_decode_attention
-                  if os.environ.get("DSTPU_PAGED_V1", "") == "1"
-                  else paged_decode_attention_v2)
+        if quant:
+            kp, kps, vp, vps = write_prompt_pages_quant(
+                kp, kps, vp, vps, k, v, table, page_size)
         else:
-            pa = paged_attention_reference
-        attn = pa(q[:, 0], kp, vp, table, start + 1)[:, None]
-    return attn, kp, vp
+            kp, vp = write_prompt_pages(kp, vp, k, v, table, page_size)
+    else:
+        if quant:
+            kp, kps, vp, vps = write_token_pages_quant(
+                kp, kps, vp, vps, k[:, 0], v[:, 0], table, start,
+                page_size)
+            if paged_kernel == "pallas_v2":
+                attn = paged_decode_attention_v2_quant(
+                    q[:, 0], kp, kps, vp, vps, table, start + 1,
+                    interpret=interpret)[:, None]
+            else:
+                attn = paged_attention_reference(
+                    q[:, 0], dequantize_pages(kp, kps, q.dtype),
+                    dequantize_pages(vp, vps, q.dtype), table,
+                    start + 1)[:, None]
+        else:
+            kp, vp = write_token_pages(kp, vp, k[:, 0], v[:, 0], table,
+                                       start, page_size)
+            if paged_kernel == "pallas_v1":
+                attn = paged_decode_attention(
+                    q[:, 0], kp, vp, table, start + 1,
+                    interpret=interpret)[:, None]
+            elif paged_kernel == "pallas_v2":
+                attn = paged_decode_attention_v2(
+                    q[:, 0], kp, vp, table, start + 1,
+                    interpret=interpret)[:, None]
+            else:
+                attn = paged_attention_reference(
+                    q[:, 0], kp, vp, table, start + 1)[:, None]
+    return attn, kp, vp, kps, vps
 
 
 def paged_forward_prelude(cache, tokens, interpret, tp,

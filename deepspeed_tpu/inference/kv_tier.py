@@ -112,6 +112,28 @@ def encode_entry(key: bytes, k: np.ndarray, v: np.ndarray, *,
         tick=tick, checksums=sums)
 
 
+def encode_prequantized_entry(key: bytes, kq: np.ndarray, ks: np.ndarray,
+                              vq: np.ndarray, vs: np.ndarray, *,
+                              page_dtype, tick: int = 0) -> TierEntry:
+    """Serialize a page whose payload is ALREADY the int8 codec's
+    (codes, scales) — the quantized-resident serving path demotes the
+    device's code/scale planes verbatim, so no dequantize/requantize
+    round-trip (and no second rounding) ever touches the data.  Buffer
+    naming and checksums match :func:`encode_entry`'s quantized layout
+    exactly: a prequantized demote and a host-side quantize of the
+    same values produce interchangeable entries."""
+    hexk = key_hex(key)
+    data = tuple(np.ascontiguousarray(b) for b in (kq, ks, vq, vs))
+    bufs = tuple((f"kv_{hexk}_{i}", tuple(b.shape), str(b.dtype))
+                 for i, b in enumerate(data))
+    sums = tuple(_crc(b) for b in data)
+    return TierEntry(
+        key=key, location="host", quantized=True,
+        dtype=str(np.dtype(page_dtype)), buffers=bufs,
+        nbytes=int(sum(b.nbytes for b in data)), data=data,
+        tick=tick, checksums=sums)
+
+
 # ------------------------------------------------- NVMe read/write legs
 class _KVNvmeChannel:
     """Alternating-slot aio READ channel over per-page spill files,
@@ -429,6 +451,34 @@ class KVTierPool:
                 _faults.corrupt_array(entry.data[0])
         return self._land(entry)
 
+    def demote_prequantized(self, key: bytes, kq: np.ndarray,
+                            ks: np.ndarray, vq: np.ndarray,
+                            vs: np.ndarray) -> Optional[str]:
+        """Capture one ALREADY-QUANTIZED page (``kq``/``vq``: int8
+        codes [L, KV, ps, Dh]; ``ks``/``vs``: f32 scales [L, KV, ps,
+        1]) — the quantized-resident engine's demote path, where the
+        device planes ARE the codec form so the host-side quantize in
+        :meth:`demote` would be a lossy no-op.  Same landing/cascade
+        semantics; requires ``quantize_cold`` (the config validates
+        the pairing, this guards direct callers)."""
+        if self.disabled is not None:
+            return None             # circuit-broken: plain eviction
+        if key in self.entries:
+            return self.touch(key)
+        if not self.cfg.quantize_cold:
+            raise ValueError(
+                "demote_prequantized requires kv_tier.quantize_cold — "
+                "a dense-entry pool cannot hold int8 codec payloads")
+        self._tick += 1
+        entry = encode_prequantized_entry(
+            key, kq, ks, vq, vs, page_dtype=self.page_dtype,
+            tick=self._tick)
+        if _faults.active_plan() is not None:
+            _delay, err = _faults.poll("kv_corrupt", key_hex(key))
+            if err is not None:
+                _faults.corrupt_array(entry.data[0])
+        return self._land(entry)
+
     def admit_entry(self, entry: TierEntry) -> Optional[str]:
         """Admit an ALREADY-SERIALIZED entry (a fabric migration: the
         payload was encoded — and checksummed — on another replica;
@@ -652,23 +702,28 @@ class KVTierPool:
                 "host-resident")
         return e.data[int(i)]
 
+    def _verify(self, key: bytes, e: TierEntry, bufs) -> None:
+        """Check every fenced buffer against the checksum recorded at
+        demote time — corrupt payloads must raise
+        :class:`~deepspeed_tpu.faults.ChecksumError` BEFORE anything
+        scatters into live HBM pages."""
+        if e.checksums is None:
+            return
+        for (name, _s, _d), buf, want in zip(e.buffers, bufs,
+                                             e.checksums):
+            got = _crc(buf)
+            if got != want:
+                raise ChecksumError(
+                    f"KV-tier page {key_hex(key)[:12]} buffer "
+                    f"{name}: payload checksum mismatch "
+                    f"({got:#x} != {want:#x}) — spilled copy is "
+                    "corrupt")
+
     def decode(self, key: bytes, bufs) -> Tuple[np.ndarray, np.ndarray]:
         """Fenced buffers → the page's (k, v) in the cache dtype
-        (dequantizing cold pages).  Verifies each buffer against the
-        checksum recorded at demote time FIRST — corrupt payloads must
-        raise :class:`~deepspeed_tpu.faults.ChecksumError` here, never
-        scatter into live HBM pages."""
+        (dequantizing cold pages).  Checksum-verified FIRST."""
         e = self.entries[key]
-        if e.checksums is not None:
-            for (name, _s, _d), buf, want in zip(e.buffers, bufs,
-                                                 e.checksums):
-                got = _crc(buf)
-                if got != want:
-                    raise ChecksumError(
-                        f"KV-tier page {key_hex(key)[:12]} buffer "
-                        f"{name}: payload checksum mismatch "
-                        f"({got:#x} != {want:#x}) — spilled copy is "
-                        "corrupt")
+        self._verify(key, e, bufs)
         if e.quantized:
             kq, ks, vq, vs = bufs
             return (dequantize_page(kq, ks, self.page_dtype),
@@ -676,6 +731,24 @@ class KVTierPool:
         k, v = bufs
         return (np.asarray(k, self.page_dtype),
                 np.asarray(v, self.page_dtype))
+
+    def decode_quantized(self, key: bytes, bufs):
+        """Fenced buffers → the page's RAW int8 codec form ``(kq, ks,
+        vq, vs)``, checksum-verified first — the quantized-resident
+        publish path scatters these straight into the device's
+        code/scale planes, skipping the dense dequantize entirely (the
+        whole point of ``kv_tier.quantized_resident``).  Raises on a
+        dense (unquantized) entry: there are no codes to publish."""
+        e = self.entries[key]
+        if not e.quantized:
+            raise ValueError(
+                f"KV-tier page {key_hex(key)[:12]} is a dense entry — "
+                "quantized-resident promotion needs "
+                "kv_tier.quantize_cold payloads")
+        self._verify(key, e, bufs)
+        kq, ks, vq, vs = bufs
+        return (np.asarray(kq, np.int8), np.asarray(ks, np.float32),
+                np.asarray(vq, np.int8), np.asarray(vs, np.float32))
 
 
 class _HostOnlyView:

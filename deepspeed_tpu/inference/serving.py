@@ -85,7 +85,8 @@ from deepspeed_tpu.config import (FaultsConfig, HistoryConfig,
 from deepspeed_tpu.faults import ChecksumError, FaultPlan, InjectedFault
 from deepspeed_tpu.history import NULL_HISTORY, MetricHistory
 from deepspeed_tpu.incidents import NULL_INCIDENTS, IncidentManager
-from deepspeed_tpu.inference.kernels import PagedKVCache, PageAllocator
+from deepspeed_tpu.inference.kernels import (PagedKVCache, PageAllocator,
+                                             resolve_serving_kernels)
 from deepspeed_tpu.inference.prefix_cache import (extend_page_keys,
                                                   key_hex,
                                                   matchable_pages,
@@ -259,7 +260,7 @@ class ServingEngine:
                  shed_queue_depth: int = 0,
                  shed_expired_deadline: bool = False,
                  replica_id: Optional[str] = None,
-                 history=None, incidents=None):
+                 history=None, incidents=None, kernels=None):
         # Sharded serving (ref: deepspeed/module_inject/replace_module.py
         # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
         # inference): with a mesh, params arrive pre-sharded from the
@@ -366,6 +367,40 @@ class ServingEngine:
                     if self._repl is not None else x)
 
         self._put = put_repl
+        # ---- serving-kernel policy: resolved ONCE, here, at build —
+        # config block + env overrides collapse to a concrete choice
+        # per dispatch site BEFORE any program traces (the old
+        # DSTPU_FORCE_PAGED_PALLAS read inside the gate made a cached
+        # trace depend on ambient env state).  Forced Pallas under a
+        # sharded mesh demotes to xla VISIBLY: the reason lands in
+        # policy.fallbacks, the serving_kernel_fallbacks counter, and
+        # /statusz — never a silent False deep in the gate.
+        self._interpret = jax.default_backend() != "tpu"
+        self._kernels = resolve_serving_kernels(
+            kernels, tp=active, interpret=self._interpret)
+        if self._kernels.fused_sampling == "on":
+            from deepspeed_tpu.ops.sampling_pallas import fused_sample_rows
+
+            _itp = self._interpret
+            self._sample_fn = (lambda lg, ky, tm:
+                               fused_sample_rows(lg, ky, tm,
+                                                 interpret=_itp))
+        else:
+            self._sample_fn = _sample_rows
+        # kv_tier coerced BEFORE the cache alloc below: the
+        # quantized_resident mode changes the DEVICE cache's layout
+        # (int8 code planes + f32 per-token-row scale planes), not just
+        # the tier pool's host encoding
+        kvt = KVTierConfig.coerce(kv_tier)
+        self.kv_tier = kvt
+        self._kvt_on = kvt.enabled
+        self._quant_resident = kvt.enabled and kvt.quantized_resident
+        if self._quant_resident and \
+                self._kernels.paged_attention == "pallas_v1":
+            raise ValueError(
+                "kernels.paged_attention=pallas_v1 cannot serve "
+                "int8-resident pages (kv_tier.quantized_resident) — "
+                "there is no quantized v1 kernel; use xla or pallas_v2")
         self.cache = self._alloc_cache(n_layers, n_kv, num_pages,
                                        page_size, head_dim, cache_dtype)
         self._build_programs(prefill_fn, decode_fn, chunk_prefill_fn)
@@ -571,9 +606,7 @@ class ServingEngine:
         # suffix's prefill chunks.  The allocator owns the index
         # states; the KVTierPool owns the payloads; this engine owns
         # the device<->host data movement.
-        kvt = KVTierConfig.coerce(kv_tier)
-        self.kv_tier = kvt
-        self._kvt_on = kvt.enabled
+        kvt = self.kv_tier        # coerced above, before the cache alloc
         self._kv_pool = None
         # cross-replica KV fabric (attach_fabric): export/admit ride
         # the spill pool, so the handle stays None unless kv_tier is on
@@ -604,12 +637,23 @@ class ServingEngine:
             # serving critical path — the first real demote/promote
             # must cost a DMA, not an XLA compile inside a request's
             # TTFT
-            z = np.zeros((n_layers, n_kv, 1, page_size, head_dim),
-                         np.dtype(cache_dtype))
-            self._upload_promoted([self.trash_page], z, z)
+            if self._quant_resident:
+                zc = np.zeros((n_layers, n_kv, 1, page_size, head_dim),
+                              np.int8)
+                zs = np.ones((n_layers, n_kv, 1, page_size, 1),
+                             np.float32)
+                self._upload_promoted_q([self.trash_page], zc, zs,
+                                        zc, zs)
+            else:
+                z = np.zeros((n_layers, n_kv, 1, page_size, head_dim),
+                             np.dtype(cache_dtype))
+                self._upload_promoted([self.trash_page], z, z)
             n = 1
             while True:
-                self._fetch_pages_host([self.trash_page] * n)
+                if self._quant_resident:
+                    self._fetch_pages_host_q([self.trash_page] * n)
+                else:
+                    self._fetch_pages_host([self.trash_page] * n)
                 if n >= self.max_pages_per_seq:
                     break
                 n *= 2
@@ -637,9 +681,38 @@ class ServingEngine:
             "so this measures wait pressure, not distinct requests; "
             "waiting keeps the demoted span a DMA instead of "
             "re-prefilling it)")
+        self._c_kvt_qres_promotes = r.counter(
+            "kv_tier_quant_resident_promotes",
+            "tier promotions published as int8-resident pages — the "
+            "cold entry's codes+scales landed in HBM verbatim, the "
+            "dequantize->scatter the dense path pays was skipped")
         self._g_kvt_inflight = r.gauge(
             "kv_tier_promoting_pages",
             "pages with a tier promotion in flight right now")
+        # serving_kernel_dispatch counter family: one counter per
+        # RESOLVED dispatch site (the suffix names the choice
+        # resolve_serving_kernels baked at build), plus the visible
+        # fallback count — together with /statusz "kernels" these make
+        # the policy auditable at runtime, not just at build
+        pk = self._kernels.paged_attention
+        fs = ("fused" if self._kernels.fused_sampling == "on"
+              else "xla")
+        self._c_kdisp_paged = r.counter(
+            f"serving_kernel_dispatch_paged_{pk}",
+            "decode sweeps dispatched under the resolved "
+            "paged-attention policy (auto = the per-shape gate inside "
+            "the compiled forward)")
+        self._c_kdisp_sample = r.counter(
+            f"serving_kernel_dispatch_sample_{fs}",
+            "batched sampling dispatches (decode-chunk syncs + "
+            "prefill-boundary flushes) under the resolved sampler")
+        self._c_kernel_fb = r.counter(
+            "serving_kernel_fallbacks",
+            "forced kernel choices the build demoted visibly (e.g. "
+            "pallas under a sharded mesh falls back to xla — the "
+            "reason is in /statusz kernels.fallbacks)")
+        if self._kernels.fallbacks:
+            self._c_kernel_fb.inc(len(self._kernels.fallbacks))
         self._h_kvt_promote = r.histogram(
             "kv_tier_promote_seconds",
             "admission-submit -> pages-landed latency of one "
@@ -814,6 +887,24 @@ class ServingEngine:
             return (jax.device_put(x, self._kv_sharding)
                     if self._kv_sharding is not None else x)
 
+        table = self._put(jnp.full(
+            (self.max_batch, self.max_pages_per_seq),
+            self.trash_page, jnp.int32))
+        seq_lens = self._put(jnp.zeros((self.max_batch,), jnp.int32))
+        if self._quant_resident:
+            # int8-resident pages: codes replace the dense planes
+            # (~2x the pages per HBM byte at bf16, 4x at f32) and a
+            # per-token-row f32 scale plane rides along.  Scales init
+            # to ONE — the codec's convention for all-zero rows, so an
+            # untouched page round-trips exactly.
+            shape = (n_layers, n_kv, num_pages, page_size, head_dim)
+            sshape = (n_layers, n_kv, num_pages, page_size, 1)
+            return PagedKVCache(
+                k=put_kv(jnp.zeros(shape, jnp.int8)),
+                v=put_kv(jnp.zeros(shape, jnp.int8)),
+                table=table, seq_lens=seq_lens, page_size=page_size,
+                k_scale=put_kv(jnp.ones(sshape, jnp.float32)),
+                v_scale=put_kv(jnp.ones(sshape, jnp.float32)))
         return PagedKVCache(
             k=put_kv(jnp.zeros(
                 (n_layers, n_kv, num_pages, page_size, head_dim),
@@ -821,10 +912,7 @@ class ServingEngine:
             v=put_kv(jnp.zeros(
                 (n_layers, n_kv, num_pages, page_size, head_dim),
                 cache_dtype)),
-            table=self._put(jnp.full(
-                (self.max_batch, self.max_pages_per_seq),
-                self.trash_page, jnp.int32)),
-            seq_lens=self._put(jnp.zeros((self.max_batch,), jnp.int32)),
+            table=table, seq_lens=seq_lens,
             page_size=page_size)
 
     def _build_programs(self, prefill_fn, decode_fn,
@@ -845,11 +933,18 @@ class ServingEngine:
         # compute-bound serving.  Tokens a request emits after its own
         # EOS within a chunk are discarded by the host (waste < K).
         # K=1 runs the same path as a length-1 scan.
+        # The sampler is the policy-resolved one (fused pallas argmax
+        # when kernels.fused_sampling resolved "on", the jitted XLA
+        # twin otherwise) — both emit bit-identical greedy tokens and
+        # share the categorical math, so flipping the policy can never
+        # change a served greedy stream.
+        sample = self._sample_fn
+
         def chunk_fn(params, tok, cache, keys, temps):
             def one(carry, key_k):
                 t, c = carry
                 logits, c = decode_fn(params, t, c)
-                nxt = _sample_rows(logits[:, -1], key_k, temps)
+                nxt = sample(logits[:, -1], key_k, temps)
                 return (nxt[:, None], c), nxt
 
             (_, cache), toks = jax.lax.scan(one, (tok, cache), keys)
@@ -1808,17 +1903,40 @@ class ServingEngine:
         pages → decode (dequantize cold pages) → one batched scatter
         into the target HBM pages → publish."""
         i = 0
-        pages, ks, vs = [], [], []
-        for key in g_keys:
-            names, _shapes, _dtypes = self._kv_pool.entry_meta(key)
-            take = bufs[i:i + len(names)]
-            i += len(names)
-            k, v = self._kv_pool.decode(key, take)
-            ks.append(k)
-            vs.append(v)
-            pages.append(page_map[key])
-        self._upload_promoted(pages, np.stack(ks, axis=2),
-                              np.stack(vs, axis=2))
+        if self._quant_resident:
+            # int8-resident publish: the entry's codes + scales go to
+            # the device VERBATIM — no dequantize on the host, no
+            # dense scatter, and (because decode_quantized still
+            # verifies the stored checksums first) the same corruption
+            # guarantees as the dense path
+            pages, kqs, kss, vqs, vss = [], [], [], [], []
+            for key in g_keys:
+                names, _shapes, _dtypes = self._kv_pool.entry_meta(key)
+                take = bufs[i:i + len(names)]
+                i += len(names)
+                kq, ks_, vq, vs_ = self._kv_pool.decode_quantized(
+                    key, take)
+                kqs.append(kq)
+                kss.append(ks_)
+                vqs.append(vq)
+                vss.append(vs_)
+                pages.append(page_map[key])
+            self._upload_promoted_q(
+                pages, np.stack(kqs, axis=2), np.stack(kss, axis=2),
+                np.stack(vqs, axis=2), np.stack(vss, axis=2))
+            self._c_kvt_qres_promotes.inc(len(g_keys))
+        else:
+            pages, ks, vs = [], [], []
+            for key in g_keys:
+                names, _shapes, _dtypes = self._kv_pool.entry_meta(key)
+                take = bufs[i:i + len(names)]
+                i += len(names)
+                k, v = self._kv_pool.decode(key, take)
+                ks.append(k)
+                vs.append(v)
+                pages.append(page_map[key])
+            self._upload_promoted(pages, np.stack(ks, axis=2),
+                                  np.stack(vs, axis=2))
         for key, pg in zip(g_keys, pages):
             if self.allocator.finish_promotion(pg, key):
                 self._c_pc_published.inc()
@@ -1875,7 +1993,21 @@ class ServingEngine:
                                self.cache.v[:, :, idx]))
         return np.asarray(k)[:, :, :n], np.asarray(v)[:, :, :n]
 
-    def _promote_idx(self, pages: List[int], k_host, v_host):
+    def _fetch_pages_host_q(self, pages: List[int]):
+        """Quantized-resident twin of :meth:`_fetch_pages_host`: ONE
+        device→host transfer of the int8 codes + f32 scales —
+        ``(kq [L, KV, n, ps, Dh] i8, ks [L, KV, n, ps, 1] f32, vq,
+        vs)`` — so a demotion captures the page VERBATIM (no dequant,
+        no requantize, no extra rounding)."""
+        idx, n = self._fetch_idx(pages)
+        c = self.cache
+        kq, ks, vq, vs = jax.device_get(
+            (c.k[:, :, idx], c.k_scale[:, :, idx],
+             c.v[:, :, idx], c.v_scale[:, :, idx]))
+        return (np.asarray(kq)[:, :, :n], np.asarray(ks)[:, :, :n],
+                np.asarray(vq)[:, :, :n], np.asarray(vs)[:, :, :n])
+
+    def _promote_idx(self, pages: List[int], *arrays):
         """Pad a promotion scatter to the FIXED promote group size:
         pad lanes aim one past the page array and drop (the
         ``write_token_pages`` trick), so every group — full, tail, or
@@ -1885,11 +2017,12 @@ class ServingEngine:
         idx = np.asarray(list(pages) + [self.trash_page + 1] * pad,
                          np.int32)
         if pad:
-            z = np.zeros(k_host.shape[:2] + (pad,) + k_host.shape[3:],
-                         k_host.dtype)
-            k_host = np.concatenate([k_host, z], axis=2)
-            v_host = np.concatenate([v_host, z], axis=2)
-        return jnp.asarray(idx), k_host, v_host
+            arrays = tuple(
+                np.concatenate(
+                    [a, np.zeros(a.shape[:2] + (pad,) + a.shape[3:],
+                                 a.dtype)], axis=2)
+                for a in arrays)
+        return (jnp.asarray(idx),) + tuple(arrays)
 
     def _upload_promoted(self, pages: List[int], k_host, v_host) -> None:
         """Scatter promoted payloads (``[L, KV, n, ps, Dh]``) into
@@ -1904,6 +2037,25 @@ class ServingEngine:
             v=self.cache.v.at[:, :, idx].set(
                 self._put(jnp.asarray(v_host)), mode="drop"))
 
+    def _upload_promoted_q(self, pages: List[int], kq, ks,
+                           vq, vs) -> None:
+        """Quantized-resident promote scatter: the cold entry's int8
+        codes + scales land in the device planes DIRECTLY — the dense
+        path's dequantize (host) + wide scatter never runs, which is
+        the point of ``kv_tier.quantized_resident`` (the page is also
+        4x smaller on the H2D wire than its f32 decode)."""
+        idx, kq, ks, vq, vs = self._promote_idx(pages, kq, ks, vq, vs)
+        c = self.cache
+        self.cache = c._replace(
+            k=c.k.at[:, :, idx].set(
+                self._put(jnp.asarray(kq)), mode="drop"),
+            k_scale=c.k_scale.at[:, :, idx].set(
+                self._put(jnp.asarray(ks)), mode="drop"),
+            v=c.v.at[:, :, idx].set(
+                self._put(jnp.asarray(vq)), mode="drop"),
+            v_scale=c.v_scale.at[:, :, idx].set(
+                self._put(jnp.asarray(vs)), mode="drop"))
+
     def _demote_for_evict(self, page: int, key: bytes) -> bool:
         """``PageAllocator.demote_hook``: capture an evicted warm
         page's KV to the tier pool.  A span whose payload is already
@@ -1916,8 +2068,13 @@ class ServingEngine:
             pool.touch(key)
             self._c_kvt_demoted.inc()
             return True
-        k, v = self._fetch_pages_host([page])
-        loc = pool.demote(key, k[:, :, 0], v[:, :, 0])
+        if self._quant_resident:
+            kq, ks, vq, vs = self._fetch_pages_host_q([page])
+            loc = pool.demote_prequantized(
+                key, kq[:, :, 0], ks[:, :, 0], vq[:, :, 0], vs[:, :, 0])
+        else:
+            k, v = self._fetch_pages_host([page])
+            loc = pool.demote(key, k[:, :, 0], v[:, :, 0])
         if loc is None:
             return False
         self._c_kvt_demoted.inc()
@@ -1938,23 +2095,27 @@ class ServingEngine:
         if fresh:
             # fetch in precompiled-bucket chunks: a big watermark sweep
             # over the whole warm pool must not trigger a fresh gather
-            # compile inside the serving step
+            # compile inside the serving step.  The quantized-resident
+            # fetch returns 4 component arrays (codes + scales); the
+            # dense one 2 — zip/concat handles both.
             cap = self._kvt_fetch_cap
-            kh_parts, vh_parts = [], []
+            parts = []
             for i in range(0, len(fresh), cap):
-                kc, vc = self._fetch_pages_host(
-                    [p for p, _ in fresh[i:i + cap]])
-                kh_parts.append(kc)
-                vh_parts.append(vc)
-            kh = np.concatenate(kh_parts, axis=2)
-            vh = np.concatenate(vh_parts, axis=2)
+                pg = [p for p, _ in fresh[i:i + cap]]
+                parts.append(self._fetch_pages_host_q(pg)
+                             if self._quant_resident
+                             else self._fetch_pages_host(pg))
+            bufs = tuple(np.concatenate(comp, axis=2)
+                         for comp in zip(*parts))
         at = {p: i for i, (p, _) in enumerate(fresh)}
         demoted, dropped = [], []
         for p, key in cands:
             if p in at:
                 i = at[p]
-                loc = self._kv_pool.demote(key, kh[:, :, i],
-                                           vh[:, :, i])
+                page = tuple(a[:, :, i] for a in bufs)
+                loc = (self._kv_pool.demote_prequantized(key, *page)
+                       if self._quant_resident
+                       else self._kv_pool.demote(key, *page))
             else:
                 loc = self._kv_pool.touch(key)
             (demoted if loc else dropped).append(p)
@@ -2121,9 +2282,10 @@ class ServingEngine:
         # dstpu: host-sync-ok: boundary sample fetch, one batched
         # transfer per step for every prefill completion (replaced
         # PR 7's per-slot device round-trip)
-        toks = np.asarray(_sample_rows(
+        toks = np.asarray(self._sample_fn(
             jnp.stack(rows), jnp.stack(keys), self._put(temps)))
         self._c_boundary_syncs.inc()
+        self._c_kdisp_sample.inc()
         for (b, _, _, _), tok in zip(pend, toks):
             self._append_token(b, int(tok))
 
@@ -2341,6 +2503,8 @@ class ServingEngine:
                 s.seq_len += K
             self._c_decode_steps.inc(K)
             self._c_decode_syncs.inc()
+            self._c_kdisp_paged.inc()
+            self._c_kdisp_sample.inc(K)
             # dstpu: host-sync-ok: the ONE device→host transfer per
             # decode chunk (K tokens per sync — the module contract)
             host_toks = np.asarray(out)
@@ -2434,6 +2598,7 @@ class ServingEngine:
         # sweep (accepted lengths + stop tokens for the whole batch)
         n_acc, stop = jax.device_get((n_acc_d, stop_d))
         self._c_decode_syncs.inc()
+        self._c_kdisp_paged.inc()   # the verify sweep IS a paged dispatch
         self._c_decode_steps.inc(K + 1)
         self._c_spec_sweeps.inc()
         if self._tel_on:
@@ -2643,6 +2808,7 @@ class ServingEngine:
                    else {}),
                 "quantize_cold": self.kv_tier.quantize_cold
                 if self._kvt_on else False,
+                "quantized_resident": self._quant_resident,
                 "demoted_lifetime": al.demoted,
                 "promoted_lifetime": al.promoted,
                 "promoting_pages": len(al.promoting),
@@ -2658,6 +2824,7 @@ class ServingEngine:
                 if spec_slots else None,
             },
             "mesh": self.mesh_info(),
+            "kernels": self._kernels.as_dict(),
             "history": {
                 "enabled": self.history.enabled,
                 "series": len(self.history.series_names()),
@@ -2867,6 +3034,21 @@ def _route_zero_inference(zero_inference, family: str, params, cfg,
         quant_group_size=quant_group_size, mesh=mesh, **kw)
 
 
+def _resolve_kernels_for_builder(kernels, mesh):
+    """Resolve the serving-kernel policy for a model builder, with the
+    SAME sharding predicate the engine uses (any model/expert axis > 1
+    demotes forced pallas — the kernels read the full page table per
+    device).  The returned :class:`~deepspeed_tpu.inference.kernels.
+    ServingKernelPolicy` is baked into the forward closures AND passed
+    through as the engine's ``kernels`` kwarg, so there is exactly one
+    resolution per build."""
+    active = mesh is not None and any(
+        mesh.size(ax) > 1 for ax in ("model", "expert"))
+    return resolve_serving_kernels(
+        kernels, tp=active,
+        interpret=jax.default_backend() != "tpu")
+
+
 def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
                          quant_group_size: int = 128, mesh=None,
                          zero_inference=None, **kw) -> ServingEngine:
@@ -2900,13 +3082,21 @@ def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
     # mutable ambient mesh on a later retrace (a cleared/replaced global
     # would silently re-enable pallas kernels over the sharded cache)
     tp = mesh is not None and mesh.size("model") > 1
+    # the kernel policy resolves HERE too (config + env, once) and the
+    # same ServingKernelPolicy passes through to the engine, so the
+    # paged_kernel the closures bake and the policy /statusz reports
+    # are one object, not two resolutions that could drift
+    kw["kernels"] = _resolve_kernels_for_builder(kw.get("kernels"), mesh)
+    pk = kw["kernels"].paged_attention
 
     def step(params, tokens, cache):
-        return llama.forward_paged(params, tokens, cfg, cache, tp=tp)
+        return llama.forward_paged(params, tokens, cfg, cache, tp=tp,
+                                   paged_kernel=pk)
 
     def chunk_step(params, tokens, cache):
         return llama.forward_paged(params, tokens, cfg, cache,
-                                   continuation=True, tp=tp)
+                                   continuation=True, tp=tp,
+                                   paged_kernel=pk)
 
     if weight_dtype != "bfloat16":
         from deepspeed_tpu.inference.quantized import quantize_for_inference
@@ -2959,13 +3149,17 @@ def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
             f"num_experts {cfg.num_experts} not divisible by "
             f"expert-axis size {mesh.size('expert')}")
 
+    kw["kernels"] = _resolve_kernels_for_builder(kw.get("kernels"), mesh)
+    pk = kw["kernels"].paged_attention
+
     def step(params, tokens, cache):
         return mixtral.forward_paged(params, tokens, cfg, cache,
-                                     tp=sharded)
+                                     tp=sharded, paged_kernel=pk)
 
     def chunk_step(params, tokens, cache):
         return mixtral.forward_paged(params, tokens, cfg, cache,
-                                     continuation=True, tp=sharded)
+                                     continuation=True, tp=sharded,
+                                     paged_kernel=pk)
 
     if weight_dtype != "bfloat16":
         from deepspeed_tpu.inference.quantized import quantize_for_inference
@@ -3013,12 +3207,17 @@ def gpt2_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
             f"max_seq {max_seq} exceeds the learned position table "
             f"(cfg.max_seq_len={cfg.max_seq_len})")
 
+    kw["kernels"] = _resolve_kernels_for_builder(kw.get("kernels"), mesh)
+    pk = kw["kernels"].paged_attention
+
     def step(params, tokens, cache):
-        return gpt2.forward_paged(params, tokens, cfg, cache, tp=tp)
+        return gpt2.forward_paged(params, tokens, cfg, cache, tp=tp,
+                                  paged_kernel=pk)
 
     def chunk_step(params, tokens, cache):
         return gpt2.forward_paged(params, tokens, cfg, cache,
-                                  continuation=True, tp=tp)
+                                  continuation=True, tp=tp,
+                                  paged_kernel=pk)
 
     if weight_dtype != "bfloat16":
         from deepspeed_tpu.inference.quantized import quantize_for_inference
@@ -3086,6 +3285,20 @@ def serving_engine(params, cfg, **kw):
     kw.pop("tracing", None)
     kw.pop("history", None)
     kw.pop("incidents", None)
+    kn = kw.pop("kernels", None)
+    if kn is not None:
+        from deepspeed_tpu.config import KernelsConfig
+
+        k = KernelsConfig.coerce(kn)
+        if k.paged_attention != "auto" or k.fused_sampling != "auto":
+            # the kernels block names paged-attention/sampling
+            # dispatches; encoder engines have neither a paged cache
+            # nor a decode sampler — fail loudly, never silently
+            # serve a different kernel than the one pinned
+            raise NotImplementedError(
+                f"the kernels block pins paged-KV decode kernels, "
+                f"which {type(cfg).__name__} does not serve — "
+                "supported: LlamaConfig, MixtralConfig, GPT2Config")
     sp = kw.pop("speculative", None)
     kw.pop("drafter", None)
     if sp is not None and SpeculativeConfig.coerce(sp).enabled:

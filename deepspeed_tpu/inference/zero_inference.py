@@ -72,10 +72,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.config import ZeroInferenceConfig
+from deepspeed_tpu.config import KVTierConfig, ZeroInferenceConfig
 from deepspeed_tpu.infinity import _NvmeTier, _RamTier
 from deepspeed_tpu.inference.kernels import PagedKVCache
-from deepspeed_tpu.inference.serving import ServingEngine, _sample_rows
+from deepspeed_tpu.inference.serving import (ServingEngine,
+                                             _resolve_kernels_for_builder)
 from deepspeed_tpu.param_stream import TierLayerReader
 from deepspeed_tpu.utils.logging import logger
 
@@ -147,6 +148,17 @@ class ZeroInferenceServingEngine(ServingEngine):
                  stem_specs=None, head_specs=None, layer_specs=None,
                  **kw):
         self._zi = zi
+        kvt = KVTierConfig.coerce(kw.get("kv_tier"))
+        if kvt.enabled and kvt.quantized_resident:
+            # the streamed engine's cache is a per-layer TUPLE of dense
+            # pages (block programs donate one layer in place); it has
+            # no int8 code/scale planes to publish into — fail loudly,
+            # never silently serve dense pages under a quantized-
+            # resident config
+            raise NotImplementedError(
+                "kv_tier.quantized_resident is not wired for the "
+                "weight-streamed (zero_inference) engine — serve "
+                "resident, or drop quantized_resident")
         self._stem_fn, self._block_fn, self._head_fn = fns
         self._layer_specs = layer_specs
         self._stem_specs = stem_specs
@@ -448,7 +460,10 @@ class ZeroInferenceServingEngine(ServingEngine):
             x = self._run_blocks("decode", x, cos, sin, k_list, v_list,
                                  cache.table, start)
             logits = self._head_jit(self._head_dev, x)
-            nxt = _sample_rows(logits[:, -1], keys[j], temps)
+            # the policy-resolved sampler (base ctor): the fused pallas
+            # argmax when kernels.fused_sampling resolved "on", the
+            # jitted XLA twin otherwise — bit-identical greedy tokens
+            nxt = self._sample_fn(logits[:, -1], keys[j], temps)
             cols.append(nxt)
             tok = nxt[:, None]
         cache = cache._replace(k=tuple(k_list), v=tuple(v_list),
@@ -670,6 +685,11 @@ def zero_inference_serving_engine(params, cfg, zi, *, family: str,
     tp = mesh is not None and mesh.size("model") > 1
     sharded = mesh is not None and any(
         mesh.size(ax) > 1 for ax in ("model", "expert"))
+    # one kernel-policy resolution per build, like the resident
+    # builders: the per-layer block programs bake the resolved
+    # paged_kernel and the engine reports the same policy in /statusz
+    kw["kernels"] = _resolve_kernels_for_builder(kw.get("kernels"), mesh)
+    pk = kw["kernels"].paged_attention
     if family == "mixtral":
         from deepspeed_tpu.models import mixtral as fam
 
@@ -677,11 +697,11 @@ def zero_inference_serving_engine(params, cfg, zi, *, family: str,
             raise ValueError(
                 f"num_experts {cfg.num_experts} not divisible by "
                 f"expert-axis size {mesh.size('expert')}")
-        fns = fam.paged_layered_fns(cfg, tp=sharded)
+        fns = fam.paged_layered_fns(cfg, tp=sharded, paged_kernel=pk)
     else:
         from deepspeed_tpu.models import llama as fam
 
-        fns = fam.paged_layered_fns(cfg, tp=tp)
+        fns = fam.paged_layered_fns(cfg, tp=tp, paged_kernel=pk)
 
     stem = {"embed": params["embed"]}
     head = {"final_norm": params["final_norm"]}

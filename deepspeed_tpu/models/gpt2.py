@@ -190,7 +190,8 @@ def loss_fn(cfg: GPT2Config):
 
 
 def forward_paged(params, tokens, cfg: GPT2Config, cache,
-                  interpret=None, continuation: bool = False, tp=None):
+                  interpret=None, continuation: bool = False, tp=None,
+                  paged_kernel=None):
     """Paged-KV forward for continuous-batching serving (ref: the
     reference's GPT-2 kernel-injection container,
     deepspeed/module_inject/containers/gpt2.py — GPT-2 is served through
@@ -224,29 +225,47 @@ def forward_paged(params, tokens, cfg: GPT2Config, cache,
     positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
     x = params["wte"][tokens] + params["wpe"][positions]
 
+    quant = cache.k_scale is not None
+    if paged_kernel in (None, "auto"):
+        paged_kernel = ("pallas_v2" if pallas_paged_gate(
+            B, nh, hd, ps, cache.table.shape[1], cache.k.dtype.itemsize,
+            interpret, tp) else "xla")
+
     def block(x, layer):
-        lp, kp, vp = layer
+        if quant:
+            lp, kp, vp, kps, vps = layer
+        else:
+            lp, kp, vp = layer
+            kps = vps = None
         h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.norm_eps)
         qkv = h @ lp["qkv_w"] + lp["qkv_b"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, nh, hd)
         k = k.reshape(B, T, nh, hd)
         v = v.reshape(B, T, nh, hd)
-        use_pallas = pallas_paged_gate(
-            B, nh, hd, ps, cache.table.shape[1], kp.dtype.itemsize,
-            interpret, tp)
-        attn, kp, vp = paged_attention_step(
+        attn, kp, vp, kps, vps = paged_attention_step(
             q, k, v, kp, vp, cache.table, start, ps,
             continuation=continuation, prefill=prefill,
-            use_pallas=use_pallas, flash_force_reference=tp)
+            paged_kernel=paged_kernel, flash_force_reference=tp,
+            interpret=interpret, kps=kps, vps=vps)
         x = x + attn.reshape(B, T, d) @ lp["proj_w"] + lp["proj_b"]
         h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.norm_eps)
         h = jax.nn.gelu(h @ lp["fc_w"] + lp["fc_b"], approximate=True)
-        return x + h @ lp["out_w"] + lp["out_b"], (kp, vp)
+        return (x + h @ lp["out_w"] + lp["out_b"],
+                (kp, vp, kps, vps) if quant else (kp, vp))
 
-    x, (new_k, new_v) = jax.lax.scan(block, x,
-                                     (params["blocks"], cache.k, cache.v))
+    if quant:
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            block, x, (params["blocks"], cache.k, cache.v,
+                       cache.k_scale, cache.v_scale))
+    else:
+        x, (new_k, new_v) = jax.lax.scan(
+            block, x, (params["blocks"], cache.k, cache.v))
+        new_ks = new_vs = None
     x = layer_norm(x, params["lnf_w"], params["lnf_b"], cfg.norm_eps)
     logits = jnp.einsum("btd,vd->btv", x, params["wte"],
                         preferred_element_type=jnp.float32)
-    return logits, cache._replace(k=new_k, v=new_v, seq_lens=start + T)
+    cache = cache._replace(k=new_k, v=new_v, seq_lens=start + T)
+    if quant:
+        cache = cache._replace(k_scale=new_ks, v_scale=new_vs)
+    return logits, cache

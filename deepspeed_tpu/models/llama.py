@@ -382,7 +382,8 @@ def forward_with_cache(params, tokens, cfg: LlamaConfig, cache):
 def forward_paged(params, tokens, cfg: LlamaConfig, cache,
                   interpret: Optional[bool] = None,
                   continuation: bool = False, ffn=None,
-                  tp: Optional[bool] = None):
+                  tp: Optional[bool] = None,
+                  paged_kernel: Optional[str] = None):
     """Forward over a paged KV cache (ref: the reference's inference
     kernels' workspace contract, modernised to vLLM-style page tables).
 
@@ -409,6 +410,15 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
     speculative verify depends on it to score a K+1-token draft window
     in one sweep (custom ``chunk_prefill_fn`` replacements must honor
     this; see MIGRATION.md).
+
+    ``paged_kernel``: the RESOLVED paged-attention dispatch ("xla" |
+    "pallas_v1" | "pallas_v2") baked in by the serving build
+    (``resolve_serving_kernels``); None/"auto" takes the shape-measured
+    gate (``pallas_paged_gate``).  A cache carrying ``k_scale`` planes
+    is int8-resident (``kv_tier.quantized_resident``): writes quantize
+    per token row on device and attention dequantizes in VMEM
+    ("pallas_v2") or via :func:`~deepspeed_tpu.inference.kernels.
+    dequantize_pages` ("xla").
     """
     from deepspeed_tpu.inference.kernels import (paged_attention_step,
                                                  pallas_paged_gate)
@@ -426,39 +436,56 @@ def forward_paged(params, tokens, cfg: LlamaConfig, cache,
     positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None]
     cos, sin = rope_tables(cfg, positions)
 
+    quant = cache.k_scale is not None      # int8-resident KV (static)
+    if paged_kernel in (None, "auto"):
+        # no engine policy passed: the shape-measured auto gate decides
+        paged_kernel = "pallas_v2" if pallas_paged_gate(
+            B, nkv, hd, ps, cache.table.shape[1],
+            cache.k.dtype.itemsize, interpret, tp_active) else "xla"
+
     def block(x, layer):
-        lp, kp, vp = layer
+        if quant:
+            lp, kp, vp, kps, vps = layer
+        else:
+            lp, kp, vp = layer
+            kps = vps = None
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = (h @ lp["wq"]).reshape(B, T, nh, hd)
         k = (h @ lp["wk"]).reshape(B, T, nkv, hd)
         v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        use_pallas = pallas_paged_gate(
-            B, nkv, hd, ps, cache.table.shape[1], kp.dtype.itemsize,
-            interpret, tp_active)
-        attn, kp, vp = paged_attention_step(
+        attn, kp, vp, kps, vps = paged_attention_step(
             q, k, v, kp, vp, cache.table, start, ps,
             continuation=continuation, prefill=prefill,
-            use_pallas=use_pallas, flash_force_reference=tp_active)
+            paged_kernel=paged_kernel, flash_force_reference=tp_active,
+            interpret=interpret, kps=kps, vps=vps)
         x = x + attn.reshape(B, T, nh * hd) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         x = x + (swiglu(h, lp["w1"], lp["w3"]) @ lp["w2"]
                  if ffn is None else ffn(lp, h))
-        return x, (kp, vp)
+        return x, ((kp, vp, kps, vps) if quant else (kp, vp))
 
-    x, (new_k, new_v) = jax.lax.scan(block, x,
-                                     (params["blocks"], cache.k, cache.v))
+    if quant:
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            block, x, (params["blocks"], cache.k, cache.v,
+                       cache.k_scale, cache.v_scale))
+    else:
+        x, (new_k, new_v) = jax.lax.scan(
+            block, x, (params["blocks"], cache.k, cache.v))
+        new_ks, new_vs = cache.k_scale, cache.v_scale
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,dv->btv", x, head,
                         preferred_element_type=jnp.float32)
-    cache = cache._replace(k=new_k, v=new_v, seq_lens=start + T)
+    cache = cache._replace(k=new_k, v=new_v, seq_lens=start + T,
+                           k_scale=new_ks, v_scale=new_vs)
     return logits, cache
 
 
 def paged_layered_fns(cfg: LlamaConfig, tp: bool = False, ffn=None,
-                      interpret: Optional[bool] = None):
+                      interpret: Optional[bool] = None,
+                      paged_kernel: Optional[str] = None):
     """Per-layer factoring of :func:`forward_paged` for weight-streamed
     (ZeRO-Inference) serving — the serving twin of :func:`layered_model`:
     stem (embedding + rope tables) and head (final norm + LM head) stay
@@ -506,12 +533,16 @@ def paged_layered_fns(cfg: LlamaConfig, tp: bool = False, ffn=None,
         v = (h @ lp["wv"]).reshape(B, T, nkv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        use_pallas = pallas_paged_gate(
-            B, nkv, hd, ps, table.shape[1], kp.dtype.itemsize, itp, tp)
-        attn, kp, vp = paged_attention_step(
+        if paged_kernel in (None, "auto"):
+            pk = "pallas_v2" if pallas_paged_gate(
+                B, nkv, hd, ps, table.shape[1], kp.dtype.itemsize,
+                itp, tp) else "xla"
+        else:
+            pk = paged_kernel
+        attn, kp, vp, _, _ = paged_attention_step(
             q, k, v, kp, vp, table, start, ps,
             continuation=continuation, prefill=prefill,
-            use_pallas=use_pallas, flash_force_reference=tp)
+            paged_kernel=pk, flash_force_reference=tp, interpret=itp)
         x = x + attn.reshape(B, T, nh * hd) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         x = x + (swiglu(h, lp["w1"], lp["w3"]) @ lp["w2"]

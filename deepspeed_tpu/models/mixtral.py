@@ -376,7 +376,7 @@ def layered_model(cfg: MixtralConfig, params):
 
 def forward_paged(params, tokens, cfg: MixtralConfig, cache,
                   interpret=None, continuation: bool = False,
-                  tp=None):
+                  tp=None, paged_kernel=None):
     """Paged-KV MoE forward for continuous-batching serving (ref:
     DeepSpeed-MoE inference — the reference SERVES MoE models through its
     inference engine, it does not just eval them; deepspeed/inference/
@@ -392,12 +392,12 @@ def forward_paged(params, tokens, cfg: MixtralConfig, cache,
     (logits [B, T, V] f32, cache)."""
     return _llama.forward_paged(
         params, tokens, cfg.llama_view(), cache, interpret=interpret,
-        continuation=continuation, tp=tp,
+        continuation=continuation, tp=tp, paged_kernel=paged_kernel,
         ffn=lambda lp, h: _moe_ffn_dense(cfg, h, lp))
 
 
 def paged_layered_fns(cfg: MixtralConfig, tp: bool = False,
-                      interpret=None):
+                      interpret=None, paged_kernel=None):
     """Per-layer factoring of :func:`forward_paged` for weight-streamed
     (ZeRO-Inference) MoE serving — llama's paged-attention backbone with
     the capacity-free dense top-k expert combine as the FFN, one program
@@ -406,6 +406,7 @@ def paged_layered_fns(cfg: MixtralConfig, tp: bool = False,
     inside each block program (the gate is never quantized)."""
     return _llama.paged_layered_fns(
         cfg.llama_view(), tp=tp, interpret=interpret,
+        paged_kernel=paged_kernel,
         ffn=lambda lp, h: _moe_ffn_dense(cfg, h, lp))
 
 

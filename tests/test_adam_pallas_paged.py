@@ -285,28 +285,33 @@ class TestPagedChunkAttention:
 
 
 class TestPagedGatePolicy:
-    """Pin the measured dispatch policy (KERNEL_BENCH.json r5, v5e): the
-    XLA gather paths win at every tested decode shape, so the pallas
-    paged kernels are opt-in only."""
+    """Pin the measured dispatch policy (KERNEL_BENCH.json
+    paged_v2_vs_xla sweep): v2 wins once the live KV footprint clears
+    the DMA-amortization crossover (_PAGED_V2_MIN_KV_BYTES); below it
+    the XLA gather wins.  The gate is pure shape math — env overrides
+    live in resolve_serving_kernels, resolved once at engine build."""
 
-    def test_default_is_gather_everywhere(self, monkeypatch):
-        from deepspeed_tpu.inference.kernels import pallas_paged_gate
+    def test_crossover_both_sides(self, monkeypatch):
+        from deepspeed_tpu.inference.kernels import (
+            _PAGED_V2_MIN_KV_BYTES, pallas_paged_gate)
 
-        monkeypatch.delenv("DSTPU_FORCE_PAGED_PALLAS", raising=False)
-        # the shape class the old transient-size heuristic routed to
-        # pallas (B=16 H=32 seq=4096 — measured 25x SLOWER on chip)
-        assert not pallas_paged_gate(16, 8, 128, 16, 288, 2,
-                                     interpret=False, tp=False)
-        assert not pallas_paged_gate(8, 4, 128, 16, 128, 2,
-                                     interpret=False, tp=False)
-
-    def test_env_opt_in(self, monkeypatch):
-        from deepspeed_tpu.inference.kernels import pallas_paged_gate
-
+        # env must NOT leak into the gate (trace-time reads removed)
         monkeypatch.setenv("DSTPU_FORCE_PAGED_PALLAS", "1")
+        # 16x8 heads, 288 pages x 16 x 128 @ bf16 = 302MB live KV ≥ 256MB
         assert pallas_paged_gate(16, 8, 128, 16, 288, 2,
                                  interpret=False, tp=False)
-        # interpret / TP still force the XLA reference paths
+        # 8x4 heads, 128 pages = 32MB — gather wins below the crossover
+        assert not pallas_paged_gate(8, 4, 128, 16, 128, 2,
+                                     interpret=False, tp=False)
+        # the boundary is exactly the committed crossover constant
+        kv_bytes = 2 * 16 * 8 * 288 * 16 * 128 * 2
+        assert kv_bytes >= _PAGED_V2_MIN_KV_BYTES > 2 * 8 * 4 * 128 * 16 * 128 * 2
+
+    def test_interpret_and_tp_force_reference(self):
+        from deepspeed_tpu.inference.kernels import pallas_paged_gate
+
+        # interpret / TP always force the XLA reference paths, even
+        # above the crossover (no TPU grid on CPU; KV heads sharded)
         assert not pallas_paged_gate(16, 8, 128, 16, 288, 2,
                                      interpret=True, tp=False)
         assert not pallas_paged_gate(16, 8, 128, 16, 288, 2,

@@ -18,6 +18,7 @@ serializes on-device, so (sum of N dispatches)/N is honest kernel time.
 
 import argparse
 import functools
+import json
 import os
 import sys
 import time
@@ -296,6 +297,130 @@ def chunk_v2_sweep(configs, iters):
     return rows
 
 
+def paged_v2_vs_xla(configs, iters):
+    """The crossover sweep behind ``pallas_paged_gate``: per decode
+    shape, the live-KV footprint, the gate's auto verdict at that
+    shape, the XLA gather time, and the FORCED-ON v2 arms (dense and
+    int8-dequant-fused) — per-kernel rows, so a chip re-stamp can move
+    ``_PAGED_V2_MIN_KV_BYTES`` with data instead of folklore.
+
+    Off-chip (CPU) the kernels only run in interpret mode, which
+    measures the interpreter, not the kernel — so a CPU stamp records
+    the gate verdicts plus interpret-mode IDENTITY errors (the
+    correctness half of the contract) and leaves the timing columns to
+    a TPU run.  Rows carry ``backend`` so the two never mix."""
+    from deepspeed_tpu.inference.kernels import (
+        _PAGED_V2_MIN_KV_BYTES, dequantize_pages,
+        paged_attention_reference, paged_decode_attention_v2,
+        paged_decode_attention_v2_quant, pallas_paged_gate,
+        quantize_kv_rows)
+
+    on_tpu = jax.default_backend() == "tpu"
+    rows = []
+    for (B, H, KV, Dh, ps, pages, seq) in configs:
+        q, kp, vp, table, lens = _paged_inputs(B, H, KV, Dh, ps, pages,
+                                               seq)
+        mp = table.shape[1]
+        live_kv = 2 * B * KV * mp * ps * Dh * kp.dtype.itemsize
+        kq, ks = quantize_kv_rows(kp)
+        vq, vs = quantize_kv_rows(vp)
+        row = {
+            "backend": jax.default_backend(),
+            "shape": {"B": B, "H": H, "KV": KV, "Dh": Dh, "page": ps,
+                      "pages": pages, "seq": seq},
+            "live_kv_mb": round(live_kv / (1 << 20), 1),
+            "gate_auto_pallas": pallas_paged_gate(
+                B, KV, Dh, ps, mp, kp.dtype.itemsize,
+                interpret=False, tp=False),
+            "crossover_mb": round(_PAGED_V2_MIN_KV_BYTES / (1 << 20)),
+        }
+        if on_tpu:
+            tr = bench(jax.jit(paged_attention_reference),
+                       q, kp, vp, table, lens, iters=iters)
+            row["xla_ms"] = round(1e3 * tr, 3)
+            try:
+                t2 = bench(jax.jit(paged_decode_attention_v2),
+                           q, kp, vp, table, lens, iters=iters)
+                row["v2_ms"] = round(1e3 * t2, 3)
+                row["v2_vs_xla"] = round(tr / t2, 2)
+                tq = bench(jax.jit(paged_decode_attention_v2_quant),
+                           q, kq, ks, vq, vs, table, lens, iters=iters)
+                row["v2_quant_ms"] = round(1e3 * tq, 3)
+                row["v2_quant_vs_xla"] = round(tr / tq, 2)
+            except Exception as e:   # Mosaic lowering risk: record
+                row["error"] = str(e)[:160]
+        else:
+            # interpret-mode identity arms (the CPU stamp's content):
+            # dense v2 vs the gather, quant v2 vs the reference over
+            # host-dequantized pages — both must sit at float noise
+            ref = paged_attention_reference(q, kp, vp, table, lens)
+            got = paged_decode_attention_v2(q, kp, vp, table, lens,
+                                            interpret=True)
+            row["v2_max_abs_diff"] = float(
+                jnp.max(jnp.abs(got.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+            qref = paged_attention_reference(
+                q, dequantize_pages(kq, ks, kp.dtype),
+                dequantize_pages(vq, vs, vp.dtype), table, lens)
+            qgot = paged_decode_attention_v2_quant(
+                q, kq, ks, vq, vs, table, lens, interpret=True)
+            row["v2_quant_max_abs_diff"] = float(
+                jnp.max(jnp.abs(qgot.astype(jnp.float32)
+                                - qref.astype(jnp.float32))))
+            row["note"] = ("cpu interpret stamp: identity only — "
+                           "timings need a chip re-stamp")
+        rows.append(row)
+        print("paged_v2_vs_xla", row, flush=True)
+    return rows
+
+
+def fused_sample_vs_xla(shapes, iters):
+    """The crossover sweep behind ``pallas_sample_gate``: per (batch,
+    vocab) serving shape, rows × vocab, the gate's auto verdict, the
+    jitted XLA sampler time, and the FORCED-ON fused kernel arm.  On
+    CPU (interpret) the row records the greedy identity mismatch count
+    instead of timing — the bit-exactness the serving gates rely on."""
+    from deepspeed_tpu.inference.serving import _sample_rows
+    from deepspeed_tpu.ops.sampling_pallas import (
+        _FUSED_SAMPLE_MIN_ROWS_X_VOCAB, fused_sample_rows,
+        pallas_sample_gate)
+
+    on_tpu = jax.default_backend() == "tpu"
+    rows = []
+    for (B, V) in shapes:
+        logits = jax.random.normal(jax.random.PRNGKey(B), (B, V),
+                                   jnp.float32)
+        keys = jax.random.split(jax.random.PRNGKey(7), B)
+        temps = jnp.zeros((B,))          # the greedy serving case
+        row = {
+            "backend": jax.default_backend(),
+            "shape": {"B": B, "V": V}, "rows_x_vocab": B * V,
+            "gate_auto_fused": pallas_sample_gate(B, V,
+                                                  interpret=False),
+            "crossover_rows_x_vocab": _FUSED_SAMPLE_MIN_ROWS_X_VOCAB,
+        }
+        if on_tpu:
+            tx = bench(_sample_rows, logits, keys, temps, iters=iters)
+            row["xla_ms"] = round(1e3 * tx, 3)
+            try:
+                tf = bench(fused_sample_rows, logits, keys, temps,
+                           iters=iters)
+                row["fused_ms"] = round(1e3 * tf, 3)
+                row["fused_vs_xla"] = round(tx / tf, 2)
+            except Exception as e:
+                row["error"] = str(e)[:160]
+        else:
+            want = _sample_rows(logits, keys, temps)
+            got = fused_sample_rows(logits, keys, temps,
+                                    interpret=True)
+            row["greedy_mismatches"] = int(jnp.sum(want != got))
+            row["note"] = ("cpu interpret stamp: identity only — "
+                           "timings need a chip re-stamp")
+        rows.append(row)
+        print("fused_sample_vs_xla", row, flush=True)
+    return rows
+
+
 def flash_packed_sweep(shapes, iters):
     """Packed-sequence flash attention (segment_ids) vs the masked XLA
     reference — first on-chip validation of the segment kernels' Mosaic
@@ -404,14 +529,42 @@ def main():
     chunk_cfgs = [(8, 16, 16, 4, 128, 16, 512, 1024),
                   (8, 64, 16, 4, 128, 16, 512, 1024),
                   (4, 64, 16, 4, 128, 16, 2048, 8192)]
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # the shapes that bracket the serving-gate crossovers: one
+        # decode shape below _PAGED_V2_MIN_KV_BYTES, one above; one
+        # (B, V) below _FUSED_SAMPLE_MIN_ROWS_X_VOCAB, one above
+        gate_paged_cfgs = [(8, 16, 4, 128, 16, 512, 1024),
+                           (16, 32, 8, 128, 16, 4608, 4096)]
+        gate_sample_shapes = [(8, 32000), (256, 128256)]
+    else:
+        # CPU interpret stamps: identity only, so tiny shapes — the
+        # rows record gate verdicts + max-abs-diff, never timings
+        gate_paged_cfgs = [(2, 4, 2, 32, 8, 16, 48)]
+        gate_sample_shapes = [(4, 512), (8, 1024)]
     if args.quick:
         attn_shapes, adam_sizes = attn_shapes[:1], adam_sizes[:1]
         paged_cfgs, chunk_cfgs = paged_cfgs[:1], chunk_cfgs[:1]
 
     # incremental commit after every sweep family: a tunnel that wedges
     # mid-run (round-5: it dropped 13 min into the window) must not
-    # cost the families that DID complete
+    # cost the families that DID complete.  MERGE semantics: seed from
+    # the committed file so a --families subset run (e.g. the CPU slow
+    # lane stamping only the gate sweeps) cannot clobber TPU rows that
+    # this box can't reproduce.
     result = {"backend": jax.default_backend(), "partial": True}
+    if os.path.exists(args.json_out):
+        try:
+            with open(args.json_out) as f:
+                prior = json.load(f)
+            prior.pop("partial", None)
+            # keep the prior top-level backend: it labels the families
+            # this run does NOT re-stamp; new rows carry their own
+            prior.setdefault("backend", jax.default_backend())
+            result = dict(prior, partial=True)
+        except (OSError, ValueError) as e:
+            print(f"note: not merging {args.json_out}: {e}",
+                  file=sys.stderr)
     sweeps = [
         ("flash_vs_xla", lambda: flash_vs_ref(attn_shapes, iters)),
         ("adam_pallas_vs_xla", lambda: adam_vs_xla(adam_sizes, iters)),
@@ -423,6 +576,10 @@ def main():
         ("chunk_prefill_v2", lambda: chunk_v2_sweep(chunk_cfgs, iters)),
         ("flash_packed", lambda: flash_packed_sweep(attn_shapes[:1], iters)),
         ("flash_block_sweep", lambda: block_sweep(iters)),
+        ("paged_v2_vs_xla", lambda: paged_v2_vs_xla(gate_paged_cfgs,
+                                                    iters)),
+        ("fused_sample_vs_xla",
+         lambda: fused_sample_vs_xla(gate_sample_shapes, iters)),
     ]
     picked = [s for s in args.families.split(",") if s]
     if picked:
