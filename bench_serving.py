@@ -204,6 +204,12 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
         config["kv_tier"] = kv_tier
     if kernels is not None:
         config["kernels"] = kernels
+    # device-truth observability rides every row: the compile sentinel
+    # proves the steady-state run never recompiled (bench_gate pins
+    # detail.devprof.steady_state_compiles at 0) and MFU/MBU land next
+    # to tokens/s.  A modest sample rate keeps the sampled
+    # block_until_ready syncs out of the throughput signal
+    config["devprof"] = {"sample_rate": 0.05}
     # SLO classification rides every row (--slo-ttft-ms 0 disables):
     # the same engine that reports tokens/s reports how many of those
     # tokens came from requests that met their latency objective —
@@ -341,6 +347,10 @@ def measure_config(name, args, params, mod, cfg, phase, prompts,
         # the policy this engine's compiled programs actually baked
         # (same object /statusz reports — resolved once at build)
         row["detail"]["kernels"] = engine._kernels.as_dict()
+    # compile ledger + roofline for this row: steady_state_compiles
+    # is the zero-recompile contract (gated at exactly 0), MFU/MBU are
+    # the device-truth utilization next to the tokens/s headline
+    row["detail"]["devprof"] = engine.statusz().get("devprof", {})
     ttft = snap["histograms"].get("serving_ttft_seconds", {})
     d_count = int(ttft.get("count", 0)) - int(ttft0.get("count", 0))
     if d_count > 0:

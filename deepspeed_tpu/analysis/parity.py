@@ -54,6 +54,7 @@ CONFIG_BLOCKS = {
     "TracingConfig": "tracing",
     "HistoryConfig": "history",
     "IncidentsConfig": "incidents",
+    "DevprofConfig": "devprof",
     "MeshConfig": "mesh",
 }
 
@@ -64,6 +65,7 @@ METRIC_FAMILIES = (
     "serving_", "prefix_cache_", "spec_", "kv_tier_", "slo_",
     "fleet_", "autoscale_", "zi_", "pstream_", "aio_",
     "tier_reader_", "comm_", "infinity_", "history_", "incident_",
+    "devprof_",
 )
 # bench-evidence JSON namespaces and row labels that share a family
 # prefix but are not registry metrics (cited next to the metrics in

@@ -1265,6 +1265,73 @@ class IncidentsConfig:
 
 
 @dataclasses.dataclass
+class DevprofConfig:
+    """Device-truth observability block (no reference analogue; the
+    fifth observability pillar next to ``telemetry``/``tracing``/
+    ``history``/``incidents`` — see :mod:`deepspeed_tpu.devprof`).
+
+    Three coupled capabilities: a **compile sentinel** (every XLA
+    compile attributed to a call-site ledger, split warmup vs
+    steady-state — a steady-state recompile is a contract violation
+    and trips an incident), **per-phase device-time attribution**
+    (sampled ``block_until_ready`` deltas on a ``sample_rate``
+    cadence feeding ``devprof_device_seconds{phase}`` counters plus a
+    host-vs-device gap gauge), and **roofline accounting** (one-time
+    ``cost_analysis`` of the compiled sweep programs at engine build
+    combined with sampled device time into live MFU/MBU gauges).
+    ``sample_rate`` thins PER DISPATCH deterministically (0.05 times
+    one dispatch in 20 per phase; 0 disables the sync entirely);
+    ``capture_max_s`` caps on-demand ``/profilez?capture_s=`` device
+    traces (written under ``tracing.dump_dir``); ``cost_analysis``
+    gates the build-time roofline pass (the only part that touches
+    XLA's cost model).
+    """
+
+    enabled: bool = False
+    sample_rate: float = 0.05            # per-dispatch; 0 = no syncs
+    capture_max_s: float = 10.0          # /profilez duration cap
+    cost_analysis: bool = True           # roofline pass at build
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DevprofConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        c = cls(**{k: v for k, v in d.items() if k in known})
+        c.sample_rate = float(c.sample_rate)
+        c.capture_max_s = float(c.capture_max_s)
+        c.cost_analysis = bool(c.cost_analysis)
+        if not 0.0 <= c.sample_rate <= 1.0:
+            raise ValueError(
+                f"devprof.sample_rate must be in [0, 1], got "
+                f"{c.sample_rate}")
+        if c.capture_max_s <= 0:
+            raise ValueError(
+                f"devprof.capture_max_s must be positive, got "
+                f"{c.capture_max_s}")
+        return c
+
+    @classmethod
+    def coerce(cls, obj) -> "DevprofConfig":
+        """Accept None (disabled), a bool, a dict (writing the block is
+        the opt-in, like ``history``), or a DevprofConfig."""
+        if obj is None:
+            return cls(enabled=False)
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, bool):
+            return cls.from_dict({"enabled": obj}) if obj \
+                else cls(enabled=False)
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("enabled", True)   # passing a block opts in
+            if not d["enabled"]:
+                return cls(enabled=False)
+            return cls.from_dict(d)
+        raise TypeError(
+            f"devprof must be a bool, dict or DevprofConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class PrecisionConfig:
     """ref: deepspeed/runtime/fp16/loss_scaler.py + config fp16/bf16 blocks."""
 
@@ -1432,6 +1499,8 @@ class Config:
         default_factory=HistoryConfig)
     incidents: IncidentsConfig = dataclasses.field(
         default_factory=IncidentsConfig)
+    devprof: DevprofConfig = dataclasses.field(
+        default_factory=DevprofConfig)
     raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     # ---------------------------------------------------------------- parse
@@ -1585,6 +1654,9 @@ class Config:
         if "incidents" in d:
             # coerce, not from_dict: writing the block IS the opt-in
             c.incidents = IncidentsConfig.coerce(d["incidents"])
+        if "devprof" in d:
+            # coerce, not from_dict: writing the block IS the opt-in
+            c.devprof = DevprofConfig.coerce(d["devprof"])
         return c
 
     @classmethod

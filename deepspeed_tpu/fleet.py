@@ -1684,7 +1684,11 @@ def fleet_router(params, cfg, *, fleet=None, telemetry=None,
     block, ``True``, or a pre-built :class:`~deepspeed_tpu.kv_fabric.
     KVFabric`) attaches the cross-replica KV exchange to every
     replica — each then needs the ``kv_tier`` block in
-    ``engine_kw``."""
+    ``engine_kw``.  A ``devprof`` block in ``engine_kw`` rides the
+    same passthrough: every replica gets its own compile sentinel,
+    device-time counters and MFU/MBU gauges under its
+    ``dstpu_r{i}`` metric namespace — one scrape shows which replica
+    is recompiling or underutilized."""
     fc = FleetConfig.coerce(fleet)
     tracer = RequestTracer.from_config(TracingConfig.coerce(tracing))
     if isinstance(faults, FaultPlan):

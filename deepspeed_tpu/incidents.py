@@ -105,6 +105,10 @@ class IncidentManager:
         # extra trip probes: zero-arg callables returning
         # (class, attrs) on a trip, None otherwise (the watchdog feed)
         self._probes: List[Callable[[], Optional[Tuple[str, Dict]]]] = []
+        # named bundle attachments: zero-arg callables whose return
+        # value is embedded in every bundle under its name (devprof
+        # registers its compile ledger + capture references here)
+        self._attachments: Dict[str, Callable[[], Any]] = {}
         r = registry
         self._c_bundles = r.counter(
             "incident_bundles_total",
@@ -131,6 +135,13 @@ class IncidentManager:
         Probes are individually guarded — a broken probe never takes
         down the tick."""
         self._probes.append(fn)
+
+    def add_attachment(self, name: str, fn: Callable[[], Any]) -> None:
+        """Register a named bundle attachment: ``fn()`` is evaluated at
+        capture time and embedded in the bundle under ``name``.  Each
+        attachment is individually guarded — a broken one degrades to
+        an ``{"error": ...}`` stanza, never loses the bundle."""
+        self._attachments[str(name)] = fn
 
     # ---------------------------------------------------------- evaluate
     # dstpu: hot-path
@@ -278,6 +289,11 @@ class IncidentManager:
                 bundle["statusz"] = self.statusz_fn()
             except Exception as e:     # a broken snapshot must not
                 bundle["statusz"] = {"error": repr(e)}  # lose the bundle
+        for aname, afn in self._attachments.items():
+            try:
+                bundle[aname] = afn()
+            except Exception as e:     # same contract as statusz_fn
+                bundle[aname] = {"error": repr(e)}
         # source is part of the name: _seq is per-MANAGER, and a fleet-
         # level manager plus replica engine-level managers can share
         # one dir — without it their same-class bundles would collide
@@ -337,6 +353,9 @@ class _NullIncidentManager:
         pass
 
     def add_probe(self, fn):
+        pass
+
+    def add_attachment(self, name, fn):
         pass
 
     def maybe_evaluate(self, now=None):

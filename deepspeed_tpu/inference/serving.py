@@ -77,11 +77,13 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu import faults as faults_mod
-from deepspeed_tpu.config import (FaultsConfig, HistoryConfig,
+from deepspeed_tpu.config import (DevprofConfig, FaultsConfig,
+                                  HistoryConfig,
                                   IncidentsConfig, KVTierConfig,
                                   PrefixCacheConfig, SLOConfig,
                                   SpeculativeConfig, TelemetryConfig,
                                   TracingConfig)
+from deepspeed_tpu.devprof import NULL_DEVPROF, DevProf
 from deepspeed_tpu.faults import ChecksumError, FaultPlan, InjectedFault
 from deepspeed_tpu.history import NULL_HISTORY, MetricHistory
 from deepspeed_tpu.incidents import NULL_INCIDENTS, IncidentManager
@@ -260,7 +262,8 @@ class ServingEngine:
                  shed_queue_depth: int = 0,
                  shed_expired_deadline: bool = False,
                  replica_id: Optional[str] = None,
-                 history=None, incidents=None, kernels=None):
+                 history=None, incidents=None, kernels=None,
+                 devprof=None):
         # Sharded serving (ref: deepspeed/module_inject/replace_module.py
         # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
         # inference): with a mesh, params arrive pre-sharded from the
@@ -561,8 +564,19 @@ class ServingEngine:
                 "events (slo_burn_alert, kv_promote_failed, replica "
                 "failover, rollbacks) live in the flight recorder; "
                 "enable tracing (or drop the incidents block)")
+        dcfg = DevprofConfig.coerce(devprof)
+        if dcfg.enabled and not self._tel_on:
+            # validated BEFORE the exporter below, like incidents: the
+            # sentinel counters, device-time attribution and MFU/MBU
+            # gauges all live in the registry
+            raise ValueError(
+                "devprof needs the telemetry block — the compile "
+                "sentinel, device-time and roofline surfaces are "
+                "registry metrics; enable telemetry (or drop the "
+                "devprof block)")
         self.history_cfg = hcfg
         self.incidents_cfg = icfg
+        self.devprof_cfg = dcfg
         # telemetry sinks for serving loops: the exporter ticks from
         # step() (a monotonic compare until interval_s elapses)
         self._tel_exporter = None
@@ -592,6 +606,29 @@ class ServingEngine:
         if self.replica_id is not None:
             self.tracer = self.tracer.bind(replica=self.replica_id)
         self._trace_on = self.tracer.enabled
+
+        # ---- device-truth observability (see deepspeed_tpu.devprof):
+        # sentinel wrappers around the compiled sweep programs count
+        # and attribute every XLA compile (warmup vs steady-state),
+        # sampled block_until_ready deltas attribute device time per
+        # phase, and a one-time cost analysis of the programs feeds
+        # live MFU/MBU gauges.  On-demand /profilez captures land
+        # under the tracer's dump_dir.
+        self.devprof = (
+            DevProf(dcfg, registry=self.registry, tracer=self.tracer,
+                    dump_dir=getattr(self.tracer, "dump_dir",
+                                     "/tmp/dstpu_flight"))
+            if dcfg.enabled else NULL_DEVPROF)
+        self._devprof_on = self.devprof.enabled
+        if self._devprof_on:
+            self._prefill = self.devprof.wrap("prefill", self._prefill)
+            self._chunk_prefill = self.devprof.wrap(
+                "chunk_prefill", self._chunk_prefill)
+            self._decode_chunk_fn = self.devprof.wrap(
+                "decode_chunk", self._decode_chunk_fn)
+            if dcfg.cost_analysis:
+                self._devprof_cost_analyze()
+            self._devprof_warmup()
 
         # rolling-update identity: which weight image this engine is
         # serving (swap_params bumps it; the fleet's per-version SLO
@@ -831,13 +868,21 @@ class ServingEngine:
                 source=self.replica_id or "engine")
         else:
             self.incident_mgr = NULL_INCIDENTS
+        if self._devprof_on and self.incident_mgr.enabled:
+            # a steady-state recompile is a contract violation: the
+            # probe trips a bundle, and every bundle (whatever its
+            # class) carries the compile ledger + capture references
+            self.incident_mgr.add_probe(self.devprof.incident_probe)
+            self.incident_mgr.add_attachment("devprof",
+                                             self.devprof.bundle_info)
         # shared timed pass: SLO window refresh + history sampling +
         # incident evaluation ride ONE exporter tick-hook walk (the
         # register_tick_hook contract) instead of three per-step paths
         self._slo_tick_hooked = False
         self._tick_inline = (self._tel_exporter is None and
                              (self.history.enabled
-                              or self.incident_mgr.enabled))
+                              or self.incident_mgr.enabled
+                              or self._devprof_on))
         if self._tel_exporter is not None:
             ex = self._tel_exporter
             if self._slo_on:
@@ -856,6 +901,11 @@ class ServingEngine:
                     self.incident_mgr.maybe_evaluate,
                     interval_s=icfg.eval_interval_s,
                     name="incident_evaluate")
+            if self._devprof_on:
+                # roofline gauges: flops/bytes counter deltas → MFU/MBU
+                ex.register_tick_hook(
+                    self.devprof.tick, interval_s=1.0,
+                    name="devprof_roofline")
 
         # ---- introspection: /statusz (live engine snapshot),
         # /healthz (liveness/readiness, watchdog-fed), /requestz?id=
@@ -873,6 +923,9 @@ class ServingEngine:
             if self.history.enabled or self.incident_mgr.enabled:
                 self._tel_exporter.register_provider("historyz",
                                                      self.historyz)
+            if self._devprof_on:
+                self._tel_exporter.register_provider("profilez",
+                                                     self.profilez)
 
     # (the `stats` deprecation shim from PR 2/PR 6 was removed on its
     # announced schedule — read `engine.registry.snapshot()` instead)
@@ -951,6 +1004,140 @@ class ServingEngine:
             return jnp.swapaxes(toks, 0, 1), cache          # [B, K]
 
         self._decode_chunk_fn = jax.jit(chunk_fn, donate_argnums=(2,))
+
+    def _devprof_cost_analyze(self) -> None:
+        """Build-time roofline pass (devprof.cost_analysis): lower the
+        compiled sweep programs once at their steady shapes and record
+        the compiler's flops/bytes estimates as per-dispatch costs.
+        Abstract (ShapeDtypeStruct) args — no device work, and the AOT
+        lower/compile path never touches the jit dispatch caches the
+        sentinel watches.  Best-effort per program: a backend without
+        ``cost_analysis`` just leaves that site uncosted."""
+        dp = self.devprof
+
+        def absx(x):
+            return (jax.ShapeDtypeStruct(x.shape, x.dtype)
+                    if hasattr(x, "shape") and hasattr(x, "dtype")
+                    else x)
+
+        tm = jax.tree_util.tree_map
+        try:
+            params_a = tm(absx, self.params)
+            cache_a = tm(absx, self.cache)
+            K = self.decode_chunk
+            keys = jax.random.split(
+                jax.random.PRNGKey(0), K * self.max_batch).reshape(
+                    K, self.max_batch, -1)
+            dp.cost_analyze(
+                "decode_chunk", self._decode_chunk_fn, params_a,
+                jax.ShapeDtypeStruct((self.max_batch, 1), jnp.int32),
+                cache_a, absx(keys),
+                jax.ShapeDtypeStruct((self.max_batch,), jnp.float32))
+            # whole-prompt prefill at the base bucket (the view a
+            # bucket-padded admission hands the program)
+            view_a = tm(absx, self.cache._replace(
+                table=jnp.zeros((1, self.max_pages_per_seq), jnp.int32),
+                seq_lens=jnp.zeros((1,), jnp.int32)))
+            dp.cost_analyze(
+                "prefill", self._prefill, params_a,
+                jax.ShapeDtypeStruct((1, self.prefill_bucket),
+                                     jnp.int32), view_a)
+            if self._spec_on and self._chunk_prefill is not None:
+                # under speculation the continuation forward IS the
+                # steady-state decode program — cost it at the verify
+                # sweep's shape
+                Kd = self.speculative.draft_tokens
+                dp.cost_analyze(
+                    "chunk_prefill", self._chunk_prefill, params_a,
+                    jax.ShapeDtypeStruct((self.max_batch, Kd + 1),
+                                         jnp.int32), cache_a)
+        except Exception:
+            # roofline accounting is observability, never a build
+            # failure — uncosted sites simply contribute 0 to MFU/MBU
+            logger.exception("devprof: build-time cost analysis")
+
+    def _devprof_warmup(self) -> None:
+        """Devprof build-time precompile: dispatch every sweep program
+        once per steady shape so the jit caches are fully populated
+        before the first request.  The zero-steady-recompile contract
+        ("a compile after the first token is a shape-drift bug") is
+        only honest if the shape set is CLOSED at build — without
+        this, the decode chunk's first compile and chunk-prefill's
+        lazily-reached power-of-two table buckets would land after the
+        first token and read as violations.  Every warmup write goes
+        to the trash page (all table rows are trash at build) so
+        serving state is untouched; the dispatches run through the
+        sentinel wrappers and are counted — and attributed — as
+        warmup compiles.  Side benefit: the first real request pays
+        zero compilation (production TPU serving does exactly this —
+        precompile the bucket set at startup)."""
+        zi = jnp.zeros
+        n0 = time.perf_counter()
+        row = self.max_pages_per_seq * self.page_size
+        if self.prefill_bucket:
+            # cold full prefill pads the prompt to prefill_bucket
+            # MULTIPLES clamped at the table row — enumerate them all
+            bkt = self.prefill_bucket
+            ends = sorted({min(i * bkt, row)
+                           for i in range(1, -(-row // bkt) + 1)})
+            for end in ends:
+                view = PagedKVCache(
+                    k=self.cache.k, v=self.cache.v,
+                    table=self._put(self._table_host[0:1]),
+                    seq_lens=self._put(zi((1,), jnp.int32)),
+                    page_size=self.page_size)
+                _, view = self._prefill(
+                    self.params, self._put(zi((1, end), jnp.int32)),
+                    view)
+                self.cache = self.cache._replace(k=view.k, v=view.v)
+        if self._chunk_prefill is not None:
+            # the continuation forward's page-table width is bucketed
+            # to powers of two clamped at the full row — enumerate the
+            # same closed set _advance_prefill draws from
+            C = self.prefill_chunk or self.prefill_bucket
+            widths, w = [], 1
+            while w < self.max_pages_per_seq:
+                widths.append(w)
+                w *= 2
+            widths.append(self.max_pages_per_seq)
+            for w in widths:
+                view = PagedKVCache(
+                    k=self.cache.k, v=self.cache.v,
+                    table=self._put(self._table_host[0:1, :w]),
+                    seq_lens=self._put(zi((1,), jnp.int32)),
+                    page_size=self.page_size)
+                _, view = self._chunk_prefill(
+                    self.params, self._put(zi((1, C), jnp.int32)),
+                    view)
+                self.cache = self.cache._replace(k=view.k, v=view.v)
+        # whole-cache dispatches (spec verify, decode) see the
+        # page_size leaf as the weak-i32 scalar a previous jit RETURN
+        # left in the cache, not the python int the constructor put
+        # there — normalize first, or the warmup would compile the
+        # int-leaf twin of each program and the first real dispatch
+        # would still compile (and read as a steady "recompile")
+        self.cache = self.cache._replace(
+            page_size=jnp.asarray(self.page_size))
+        if self._spec_on and self._chunk_prefill is not None:
+            # the verify sweep's whole-cache continuation shape
+            Kd = self.speculative.draft_tokens
+            _, self.cache = self._chunk_prefill(
+                self.params,
+                self._put(zi((self.max_batch, Kd + 1), jnp.int32)),
+                self.cache)
+        K = self.decode_chunk
+        keys = jax.random.split(
+            jax.random.PRNGKey(0), K * self.max_batch).reshape(
+                K, self.max_batch, -1)
+        out, self.cache = self._decode_chunk_fn(
+            self.params,
+            self._put(zi((self.max_batch, 1), jnp.int32)),
+            self.cache, self._put(keys),
+            self._put(zi((self.max_batch,), jnp.float32)))
+        del out
+        logger.info("devprof warmup: %d programs precompiled in %.1fs",
+                    self.devprof.ledger.warmup,
+                    time.perf_counter() - n0)
 
     # ------------------------------------------------------------- requests
     def submit(self, req_id, tokens, max_new_tokens: int = 32,
@@ -1628,6 +1815,11 @@ class ServingEngine:
                 page_size=self.page_size)
             logits, view = self._prefill(self.params, self._put(toks),
                                          view)
+            if self._devprof_on and self.devprof.should_sample(
+                    "prefill"):
+                # dstpu: host-sync-ok: sampled devprof device-time
+                # attribution (one sync per 1/sample_rate prefills)
+                self.devprof.observe_device("prefill", logits)
             self.cache = self.cache._replace(k=view.k, v=view.v)
 
             slot = _Slot(req=req, seq_len=T, generated=[], rng=rng,
@@ -2036,6 +2228,10 @@ class ServingEngine:
                 self._put(jnp.asarray(k_host)), mode="drop"),
             v=self.cache.v.at[:, :, idx].set(
                 self._put(jnp.asarray(v_host)), mode="drop"))
+        if self._devprof_on and self.devprof.should_sample("promote"):
+            # dstpu: host-sync-ok: sampled devprof device-time
+            # attribution (one sync per 1/sample_rate promote scatters)
+            self.devprof.observe_device("promote", self.cache.k)
 
     def _upload_promoted_q(self, pages: List[int], kq, ks,
                            vq, vs) -> None:
@@ -2055,6 +2251,10 @@ class ServingEngine:
                 self._put(jnp.asarray(vq)), mode="drop"),
             v_scale=c.v_scale.at[:, :, idx].set(
                 self._put(jnp.asarray(vs)), mode="drop"))
+        if self._devprof_on and self.devprof.should_sample("promote"):
+            # dstpu: host-sync-ok: sampled devprof device-time
+            # attribution (one sync per 1/sample_rate promote scatters)
+            self.devprof.observe_device("promote", self.cache.k)
 
     def _demote_for_evict(self, page: int, key: bytes) -> bool:
         """``PageAllocator.demote_hook``: capture an evicted warm
@@ -2193,6 +2393,10 @@ class ServingEngine:
             page_size=self.page_size)
         logits, view = self._chunk_prefill(self.params, self._put(toks),
                                            view)
+        if self._devprof_on and self.devprof.should_sample("prefill"):
+            # dstpu: host-sync-ok: sampled devprof device-time
+            # attribution (one sync per 1/sample_rate prefill chunks)
+            self.devprof.observe_device("prefill", logits)
         self.cache = self.cache._replace(k=view.k, v=view.v)
         s.prefill_done = done + take
         s.seq_len = s.prefill_done
@@ -2279,11 +2483,19 @@ class ServingEngine:
         keys = [p[2] for p in pend] + [pend[0][2]] * pad
         temps = np.zeros((self.max_batch,), np.float32)
         temps[:len(pend)] = [p[3] for p in pend]
+        want_dev = (self._devprof_on
+                    and self.devprof.should_sample("sample"))
+        t0_dev = time.perf_counter() if want_dev else 0.0
         # dstpu: host-sync-ok: boundary sample fetch, one batched
         # transfer per step for every prefill completion (replaced
         # PR 7's per-slot device round-trip)
         toks = np.asarray(self._sample_fn(
             jnp.stack(rows), jnp.stack(keys), self._put(temps)))
+        if want_dev:
+            # the np.asarray above already synced — self-timed, no
+            # extra block_until_ready needed
+            self.devprof.record_device(
+                "sample", time.perf_counter() - t0_dev)
         self._c_boundary_syncs.inc()
         self._c_kdisp_sample.inc()
         for (b, _, _, _), tok in zip(pend, toks):
@@ -2291,6 +2503,11 @@ class ServingEngine:
 
     # dstpu: hot-path
     def _append_token(self, b: int, tok: int) -> None:
+        if self._devprof_on and not self.devprof.steady:
+            # first token of the FIRST request: everything before this
+            # is warmup compilation; every compile after is steady-state
+            # (and trips the incident probe + bench gate)
+            self.devprof.mark_steady()
         s = self.slots[b]
         s.generated.append(tok)
         if self._tel_on or self._slo_on:
@@ -2397,6 +2614,7 @@ class ServingEngine:
                 now = time.monotonic()
                 self.history.maybe_sample(now)
                 self.incident_mgr.maybe_evaluate(now)
+                self.devprof.tick(now)  # rate-limited internally
         else:
             self._step_inner()
             if self._tick_inline:
@@ -2496,6 +2714,12 @@ class ServingEngine:
             out, self.cache = self._decode_chunk_fn(
                 self.params, self._put(toks), self.cache,
                 self._put(keys), self._put(temps))
+            if self._devprof_on and self.devprof.should_sample(
+                    "decode"):
+                # dstpu: host-sync-ok: sampled devprof device-time
+                # attribution — the np.asarray below would sync anyway;
+                # this just brackets it with a clock
+                self.devprof.observe_device("decode", out)
             # trust the decode's structural seq_lens+K between
             # composition changes (inactive rows drift, rebuilt on the
             # next dirty upload)
@@ -2591,6 +2815,12 @@ class ServingEngine:
         n_acc_d, stop_d = verify_accept(
             logits, self._put(drafts), self._put(dlens),
             self._put(keys), self._put(temps))
+        if self._devprof_on and self.devprof.should_sample(
+                "spec_verify"):
+            # dstpu: host-sync-ok: sampled devprof device-time
+            # attribution — the device_get below syncs anyway; this
+            # just brackets the verify sweep with a clock
+            self.devprof.observe_device("spec_verify", n_acc_d)
         if traced_any:
             self.tracer.event("spec_verify", attrs={
                 "active": len(active), "positions": K + 1})
@@ -2830,6 +3060,7 @@ class ServingEngine:
                 "series": len(self.history.series_names()),
             },
             "incidents": self.incident_mgr.snapshot(),
+            "devprof": self.devprof.statusz_block(),
         }
         metrics = self.registry.snapshot()
         status["slo"] = self.slo_tracker.snapshot(now=now)
@@ -2977,6 +3208,14 @@ class ServingEngine:
             "history": self.history.snapshot(),
             "incidents": self.incident_mgr.snapshot(),
         }
+
+    def profilez(self, capture_s=None) -> Dict[str, Any]:
+        """The ``/profilez`` document: devprof's statusz block (compile
+        ledger totals, per-phase device seconds, MFU/MBU), and — when
+        ``capture_s`` is given — an on-demand :mod:`jax.profiler` trace
+        capture of that many seconds written under the tracer's
+        ``dump_dir`` (clamped to ``devprof.capture_max_s``)."""
+        return self.devprof.profilez(capture_s)
 
     def shutdown(self) -> None:
         """Idempotent teardown: final sink flush, then stop the
@@ -3279,12 +3518,14 @@ def serving_engine(params, cfg, **kw):
     # lifecycle (queued/admitted/first-token/finish edges); the encoder
     # engines are fixed-shape batch scorers with no such lifecycle —
     # the block is accepted and unused there, never an error.  The
-    # history/incidents blocks ride the same lifecycle (exporter tick
-    # hooks + flight-recorder triggers) and are likewise accepted and
-    # unused on the encoder path.
+    # history/incidents/devprof blocks ride the same lifecycle
+    # (exporter tick hooks + flight-recorder triggers + the compile
+    # sentinel's steady-state boundary at first token) and are likewise
+    # accepted and unused on the encoder path.
     kw.pop("tracing", None)
     kw.pop("history", None)
     kw.pop("incidents", None)
+    kw.pop("devprof", None)
     kn = kw.pop("kernels", None)
     if kn is not None:
         from deepspeed_tpu.config import KernelsConfig

@@ -209,6 +209,10 @@ def build_drafter(cfg: SpeculativeConfig) -> Drafter:
 
 
 # ------------------------------------------------------ device accept
+# NOTE: module-level jit shared across engines — devprof attributes its
+# device time to the "spec_verify" phase at the call site (serving's
+# _spec_step samples the dispatch result) rather than sentinel-wrapping
+# here, so one engine's sampling never charges another's sweep.
 @jax.jit
 def verify_accept(logits, drafts, draft_lens, keys, temps):
     """Batched acceptance for one verify sweep — ONE host transfer.

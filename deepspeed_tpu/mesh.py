@@ -198,7 +198,20 @@ def install() -> bool:
     pinned JAX predates the top-level entrypoint, so modern-idiom
     callers (the package everywhere, the seed tests verbatim) never
     see the AttributeError.  Never shadows a real native entrypoint.
-    Returns True when this call (or an earlier one) installed it."""
+    Returns True when this call (or an earlier one) installed it.
+
+    Also installs devprof's process-wide ``jax.monitoring`` compile
+    listener (idempotent, best-effort): mesh import is the one choke
+    point every entrypoint passes through before the first jit, so
+    compile-duration events are captured even for programs built
+    before any engine constructs a :class:`~deepspeed_tpu.devprof
+    .DevProf`."""
+    try:
+        from deepspeed_tpu import devprof
+
+        devprof.install_compile_listener()
+    except Exception:
+        pass    # monitoring is an enhancement, never a mesh failure
     native = getattr(jax, "shard_map", None)
     if native is None:
         jax.shard_map = shard_map

@@ -58,15 +58,27 @@ def params_count(params: Any) -> int:
 
 
 # ------------------------------------------------------------- XLA-measured
-def xla_cost_analysis(fn: Callable, *args,
-                      static_argnums=()) -> Dict[str, float]:
-    """Compiler-reported flops / bytes for the fused program."""
-    lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args)
+def xla_cost_analysis_lowered(lowered) -> Dict[str, float]:
+    """Compiler-reported flops / bytes for an already-lowered program
+    (``jit(fn).lower(...)`` — concrete args or ShapeDtypeStructs both
+    work).  The entry point :mod:`deepspeed_tpu.devprof` reuses for its
+    roofline denominators: the engine lowers its OWN jitted sweep
+    programs once at build instead of re-jitting through
+    :func:`xla_cost_analysis`."""
     ca = lowered.compile().cost_analysis()
     if isinstance(ca, (list, tuple)):  # older jax returns a per-computation list
         ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
     return {"flops": float(ca.get("flops", 0.0)),
             "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+
+
+def xla_cost_analysis(fn: Callable, *args,
+                      static_argnums=()) -> Dict[str, float]:
+    """Compiler-reported flops / bytes for the fused program."""
+    return xla_cost_analysis_lowered(
+        jax.jit(fn, static_argnums=static_argnums).lower(*args))
 
 
 class FlopsProfiler:

@@ -292,11 +292,19 @@ class MetricsRegistry:
     def span(self, name: str, help: str = "",
              buckets: Sequence[float] = LATENCY_BUCKETS_S):
         """Context manager: wall time into ``{name}_seconds`` + a
-        ``TraceAnnotation`` named ``{namespace}/{name}``."""
+        ``TraceAnnotation`` named ``{namespace}/{phase}``, where
+        ``phase`` is ``name`` normalized through the devprof phase
+        vocabulary — so captured device timelines use the same
+        prefill/decode/spec_verify/promote/sample names the
+        ``devprof_device_seconds_*`` counters report under.  The
+        histogram keeps the caller's literal name (metric families are
+        a stable exposition contract)."""
         if not self.enabled:
             return _NULL_SPAN
+        from deepspeed_tpu.devprof import canonical_phase
+
         h = self.histogram(f"{name}_seconds", help, buckets)
-        return Span(h, f"{self.namespace}/{name}")
+        return Span(h, f"{self.namespace}/{canonical_phase(name)}")
 
     # ----------------------------------------------------------- export
     def snapshot(self) -> Dict[str, Any]:
@@ -595,12 +603,15 @@ class TelemetryExporter:
         ``historyz`` take no args and return a JSON dict (healthz may
         include ``"ready": false`` to force a 503; historyz serves the
         metric-history rings + recent incident metadata); ``requestz``
-        takes the request-id string.  Re-registering a name replaces
-        it (the engine owns its endpoints)."""
-        if name not in ("statusz", "healthz", "requestz", "historyz"):
+        takes the request-id string; ``profilez`` takes the optional
+        ``?capture_s=`` string (None for a plain devprof snapshot).
+        Re-registering a name replaces it (the engine owns its
+        endpoints)."""
+        if name not in ("statusz", "healthz", "requestz", "historyz",
+                        "profilez"):
             raise ValueError(
                 f"unknown introspection provider {name!r} — expected "
-                "statusz, healthz, historyz or requestz")
+                "statusz, healthz, historyz, profilez or requestz")
         self._providers[name] = fn
 
     # ------------------------------------------------------------- http
@@ -649,6 +660,11 @@ class TelemetryExporter:
                         h = providers["healthz"]()
                         self._send_json(
                             h, 200 if h.get("ready", True) else 503)
+                    elif route == "/profilez" and \
+                            "profilez" in providers:
+                        cs = parse_qs(u.query).get(
+                            "capture_s", [None])[0]
+                        self._send_json(providers["profilez"](cs))
                     elif route == "/requestz" and \
                             "requestz" in providers:
                         rid = parse_qs(u.query).get("id", [None])[0]

@@ -37,6 +37,29 @@ def device_peak_flops() -> float:
     return PEAK_FLOPS["v5e"] if "tpu" in kind else PEAK_FLOPS["cpu"]
 
 
+# Peak HBM bandwidth per chip by TPU generation, bytes/s (public spec
+# sheets) — the MBU denominator, parallel to PEAK_FLOPS for MFU.
+PEAK_HBM_BW = {
+    "v4": 1.2e12,
+    "v5e": 0.82e12,
+    "v5p": 2.77e12,
+    "v6e": 1.64e12,
+    "cpu": 0.1e12,  # nominal, so MBU math never divides by zero off-TPU
+}
+
+
+def device_peak_bandwidth() -> float:
+    """Best-effort peak HBM bandwidth (bytes/s) of the attached chip."""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return PEAK_HBM_BW["cpu"]
+    for key, val in PEAK_HBM_BW.items():
+        if key in kind:
+            return val
+    return PEAK_HBM_BW["v5e"] if "tpu" in kind else PEAK_HBM_BW["cpu"]
+
+
 def _sync() -> None:
     """Drain the async dispatch queue so wall-clock brackets device work."""
     jax.block_until_ready(jnp.zeros(()))

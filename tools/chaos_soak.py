@@ -1007,7 +1007,12 @@ def main():
     waves, burst, expired = build_traffic(cfg.vocab_size)
 
     kw = dict(max_batch=2, page_size=8, num_pages=12, max_seq=64,
-              prefill_bucket=8)
+              prefill_bucket=8,
+              # compile sentinel on BOTH arms: a soak that survives
+              # faults, shedding and tier churn must also never
+              # recompile after its first token — the stamp's
+              # steady_state_recompiles is gated at exactly 0
+              devprof={"sample_rate": 0.05})
 
     # ---- fault-free oracle: every distinct prompt's greedy completion.
     # The oracle ALSO runs history+incidents (same cadences as the
@@ -1161,6 +1166,7 @@ def main():
         inc["sample"] = os.path.basename(sample_path)
 
     plan_snap = eng._fault_plan.snapshot()
+    devprof_snap = eng.statusz().get("devprof", {})
     eng.shutdown()
 
     healthz = eng.healthz()
@@ -1200,6 +1206,19 @@ def main():
                        if k.endswith(("_io_retries", "_sync_fallbacks",
                                       "_write_retries")) and v},
         "incidents": inc,
+        # the zero-recompile contract under chaos: faults, shedding and
+        # tier churn must never push the engine onto an uncompiled
+        # shape after its first token (bench_gate pins this at 0)
+        "steady_state_recompiles": int(
+            devprof_snap.get("compiles_steady", 0)),
+        "devprof": {
+            "compiles_warmup": int(
+                devprof_snap.get("compiles_warmup", 0)),
+            "mfu": devprof_snap.get("mfu", 0.0),
+            "mbu": devprof_snap.get("mbu", 0.0),
+            "host_device_gap_s": devprof_snap.get("host_device_gap_s"),
+            "device_seconds": devprof_snap.get("device_seconds", {}),
+        },
         "injected": plan_snap,
         "degraded_at_end": healthz["degraded"],
         "robustness": robustness,

@@ -64,6 +64,8 @@ _ENGINE_SPARKS = (
     ("kv util", "serving_kv_page_utilization"),
     ("ttft p95", "serving_ttft_seconds:p95"),
     ("decode/s", "serving_decode_steps:rate"),
+    ("mfu", "devprof_mfu"),
+    ("mbu", "devprof_mbu"),
 )
 _FLEET_SPARKS = (
     ("queue", "fleet_queue_depth"),
@@ -307,6 +309,19 @@ def render(status: dict, health: dict | None = None,
                  f"  stalls {zi.get('stream_stalls', 0)}"
                  f" ({zi.get('stream_stall_s', 0.0):.2f}s)"
                  f"  {zi.get('bytes_uploaded', 0) / 1e6:.0f} MB up")
+    dp = status.get("devprof", {})
+    if dp.get("enabled"):
+        ds = dp.get("device_seconds", {})
+        steady = dp.get("compiles_steady", 0)
+        L.append(f"dev   mfu {100 * dp.get('mfu', 0.0):.1f}%"
+                 f"  mbu {100 * dp.get('mbu', 0.0):.1f}%"
+                 f"  gap {1e3 * dp.get('host_device_gap_s', 0.0):.2f}ms"
+                 f"  compiles {dp.get('compiles_warmup', 0)}w"
+                 f"/{steady}s{'  RECOMPILING' if steady else ''}"
+                 f"  dev_s " +
+                 " ".join(f"{p[:3]}={ds.get(p, 0.0):.2f}"
+                          for p in ("prefill", "decode", "spec_verify",
+                                    "promote", "sample")))
     L.extend(render_history(historyz, _ENGINE_SPARKS))
 
     slo = status.get("slo", {})

@@ -31,12 +31,16 @@ RC=${PIPESTATUS[0]}
 
 # telemetry + introspection samples: every slow-lane run also stamps
 # TELEMETRY_SAMPLE.json (a live registry snapshot off a short gpt2
-# serving loop) and STATUSZ_SAMPLE.json (/statusz, /healthz and a
+# serving loop), STATUSZ_SAMPLE.json (/statusz, /healthz and a
 # /requestz drill-down fetched over real HTTP from the same engine)
-# next to SLOW_LANE.json — best-effort, never the reason the lane fails
+# and DEVPROF_SAMPLE.json (the compile ledger, per-phase device time
+# and MFU/MBU via /statusz + /profilez incl. a short on-demand
+# jax.profiler capture, same real HTTP server) next to SLOW_LANE.json
+# — best-effort, never the reason the lane fails
 timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/telemetry_dump.py \
   --cpu --json-out "$REPO/TELEMETRY_SAMPLE.json" \
-  --statusz-out "$REPO/STATUSZ_SAMPLE.json" >/dev/null 2>&1 || true
+  --statusz-out "$REPO/STATUSZ_SAMPLE.json" \
+  --devprof-out "$REPO/DEVPROF_SAMPLE.json" >/dev/null 2>&1 || true
 
 # prefix-cache A/B: the shared-prefix workload served with caching off
 # vs on (TTFT, tokens/s, hit rate) stamps PREFIX_BENCH.json through the

@@ -34,7 +34,8 @@ sys.path.insert(0, REPO)
 
 def measure_point(model_name, slots, decode_chunk, prompt_len=8,
                   new_tokens=48, requests=None, telemetry=True,
-                  tracing=True, slo=False, history=False):
+                  tracing=True, slo=False, history=False,
+                  devprof=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -77,7 +78,8 @@ def measure_point(model_name, slots, decode_chunk, prompt_len=8,
         num_pages=slots * (-(-max_seq // 8)) + 8, max_seq=max_seq,
         prefill_bucket=prompt_len, decode_chunk=decode_chunk,
         telemetry=telemetry, tracing=tracing, slo=slo_block,
-        history=history_block, incidents=incidents_block)
+        history=history_block, incidents=incidents_block,
+        devprof=bool(devprof))
 
     def decode_steps():
         return int(eng.registry.snapshot()["counters"]
@@ -136,6 +138,7 @@ def measure_point(model_name, slots, decode_chunk, prompt_len=8,
         "requests": requests, "generated": generated,
         "telemetry": bool(telemetry), "tracing": bool(tracing),
         "slo": bool(slo), "history": bool(history),
+        "devprof": bool(devprof),
         "decode_steps": steps,
         "prefill_chunks": int(eng.registry.snapshot()["counters"]
                               .get("serving_prefill_chunks", 0)),
@@ -251,6 +254,20 @@ def main():
         "on the same build (telemetry+tracing+slo on in both arms); "
         "the enabled path adds one tick-hook compare per step")
 
+    # devprof-overhead A/B (ISSUE 17 acceptance): compile sentinel +
+    # sampled device-time attribution + roofline counters on vs off,
+    # telemetry/tracing on in BOTH arms — the enabled delta is the
+    # price of the sentinel's cache-size check, two counter adds per
+    # dispatch, and one block_until_ready per 1/sample_rate dispatches.
+    _, devprof_overhead = _ab("devprof")
+    devprof_overhead["backend"] = jax.default_backend()
+    devprof_overhead["note"] = (
+        "best-of-3 ms/decode-step, devprof enabled (compile sentinel + "
+        "5% sampled block_until_ready attribution + per-dispatch "
+        "flops/bytes accounting) vs disabled on the same build "
+        "(telemetry+tracing on in both arms); disabled path = shared "
+        "NULL_DEVPROF, wrap() is the identity")
+
     if args.ab_only and os.path.exists(args.json_out):
         with open(args.json_out) as f:
             out = json.load(f)
@@ -268,6 +285,7 @@ def main():
     out["tracing_overhead"] = tracing_overhead
     out["slo_overhead"] = slo_overhead
     out["history_overhead"] = history_overhead
+    out["devprof_overhead"] = devprof_overhead
     with open(args.json_out, "w") as f:
         json.dump(out, f, indent=1)
     print("→", args.json_out)

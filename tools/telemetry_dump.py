@@ -14,7 +14,12 @@ Runs a tiny gpt2 ServingEngine on whatever backend is available (pass
   4. stamps STATUSZ_SAMPLE.json from the engine's introspection server
      (ISSUE 6): /statusz, /healthz and a /requestz drill-down fetched
      over REAL HTTP from the live engine — the snapshot schema is
-     versioned in-repo and round-trip-parsed by a tier-1 test.
+     versioned in-repo and round-trip-parsed by a tier-1 test, and
+  5. stamps DEVPROF_SAMPLE.json (ISSUE 17): the devprof block from
+     /statusz plus the /profilez round-trip and a short on-demand
+     jax.profiler capture, all over the same real HTTP server — the
+     standing record of the compile ledger (steady_state_compiles
+     must read 0), per-phase device seconds and MFU/MBU.
 
     python tools/telemetry_dump.py --cpu
 """
@@ -39,6 +44,11 @@ def main():
                     default=os.path.join(REPO, "TELEMETRY_SAMPLE.json"))
     ap.add_argument("--statusz-out",
                     default=os.path.join(REPO, "STATUSZ_SAMPLE.json"))
+    ap.add_argument("--devprof-out",
+                    default=os.path.join(REPO, "DEVPROF_SAMPLE.json"))
+    ap.add_argument("--capture-s", type=float, default=0.2,
+                    help="on-demand /profilez device-trace length "
+                         "(0 skips the capture)")
     args = ap.parse_args()
 
     import jax
@@ -74,7 +84,11 @@ def main():
                                        "deadline_s": 60.0},
                        "batch": {"deadline_s": 300.0, "target": 0.9}},
              "default_tier": "interactive"},
-        telemetry={"http_port": 0, "interval_s": 0.0})
+        telemetry={"http_port": 0, "interval_s": 0.0},
+        # full-rate sampling: this is a tiny sample loop, so every
+        # dispatch contributing device time gives the stamp dense
+        # per-phase attribution (production default is 0.05)
+        devprof={"sample_rate": 1.0})
 
     rng = np.random.default_rng(0)
     prefix = rng.integers(1, cfg.vocab_size, prompt_len - 4).tolist()
@@ -117,8 +131,8 @@ def main():
 
     base = f"http://127.0.0.1:{eng._tel_exporter.port}"
 
-    def get(path):
-        with urllib.request.urlopen(base + path, timeout=10) as r:
+    def get(path, timeout=10):
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
             return json.loads(r.read().decode())
 
     statusz = get("/statusz")
@@ -137,6 +151,37 @@ def main():
     print(f"# introspection: fetched /statusz /healthz /requestz over "
           f"http from {base}")
     print("→", args.statusz_out)
+
+    # device-truth sample over the same real HTTP server (ISSUE 17):
+    # /profilez without a query returns the devprof status block;
+    # with capture_s it runs a bounded jax.profiler capture and
+    # returns the capture reference
+    profilez = get("/profilez")
+    capture = None
+    if args.capture_s > 0:
+        # profiler session start/stop costs ~15 s on some backends —
+        # the capture fetch gets a generous client timeout
+        capture = get(f"/profilez?capture_s={args.capture_s}",
+                      timeout=120)
+        capture.pop("devprof", None)   # already stamped above
+    dp = statusz.get("devprof", {})
+    atomic_write_json({
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(),
+        "model": "gpt2-tiny",
+        "endpoints": ["/profilez", "/profilez?capture_s="],
+        # the zero-recompile contract's standing evidence: this loop
+        # served real traffic after warmup, so steady must be true and
+        # steady_state_compiles must read 0
+        "steady": dp.get("steady"),
+        "steady_state_compiles": dp.get("compiles_steady"),
+        "devprof": dp,
+        "profilez": profilez,
+        "capture": capture,
+    }, args.devprof_out)
+    print(f"# devprof: fetched /profilez over http from {base} "
+          f"(capture_s={args.capture_s})")
+    print("→", args.devprof_out)
     eng.shutdown()
 
 
