@@ -44,6 +44,7 @@ CONFIG_BLOCKS = {
     "PrefixCacheConfig": "prefix_cache",
     "KVTierConfig": "kv_tier",
     "KernelsConfig": "kernels",
+    "CommConfig": "comm",
     "SpeculativeConfig": "speculative",
     "SLOConfig": "slo",
     "FaultsConfig": "faults",

@@ -183,7 +183,10 @@ def quantized_all_reduce_tree(grads: Any, axis_name: str = AXIS,
     red = quantized_all_reduce(flat, axis_name, bits)
     out, off = [], 0
     for l in leaves:
-        out.append(red[off:off + l.size].reshape(l.shape))
+        # restore each leaf's own dtype: the raveled buffer is f32
+        # working precision, but handing bf16 grads back widened
+        # silently doubles every downstream buffer
+        out.append(red[off:off + l.size].reshape(l.shape).astype(l.dtype))
         off += l.size
     return jax.tree.unflatten(treedef, out)
 
@@ -227,13 +230,57 @@ def quantized_weight_gather(row: jnp.ndarray, axis_name: str = AXIS,
     multiple of ``_GROUP``.  Returns the dequantized ``[world*chunk]``
     flat buffer (lossy: the forward sees group-quantized weights, same
     trade the reference makes, ref zero_quantized_weights)."""
-    from deepspeed_tpu.ops.quant import dequantize, quantize
-
     q, s, _ = quantize(row, bits=bits, num_groups=row.shape[0] // _GROUP)
     qg = jax.lax.all_gather(q, axis_name)                       # int8 wire
     sg = jax.lax.all_gather(s, axis_name)
     full = jax.vmap(lambda qq, ss: dequantize(qq, ss, bits=bits))(qg, sg)
     return full.reshape(-1)
+
+
+# ------------------------------------------- comm-config routing (v2)
+def make_reduce_fn(comm_cfg, ms: MeshSpec, bits: Optional[int] = None):
+    """CommConfig → the tree ``reduce_fn`` for :func:`local_grad_shardmap`.
+
+    The hierarchical two-level path (deepspeed_tpu/comm/collectives.py)
+    is the default engine route: ``hierarchy_size`` (0 = auto-detect,
+    1 = flat schedule), ``codec`` ("blockwise" v2 wire / "group" legacy
+    512-grid / "exact" f32 verification arm) and ``bucket_mb``
+    (0 = monolithic) all come from the config block.  Returns
+    ``(reduce_fn, Hierarchy)`` so callers can report wire accounting.
+    """
+    from deepspeed_tpu.comm import collectives as _hc
+
+    world = ms.size(AXIS)
+    h = _hc.resolve_hierarchy(world, comm_cfg.hierarchy_size,
+                              devices=ms.mesh.devices.reshape(-1))
+    be = _hc.bucket_elems_for(comm_cfg.bucket_mb, world, comm_cfg.codec)
+    fn = functools.partial(
+        _hc.hierarchical_all_reduce_tree, axis_name=AXIS, h=h,
+        bits=int(bits if bits is not None else comm_cfg.bits),
+        codec=comm_cfg.codec, bucket_elems=be)
+    return fn, h
+
+
+def make_weight_gather(comm_cfg, ms: MeshSpec, bits: Optional[int] = None):
+    """CommConfig → the qwZ row gather for the flat-shard step: the hpZ
+    two-hop gather when a hierarchy is in play (inter links carry
+    ``inter`` int8 rows instead of ``world``), the flat int8 gather
+    otherwise.  Returns ``(gather_fn(row) -> [world, chunk], Hierarchy)``;
+    both routes are bit-exact to each other (one quantization, same
+    grid, before any hop)."""
+    from deepspeed_tpu.comm import collectives as _hc
+
+    world = ms.size(AXIS)
+    h = _hc.resolve_hierarchy(world, comm_cfg.hierarchy_size,
+                              devices=ms.mesh.devices.reshape(-1))
+    b = int(bits if bits is not None else comm_cfg.bits)
+
+    def gather(row):
+        full, _ = _hc.hpz_weight_gather(
+            row, AXIS, h, bits=b, num_groups=row.shape[0] // _GROUP)
+        return full.reshape(-1)
+
+    return gather, h
 
 
 # ----------------------------------------------------- local-grad harness

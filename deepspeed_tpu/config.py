@@ -341,6 +341,107 @@ class KVTierConfig:
 
 
 @dataclasses.dataclass
+class CommConfig:
+    """Collective-communication policy: hierarchical two-level
+    collectives + the int8 wire codec shared by ZeRO-3 training and TP
+    serving (ZeRO++ arXiv:2306.10209, EQuARX arXiv:2506.17615).
+
+    ``hierarchy_size`` factors the ``data`` axis into ``(inter,
+    intra)`` sub-groups of ``intra = hierarchy_size`` devices each: the
+    compressed gradient all-reduce runs intra-reduce → quantized
+    inter-exchange → intra-gather, and the qwZ weight all-gather
+    resolves intra-node against an hpZ secondary shard (the full-axis
+    int8 hop becomes an ``inter``-sized one).  ``0`` auto-detects from
+    the device topology (devices-per-process on a multi-host mesh;
+    flat on a single host), ``1`` forces the flat single-level paths,
+    ``k > 1`` must divide the data-parallel world (resolution raises
+    otherwise — a silently-flat "hierarchical" config is a perf bug).
+
+    ``codec`` picks the wire encoding for the compressed collectives:
+    ``blockwise`` (the v2 per-block int8 codec, scales over 8x512
+    TPU-tile blocks), ``group`` (the legacy flat 512-element group
+    scheme, kept for A/B), or ``exact`` (f32 on the wire — the
+    bit-exact bypass kept for verification; hierarchical routing still
+    applies).  ``bits`` is the integer wire width for the non-exact
+    codecs.
+
+    ``bucket_mb`` splits the raveled gradient tree into fixed-size
+    buckets reduced under a ``lax.scan`` so XLA can overlap bucket
+    ``k``'s collective with bucket ``k+1``'s work (the reference's
+    NCCL-bucket idiom); ``0`` keeps the single monolithic buffer.
+    Bucket boundaries are aligned to the codec block grid, so bucketed
+    and monolithic paths ship identical int8 codes and scales (grads
+    agree to f32 rounding).
+
+    ``quantized_serving`` opts TP replica weight placement and the
+    ZeRO-Inference layer upload into the same int8 wire (blockwise
+    codes + scales travel host→HBM, dequantized on device).  Default
+    off: greedy token identity is preserved via the bit-exact path;
+    the int8 arm is gated by ``serving_rtol`` (max relative weight
+    error the placement may introduce — exceeding it raises).
+    """
+
+    hierarchy_size: int = 0
+    bucket_mb: float = 0.0
+    bits: int = 8
+    codec: str = "blockwise"
+    quantized_serving: bool = False
+    serving_rtol: float = 0.05
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CommConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown comm config keys: {sorted(unknown)} "
+                f"(known: {sorted(known)})")
+        c = cls(**{k: v for k, v in d.items() if k in known})
+        c.hierarchy_size = int(c.hierarchy_size)
+        c.bucket_mb = float(c.bucket_mb)
+        c.bits = int(c.bits)
+        c.codec = str(c.codec)
+        c.quantized_serving = bool(c.quantized_serving)
+        c.serving_rtol = float(c.serving_rtol)
+        if c.hierarchy_size < 0:
+            raise ValueError(
+                f"comm.hierarchy_size must be >= 0 (0 = auto-detect), "
+                f"got {c.hierarchy_size}")
+        if c.bucket_mb < 0:
+            raise ValueError(
+                f"comm.bucket_mb must be >= 0 (0 = monolithic), "
+                f"got {c.bucket_mb}")
+        if c.codec not in ("blockwise", "group", "exact"):
+            raise ValueError(
+                f"comm.codec must be one of blockwise|group|exact, "
+                f"got {c.codec!r}")
+        if c.bits not in (4, 8):
+            raise ValueError(
+                f"comm.bits must be 4 or 8, got {c.bits}")
+        if not 0 < c.serving_rtol <= 1:
+            raise ValueError(
+                f"comm.serving_rtol must be in (0, 1], "
+                f"got {c.serving_rtol}")
+        return c
+
+    @classmethod
+    def coerce(cls, obj) -> "CommConfig":
+        """Accept None (all-default policy), a dict, or a CommConfig —
+        like ``kernels`` there is no enabled switch: the defaults ARE
+        the policy (auto hierarchy, blockwise codec, monolithic
+        buckets, bit-exact serving)."""
+        if obj is None:
+            return cls()
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, dict):
+            return cls.from_dict(dict(obj))
+        raise TypeError(
+            f"comm must be a dict or CommConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class KernelsConfig:
     """Serving kernel-dispatch policy (the config-first replacement for
     the ``DSTPU_FORCE_PAGED_PALLAS`` / ``DSTPU_PAGED_V1`` env-flag
@@ -1481,6 +1582,8 @@ class Config:
         default_factory=KVTierConfig)
     kernels: KernelsConfig = dataclasses.field(
         default_factory=KernelsConfig)
+    comm: CommConfig = dataclasses.field(
+        default_factory=CommConfig)
     speculative: SpeculativeConfig = dataclasses.field(
         default_factory=SpeculativeConfig)
     slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
@@ -1617,6 +1720,10 @@ class Config:
             # no enabled switch here: "auto" is the default policy and
             # writing the block just overrides fields of it
             c.kernels = KernelsConfig.coerce(d["kernels"])
+        if "comm" in d:
+            # no enabled switch (same contract as kernels): the
+            # defaults are the policy, the block overrides fields
+            c.comm = CommConfig.coerce(d["comm"])
         if "speculative" in d:
             # coerce, not from_dict: writing the block IS the opt-in
             # (same contract as zero_inference / prefix_cache above);

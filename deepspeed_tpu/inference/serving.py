@@ -77,7 +77,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu import faults as faults_mod
-from deepspeed_tpu.config import (DevprofConfig, FaultsConfig,
+from deepspeed_tpu.config import (CommConfig, DevprofConfig, FaultsConfig,
                                   HistoryConfig,
                                   IncidentsConfig, KVTierConfig,
                                   PrefixCacheConfig, SLOConfig,
@@ -263,7 +263,7 @@ class ServingEngine:
                  shed_expired_deadline: bool = False,
                  replica_id: Optional[str] = None,
                  history=None, incidents=None, kernels=None,
-                 devprof=None):
+                 devprof=None, comm=None):
         # Sharded serving (ref: deepspeed/module_inject/replace_module.py
         # TP injection + deepspeed/moe/sharded_moe.py expert-parallel
         # inference): with a mesh, params arrive pre-sharded from the
@@ -390,6 +390,16 @@ class ServingEngine:
                                                  interpret=_itp))
         else:
             self._sample_fn = _sample_rows
+        # ---- quantized weight placement (the training int8 wire
+        # reused for serving, ISSUE 18): when comm.quantized_serving is
+        # on, the BUILDER quantizes replica weights host-side so the
+        # H2D upload carries int8 codes + scales, records placement
+        # stats via _record_comm_placement, and this engine publishes
+        # them (/statusz "comm" block + comm_* metric family).  The
+        # engine itself only holds the coerced config — builders and
+        # the ZeRO-Inference layer stream read it from here.
+        self._comm = CommConfig.coerce(comm)
+        self.comm_placement: Optional[Dict[str, Any]] = None
         # kv_tier coerced BEFORE the cache alloc below: the
         # quantized_resident mode changes the DEVICE cache's layout
         # (int8 code planes + f32 per-token-row scale planes), not just
@@ -3062,6 +3072,11 @@ class ServingEngine:
             "incidents": self.incident_mgr.snapshot(),
             "devprof": self.devprof.statusz_block(),
         }
+        if self.comm_placement is not None:
+            # quantized TP weight placement (comm.quantized_serving):
+            # wire-byte ledger + worst per-leaf round-trip error, stamped
+            # once at build by _record_comm_placement
+            status["comm"] = dict(self.comm_placement)
         metrics = self.registry.snapshot()
         status["slo"] = self.slo_tracker.snapshot(now=now)
         # reuse the snapshot just taken — _robustness_status only
@@ -3255,6 +3270,107 @@ def _shard_params_for_serving(params, specs_tree, mesh):
                            mesh)
 
 
+# below this, the exact path keeps a leaf: scales would outweigh the
+# payload saved, and tiny leaves are the accuracy-critical ones (norm
+# gains, biases)
+_WIRE_MIN_ELEMS = 1024
+
+
+def _quantized_shard_params(params, specs_tree, mesh, comm_cfg):
+    """int8-wire variant of :func:`_shard_params_for_serving` (ref:
+    ZeRO++ qwZ's quantized weight gather reused at serving time,
+    arXiv:2306.10209): each float weight leaf is quantized ON THE HOST
+    so the H2D upload that places the TP replica carries int8 codes +
+    f32 scales instead of the full-precision image, then dequantized on
+    device back to the leaf's own dtype under the leaf's own
+    PartitionSpec (scales ride replicated — they are tiny).  Every
+    quantized leaf is gated by ``comm_cfg.serving_rtol`` on its exact
+    host-side round-trip error — a leaf the codec cannot represent
+    within tolerance fails the BUILD, never silently serves degraded
+    weights.  QuantizedTensor leaves (weight_dtype="int8" already
+    shipped codes), non-float leaves, and sub-``_WIRE_MIN_ELEMS``
+    leaves take the exact path.  Returns ``(placed, stats)``; the
+    caller stamps ``stats`` onto the engine via
+    :func:`_record_comm_placement`."""
+    from jax.tree_util import keystr, tree_map_with_path
+
+    from deepspeed_tpu import zero as _zero
+    from deepspeed_tpu.comm.collectives import (dequantize_from_wire,
+                                                quantize_for_wire_np)
+    from deepspeed_tpu.inference.quantized import _is_qt, shard_quantized
+
+    specs = _zero.resolve_specs(None, specs_tree)
+    stats = {"leaves_quantized": 0, "leaves_exact": 0,
+             "bytes_on_wire_int8": 0, "bytes_on_wire_f32": 0,
+             "max_rel_err": 0.0,
+             "serving_rtol": float(comm_cfg.serving_rtol)}
+
+    def put(path, leaf, spec):
+        a = None if _is_qt(leaf) else np.asarray(leaf)
+        if a is None or a.dtype.kind != "f" or a.size < _WIRE_MIN_ELEMS:
+            stats["leaves_exact"] += 1
+            return shard_quantized(leaf, spec, mesh)
+        q, s, dt = quantize_for_wire_np(a)
+        af32 = a.astype(np.float32)
+        deq_host = (q.astype(np.float32).reshape(s.size, -1)
+                    * s[:, None]).reshape(a.shape)
+        ref = float(np.abs(af32).max()) or 1.0
+        rel = float(np.abs(deq_host - af32).max()) / ref
+        if rel > comm_cfg.serving_rtol:
+            raise ValueError(
+                f"comm.quantized_serving: leaf {keystr(path)} "
+                f"{a.shape} round-trips at rel err {rel:.3e} > "
+                f"serving_rtol {comm_cfg.serving_rtol:g} — raise the "
+                "tolerance or serve this model unquantized")
+        stats["leaves_quantized"] += 1
+        stats["bytes_on_wire_int8"] += q.nbytes + s.nbytes
+        stats["bytes_on_wire_f32"] += a.size * 4
+        stats["max_rel_err"] = max(stats["max_rel_err"], rel)
+        # the H2D below is the wire this whole path exists for: int8
+        # codes under the weight's spec + replicated scales, dequantized
+        # device-side into the leaf's serving dtype
+        q_dev = jax.device_put(q, mesh.sharding(spec))
+        s_dev = jax.device_put(s, mesh.replicated())
+        return jax.device_put(
+            dequantize_from_wire(q_dev, s_dev, jnp.dtype(dt)),
+            mesh.sharding(spec))
+
+    placed = tree_map_with_path(put, params, specs, is_leaf=_is_qt)
+    i8 = stats["bytes_on_wire_int8"]
+    stats["compression_ratio"] = round(
+        stats["bytes_on_wire_f32"] / i8, 4) if i8 else 0.0
+    stats["max_rel_err"] = round(stats["max_rel_err"], 8)
+    return placed, stats
+
+
+def _record_comm_placement(eng: ServingEngine, stats: Dict[str, Any]):
+    """Stamp quantized-placement stats onto a built engine: the
+    /statusz ``comm`` block plus the ``comm_*`` metric family — the
+    SAME names the training engine reports for its gradient wire, so
+    one dashboard joins both sides of the shared int8 codec."""
+    eng.comm_placement = dict(stats)
+    r = eng.registry
+    if not r.enabled:
+        return
+    r.counter(
+        "comm_bytes_on_wire_int8",
+        "bytes actually shipped on the quantized wire (int8 codes + "
+        "f32 scales)").inc(stats["bytes_on_wire_int8"])
+    r.counter(
+        "comm_bytes_on_wire_f32",
+        "bytes a flat f32 wire would have shipped for the same "
+        "payload").inc(stats["bytes_on_wire_f32"])
+    r.gauge(
+        "comm_compression_ratio",
+        "f32 wire bytes / quantized wire bytes").set(
+        stats["compression_ratio"])
+    r.gauge(
+        "comm_serving_max_rel_err",
+        "worst per-leaf round-trip error of the quantized weight "
+        "placement (gated by comm.serving_rtol at build)").set(
+        stats["max_rel_err"])
+
+
 def _route_zero_inference(zero_inference, family: str, params, cfg,
                           weight_dtype, quant_group_size, mesh, kw):
     """Shared builder branch: a live ``zero_inference`` block routes to
@@ -3347,14 +3463,25 @@ def llama_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
             group_size=quant_group_size,
             skip_paths=("attn_norm", "mlp_norm", "final_norm"))
 
+    comm_stats = None
     if tp:
-        params = _shard_params_for_serving(params, llama.param_specs(cfg),
-                                           mesh)
+        cc = CommConfig.coerce(kw.get("comm"))
+        if cc.quantized_serving:
+            # the training int8 wire reused for replica placement: H2D
+            # ships codes + scales, gated by serving_rtol per leaf
+            params, comm_stats = _quantized_shard_params(
+                params, llama.param_specs(cfg), mesh, cc)
+        else:
+            params = _shard_params_for_serving(
+                params, llama.param_specs(cfg), mesh)
 
-    return ServingEngine(
+    eng = ServingEngine(
         params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
         head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, mesh=mesh,
         **kw)
+    if comm_stats is not None:
+        _record_comm_placement(eng, comm_stats)
+    return eng
 
 
 def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
@@ -3410,16 +3537,25 @@ def mixtral_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
             group_size=quant_group_size,
             skip_paths=("gate", "attn_norm", "mlp_norm", "final_norm"))
 
+    comm_stats = None
     if sharded:
         # expert FFNs shard over the expert axis, attention
         # Megatron-style over model (ref: DeepSpeed-MoE inference)
-        params = _shard_params_for_serving(params,
-                                           mixtral.param_specs(cfg), mesh)
+        cc = CommConfig.coerce(kw.get("comm"))
+        if cc.quantized_serving:
+            params, comm_stats = _quantized_shard_params(
+                params, mixtral.param_specs(cfg), mesh, cc)
+        else:
+            params = _shard_params_for_serving(
+                params, mixtral.param_specs(cfg), mesh)
 
-    return ServingEngine(
+    eng = ServingEngine(
         params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
         head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, mesh=mesh,
         **kw)
+    if comm_stats is not None:
+        _record_comm_placement(eng, comm_stats)
+    return eng
 
 
 def gpt2_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
@@ -3471,17 +3607,26 @@ def gpt2_serving_engine(params, cfg, weight_dtype: str = "bfloat16",
                         "proj_b", "fc_b", "out_b", "lnf_w", "lnf_b",
                         "wpe"))
 
+    comm_stats = None
     if tp:
         # ref: module_inject/containers/gpt2.py — fused qkv shards its
         # output dim, proj/out row-parallel; biases on sharded outputs
         # follow the column split
-        params = _shard_params_for_serving(params, gpt2.param_specs(cfg),
-                                           mesh)
+        cc = CommConfig.coerce(kw.get("comm"))
+        if cc.quantized_serving:
+            params, comm_stats = _quantized_shard_params(
+                params, gpt2.param_specs(cfg), mesh, cc)
+        else:
+            params = _shard_params_for_serving(
+                params, gpt2.param_specs(cfg), mesh)
 
-    return ServingEngine(
+    eng = ServingEngine(
         params, step, step, n_layers=cfg.n_layers, n_kv=cfg.n_kv_heads,
         head_dim=cfg.head_dim, chunk_prefill_fn=chunk_step, mesh=mesh,
         **kw)
+    if comm_stats is not None:
+        _record_comm_placement(eng, comm_stats)
+    return eng
 
 
 def serving_engine(params, cfg, **kw):
@@ -3540,6 +3685,16 @@ def serving_engine(params, cfg, **kw):
                 f"the kernels block pins paged-KV decode kernels, "
                 f"which {type(cfg).__name__} does not serve — "
                 "supported: LlamaConfig, MixtralConfig, GPT2Config")
+    cm = kw.pop("comm", None)
+    if cm is not None and CommConfig.coerce(cm).quantized_serving:
+        # quantized placement rides the TP replica upload / ZI layer
+        # stream, neither of which the encoder engines have — fail
+        # loudly, never silently place full-precision weights under a
+        # config that pinned the int8 wire
+        raise NotImplementedError(
+            f"comm.quantized_serving quantizes TP replica weight "
+            f"placement, which {type(cfg).__name__} does not serve — "
+            "supported: LlamaConfig, MixtralConfig, GPT2Config")
     sp = kw.pop("speculative", None)
     kw.pop("drafter", None)
     if sp is not None and SpeculativeConfig.coerce(sp).enabled:

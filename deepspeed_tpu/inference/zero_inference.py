@@ -75,7 +75,8 @@ import jax.numpy as jnp
 from deepspeed_tpu.config import KVTierConfig, ZeroInferenceConfig
 from deepspeed_tpu.infinity import _NvmeTier, _RamTier
 from deepspeed_tpu.inference.kernels import PagedKVCache
-from deepspeed_tpu.inference.serving import (ServingEngine,
+from deepspeed_tpu.inference.serving import (_WIRE_MIN_ELEMS,
+                                             ServingEngine,
                                              _resolve_kernels_for_builder)
 from deepspeed_tpu.param_stream import TierLayerReader
 from deepspeed_tpu.utils.logging import logger
@@ -243,6 +244,33 @@ class ZeroInferenceServingEngine(ServingEngine):
             "zi_h2d_bandwidth_bytes_per_s",
             "streamed bytes / sweep wall time (lower bound: the sweep "
             "window includes the compute the stream hides behind)")
+        # int8 layer broadcast (comm.quantized_serving, ISSUE 18): every
+        # upload — the resident pins below AND the steady-state tier
+        # stream — packs float leaves host-side so the H2D link carries
+        # int8 codes + f32 scales (the training gradient wire's codec,
+        # comm/collectives.py).  The serving_rtol gate runs once per
+        # layer, on its first upload.
+        self._wire_on = self._comm.quantized_serving
+        self._wire_checked: set = set()
+        if self._wire_on:
+            # the serving_rtol gate runs at BUILD over every layer's
+            # leaves: a config the codec cannot honor must fail the
+            # constructor, not surface later as swallowed per-request
+            # admission failures from the reader thread (request
+            # isolation treats a mid-stream exception as one bad
+            # request, which a config error is not)
+            for a in leaves:
+                for l in range(n_layers):
+                    self._wire_check(a[l], l)
+            self._wire_checked.update(range(n_layers))
+        self._c_comm_int8 = r.counter(
+            "comm_bytes_on_wire_int8",
+            "bytes actually shipped on the quantized wire (int8 codes "
+            "+ f32 scales)")
+        self._c_comm_f32 = r.counter(
+            "comm_bytes_on_wire_f32",
+            "bytes a flat f32 wire would have shipped for the same "
+            "payload")
         # incident wiring (PR 15): a streamed engine's trajectory
         # pathology of interest is the tier fence — watch the
         # prefetch-wait p95 history series so a developing stall trend
@@ -320,11 +348,61 @@ class ZeroInferenceServingEngine(ServingEngine):
     def _upload_layer(self, bufs: List[np.ndarray], _l: int):
         """Fenced host buffers → device tree for ONE layer (the async
         H2D the reader keeps in flight behind the sweep); TP/EP uploads
-        land pre-sharded under the model's own per-layer specs."""
+        land pre-sharded under the model's own per-layer specs.  Under
+        ``comm.quantized_serving`` float leaves cross the link as int8
+        codes + scales and dequantize device-side."""
+        if self._wire_on:
+            bufs = [self._wire_put(a, _l) for a in bufs]
+            self._wire_checked.add(_l)
         tree = jax.tree_util.tree_unflatten(self._btree, list(bufs))
         self._c_h2d.inc()
         self._c_bytes.inc(self._layer_bytes)
         return self._place(tree, self._layer_specs)
+
+    def _wire_check(self, buf, l: int) -> None:
+        """serving_rtol gate for one leaf of layer ``l`` — exact
+        host-side round-trip error of the wire codec, raising on a
+        config the codec cannot honor.  Build runs it over every layer;
+        :meth:`_wire_put` re-runs it only for layers the build never
+        saw (``_wire_checked`` is the ledger)."""
+        from deepspeed_tpu.comm.collectives import quantize_for_wire_np
+
+        a = np.asarray(buf)
+        if a.dtype.kind != "f" or a.size < _WIRE_MIN_ELEMS:
+            return
+        q, s, _ = quantize_for_wire_np(a)
+        af32 = a.astype(np.float32)
+        deq = (q.astype(np.float32).reshape(s.size, -1)
+               * s[:, None]).reshape(a.shape)
+        rel = float(np.abs(deq - af32).max()) \
+            / (float(np.abs(af32).max()) or 1.0)
+        if rel > self._comm.serving_rtol:
+            raise ValueError(
+                f"comm.quantized_serving: layer {l} leaf {a.shape} "
+                f"round-trips at rel err {rel:.3e} > serving_rtol "
+                f"{self._comm.serving_rtol:g} — raise the tolerance "
+                "or stream this model unquantized")
+
+    def _wire_put(self, buf, l: int):
+        """One leaf onto the int8 wire: host-side pack → H2D of codes +
+        scales → device-side dequant to the leaf's dtype.  Non-float and
+        tiny leaves ship exact (same threshold as the TP placement
+        path).  The stream re-ships the same bytes every sweep, so the
+        build-time gate covers the engine's lifetime without taxing the
+        hot path."""
+        from deepspeed_tpu.comm.collectives import (dequantize_from_wire,
+                                                    quantize_for_wire_np)
+
+        a = np.asarray(buf)
+        if a.dtype.kind != "f" or a.size < _WIRE_MIN_ELEMS:
+            return buf
+        if l not in self._wire_checked:
+            self._wire_check(a, l)
+        q, s, dt = quantize_for_wire_np(a)
+        self._c_comm_int8.inc(q.nbytes + s.nbytes)
+        self._c_comm_f32.inc(a.size * 4)
+        return dequantize_from_wire(jnp.asarray(q), jnp.asarray(s),
+                                    jnp.dtype(dt))
 
     # ---------------------------------------------------- program hooks
     def _alloc_cache(self, n_layers, n_kv, num_pages, page_size,

@@ -53,7 +53,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 __all__ = [
     "shard_map", "axis_size", "resolve_shard_map", "install",
     "make_mesh", "named_sharding", "pspec", "mesh_axis_sizes",
-    "host_device_count",
+    "host_device_count", "detect_hierarchy_size",
 ]
 
 
@@ -166,6 +166,32 @@ def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
     """``{axis: size}`` of a live Mesh (statusz / observability)."""
     return {a: int(s) for a, s in zip(mesh.axis_names,
                                       mesh.devices.shape)}
+
+
+def detect_hierarchy_size(devices: Optional[Sequence] = None) -> int:
+    """Devices per node for two-level collectives (comm/collectives.py).
+
+    The physical boundary hierarchical collectives care about is the
+    host: devices of one process share fast intra-node links (ICI /
+    NVLink-class), cross-process traffic rides the slower DCN tier.  So
+    the auto-detected ``hierarchy_size`` is the per-process device
+    count — when every process holds the same number of devices and
+    there is more than one process.  Single-process topologies (incl.
+    the virtual-CPU test mesh) return 1: a flat axis, no hierarchy —
+    callers treat 1 as "hierarchy off" rather than guessing a split
+    that has no physical meaning.
+    """
+    devices = list(jax.devices() if devices is None else devices)
+    if not devices:
+        return 1
+    per_proc: Dict[int, int] = {}
+    for d in devices:
+        p = int(getattr(d, "process_index", 0))
+        per_proc[p] = per_proc.get(p, 0) + 1
+    counts = set(per_proc.values())
+    if len(per_proc) <= 1 or len(counts) != 1:
+        return 1
+    return counts.pop()
 
 
 def host_device_count(n: int) -> None:

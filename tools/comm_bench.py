@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Hierarchical + quantized collectives A/B (ISSUE 18; ref: ZeRO++
+arXiv:2306.10209, EQuARX arXiv:2506.17615): the same ZeRO-2 training
+run under three gradient-wire schemes on the 8-device mesh —
+
+  flat_f32     ring reduce over the full f32 payload (the baseline)
+  flat_quant   one-level int8 wire (qgZ without the hierarchy)
+  hier_quant   two-level schedule: intra quantized RS -> inter
+               quantized exchange -> int8 gathers, bucketed overlap
+
+Stamps ``COMM_BENCH.json`` with per-arm step times and loss
+trajectories, the analytic per-device wire-bytes table (device truth:
+tree size is static, so payload bytes are deterministic — the same
+numbers the engine's ``comm_*`` counters carry), a >= ``--steps``-step
+loss-parity block, and the two bit-exact contracts pinned at zero
+mismatches:
+
+  * qwZ trajectory identity — routing the stage-3 weight gather
+    through the hierarchy must not move the loss AT ALL vs the flat
+    int8 gather (the codes are made once, before any hop), and
+  * the ``exact`` codec through the two-level schedule must be
+    bit-equal to ``pmean`` on integer-valued data.
+
+Gated rows (``BENCH_BASELINE.json`` via ``tools/bench_gate.py``):
+``wire.ratio_vs_f32`` >= 3.5, both mismatch counts == 0, and the
+parity window must actually span >= 50 steps.  Step TIME is stamped
+but not gated on CPU: 8 virtual devices share one host core, so the
+quantize fan-out costs here what the wire saves on a real fabric.
+
+    python tools/comm_bench.py --cpu --json-out COMM_BENCH.json
+    python tools/comm_bench.py --cpu --quick          # smoke (12 steps)
+"""
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORLD = 8
+AXIS = "data"
+
+
+def _mlp_loss(params, batch):
+    import jax.numpy as jnp
+
+    h = jnp.tanh(batch["x"] @ params["w1"] + params["b1"])
+    pred = h @ params["w2"] + params["b2"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _build(zero, comm, hidden):
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as dstpu
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    params = {"w1": jax.random.normal(k1, (16, hidden)) * 0.3,
+              "b1": jnp.zeros((hidden,)),
+              "w2": jax.random.normal(k2, (hidden, 4)) * 0.3,
+              "b2": jnp.zeros((4,))}
+    cfg = {"train_micro_batch_size_per_gpu": 8,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+           "mesh": {AXIS: WORLD}, "zero_optimization": zero}
+    if comm is not None:
+        cfg["comm"] = comm
+    engine, _, _, _ = dstpu.initialize(
+        loss_fn=_mlp_loss, params=params, config=cfg)
+    return engine
+
+
+def _run_arm(eng, batch, steps):
+    losses, times = [], []
+    for i in range(steps):
+        t0 = time.perf_counter()
+        losses.append(float(eng.train_batch(batch)))  # float() syncs
+        if i >= 2:  # first steps carry compile
+            times.append(time.perf_counter() - t0)
+    times.sort()
+    return {
+        "steps": steps,
+        "first_loss": round(losses[0], 6),
+        "final_loss": round(losses[-1], 6),
+        "learned": losses[-1] < losses[0],
+        "step_ms_p50": round(1e3 * times[len(times) // 2], 3),
+        "step_ms_mean": round(1e3 * sum(times) / len(times), 3),
+    }, losses
+
+
+def _rel_gap(a, b):
+    return abs(a - b) / max(abs(b), 1e-9)
+
+
+def _bit_exact_checks(qwz_steps):
+    """The two zero-tolerance contracts, counted as mismatches."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.comm import collectives as C
+    from deepspeed_tpu.topology import MeshSpec
+
+    # qwZ trajectory identity: flat int8 gather vs two-hop hpZ gather
+    rng = np.random.default_rng(0)
+    batch = {"x": jnp.asarray(rng.normal(size=(64, 16)), jnp.float32),
+             "y": jnp.asarray(rng.normal(size=(64, 4)), jnp.float32)}
+    flat = _build({"stage": 3, "zero_quantized_weights": True},
+                  {"hierarchy_size": 1}, hidden=32)
+    hier = _build({"stage": 3, "zero_quantized_weights": True},
+                  {"hierarchy_size": 2}, hidden=32)
+    lf = [float(flat.train_batch(batch)) for _ in range(qwz_steps)]
+    lh = [float(hier.train_batch(batch)) for _ in range(qwz_steps)]
+    qwz_mism = sum(a != b for a, b in zip(lf, lh))
+
+    # exact codec vs pmean on integer-valued data (bit-equal: every
+    # arm is a SEPARATE jitted call compared host-side — subtracting
+    # two collective pipelines inside one jit lets XLA reassociate
+    # across them and manufactures ~1-ulp phantom diffs)
+    ms = MeshSpec.build({AXIS: WORLD})
+    x = jnp.asarray(rng.integers(-512, 512, size=(WORLD, 4096)),
+                    jnp.float32)
+    h = C.Hierarchy(WORLD, 2)
+
+    def sharded(f):
+        def body(loc):
+            return f(loc[0])[None]
+
+        return jax.shard_map(body, mesh=ms.mesh, in_specs=P(AXIS),
+                             out_specs=P(AXIS), check_vma=False)(x)
+
+    ref = np.asarray(sharded(lambda v: jax.lax.pmean(v, AXIS)))
+    got = np.asarray(sharded(
+        lambda v: C.hierarchical_all_reduce(v, AXIS, h, codec="exact")))
+    return {
+        "qwz_trajectory_mismatches": int(qwz_mism),
+        "qwz_compared_steps": qwz_steps,
+        "exact_codec_elem_mismatches": int((ref != got).sum()),
+        "exact_codec_compared_elems": int(ref.size),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--quick", action="store_true",
+                    help="12-step smoke (the stamped parity window "
+                         "then fails the >= 50-step gate by design)")
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--hidden", type=int, default=512,
+                    help="MLP width; 512 -> 10756 params, 3 group-codec"
+                         " buckets at bucket_mb=0.015625")
+    ap.add_argument("--json-out",
+                    default=os.path.join(REPO, "COMM_BENCH.json"))
+    args = ap.parse_args()
+
+    if args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from deepspeed_tpu.utils.evidence import atomic_write_json
+
+    if len(jax.devices()) != WORLD:
+        print(f"comm_bench: need {WORLD} devices, have "
+              f"{len(jax.devices())}", file=sys.stderr)
+        return 1
+
+    steps = 12 if args.quick else args.steps
+    comm_q = {"hierarchy_size": 1, "codec": "group",
+              "bucket_mb": 0.015625}
+    comm_h = dict(comm_q, hierarchy_size=2)
+    arms = {
+        "flat_f32": ({"stage": 2}, None),
+        "flat_quant": ({"stage": 2, "zero_quantized_gradients": True},
+                       comm_q),
+        "hier_quant": ({"stage": 2, "zero_quantized_gradients": True},
+                       comm_h),
+    }
+
+    rng = np.random.default_rng(1)
+    import jax.numpy as jnp
+
+    batch = {"x": jnp.asarray(rng.normal(size=(64, 16)), jnp.float32),
+             "y": jnp.asarray(rng.normal(size=(64, 4)), jnp.float32)}
+
+    out_arms, trajs, wire = {}, {}, None
+    for name, (zero, comm) in arms.items():
+        eng = _build(zero, comm, args.hidden)
+        row, losses = _run_arm(eng, batch, steps)
+        info = eng.comm_info()
+        if info is not None:
+            row["comm_info"] = info
+            if name == "hier_quant":
+                wire = info["wire"]
+        out_arms[name] = row
+        trajs[name] = losses
+        print(f"comm_bench: {name:10s} final_loss "
+              f"{row['final_loss']:.6f}  step p50 "
+              f"{row['step_ms_p50']:.1f} ms")
+
+    f32 = trajs["flat_f32"]
+    parity = {
+        "steps": steps,
+        "flat_quant_final_rel_gap": round(
+            _rel_gap(trajs["flat_quant"][-1], f32[-1]), 6),
+        "hier_quant_final_rel_gap": round(
+            _rel_gap(trajs["hier_quant"][-1], f32[-1]), 6),
+        "hier_vs_flat_quant_max_rel_gap": round(
+            max(_rel_gap(a, b) for a, b in
+                zip(trajs["hier_quant"], trajs["flat_quant"])), 6),
+        "all_arms_learned": all(r["learned"] for r in out_arms.values()),
+    }
+    bit_exact = _bit_exact_checks(qwz_steps=4)
+
+    doc = {
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(),
+        "world": WORLD,
+        "hidden": args.hidden,
+        "codec": comm_h["codec"],
+        "bucket_mb": comm_h["bucket_mb"],
+        "arms": out_arms,
+        "wire": wire,
+        "loss_parity": parity,
+        "bit_exact": bit_exact,
+    }
+    atomic_write_json(doc, args.json_out)
+    print(f"comm_bench: wire ratio_vs_f32 "
+          f"{(wire or {}).get('ratio_vs_f32', 0.0):.3f}  "
+          f"hier final rel gap {parity['hier_quant_final_rel_gap']}  "
+          f"qwz mismatches {bit_exact['qwz_trajectory_mismatches']}  "
+          f"exact-codec mismatches "
+          f"{bit_exact['exact_codec_elem_mismatches']}")
+    print("→", args.json_out)
+    ok = ((wire or {}).get("ratio_vs_f32", 0.0) >= 3.5
+          and bit_exact["qwz_trajectory_mismatches"] == 0
+          and bit_exact["exact_codec_elem_mismatches"] == 0
+          and parity["all_arms_learned"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -309,6 +309,15 @@ def render(status: dict, health: dict | None = None,
                  f"  stalls {zi.get('stream_stalls', 0)}"
                  f" ({zi.get('stream_stall_s', 0.0):.2f}s)"
                  f"  {zi.get('bytes_uploaded', 0) / 1e6:.0f} MB up")
+    cm = status.get("comm")
+    if cm:
+        L.append(f"comm  int8 wire {cm.get('bytes_on_wire_int8', 0) / 1e6:.1f}"
+                 f" MB (f32 {cm.get('bytes_on_wire_f32', 0) / 1e6:.1f} MB,"
+                 f" x{cm.get('compression_ratio', 0.0):.2f})"
+                 f"  leaves {cm.get('leaves_quantized', 0)}q"
+                 f"/{cm.get('leaves_exact', 0)}x"
+                 f"  relerr {cm.get('max_rel_err', 0.0):.1e}"
+                 f"<{cm.get('serving_rtol', 0.0):g}")
     dp = status.get("devprof", {})
     if dp.get("enabled"):
         ds = dp.get("device_seconds", {})

@@ -170,6 +170,16 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_serving.py --cpu \
   --kernels --requests 16 --new-tokens 32 --cpu-dim 256 --cpu-layers 2 \
   --json-out "$REPO/KERNEL_SERVING_BENCH.json" >/dev/null 2>&1 || true
 
+# hierarchical + quantized collectives A/B: the same ZeRO-2 training
+# run under three gradient-wire schemes (flat f32 / flat int8 /
+# two-level hierarchical int8) on the 8-device mesh — per-arm step
+# times, the analytic wire-bytes table (ratio_vs_f32 >= 3.5), a
+# 60-step loss-parity window, and the two zero-tolerance bit-exact
+# contracts (qwZ trajectory identity, exact codec == pmean).  Stamps
+# COMM_BENCH.json, gated by bench_gate below.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/comm_bench.py \
+  --cpu --json-out "$REPO/COMM_BENCH.json" >/dev/null 2>&1 || true
+
 # static analysis: the four dstpu-lint pass families (hot-path
 # host-sync lint, lock-order/scope, page lifecycle, surface parity
 # incl. the Chrome-trace pairing check against the selftest stamp
