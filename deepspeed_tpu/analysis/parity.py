@@ -57,6 +57,7 @@ CONFIG_BLOCKS = {
     "IncidentsConfig": "incidents",
     "DevprofConfig": "devprof",
     "MeshConfig": "mesh",
+    "ObsWireConfig": "obs_wire",
 }
 
 # metric families the citation scan is anchored to: a doc token is only
@@ -66,7 +67,7 @@ METRIC_FAMILIES = (
     "serving_", "prefix_cache_", "spec_", "kv_tier_", "slo_",
     "fleet_", "autoscale_", "zi_", "pstream_", "aio_",
     "tier_reader_", "comm_", "infinity_", "history_", "incident_",
-    "devprof_",
+    "devprof_", "obswire_",
 )
 # bench-evidence JSON namespaces and row labels that share a family
 # prefix but are not registry metrics (cited next to the metrics in

@@ -1433,6 +1433,88 @@ class DevprofConfig:
 
 
 @dataclasses.dataclass
+class ObsWireConfig:
+    """Remote observability wire block (no reference analogue; see
+    :mod:`deepspeed_tpu.obs_wire`).
+
+    Governs the **scrape plane**: `RemoteReplica` pollers that read a
+    replica's ``/statusz``/``/metrics``/``/historyz``/``/tracez`` HTTP
+    surface from another process and fold the snapshots into the fleet
+    rollups. ``poll_interval_s`` paces the scrape loop; ``timeout_s``
+    bounds each HTTP request; ``retries``/``backoff_s`` drive
+    :func:`~deepspeed_tpu.faults.retry_with_backoff` around each
+    scrape. Staleness hysteresis: a replica whose last successful
+    scrape is older than ``stale_after_s`` reads STALE, older than
+    ``lost_after_s`` reads LOST (last-known snapshot retained either
+    way); ``fresh_after`` consecutive successful scrapes are required
+    to return to FRESH. ``offset_probes`` sets the min-RTT sample
+    count for the cross-process clock-offset estimator used when
+    merging ``/tracez`` segments.
+    """
+
+    enabled: bool = False
+    poll_interval_s: float = 1.0         # scrape loop cadence
+    timeout_s: float = 2.0               # per-HTTP-request budget
+    retries: int = 2                     # attempts per scrape
+    backoff_s: float = 0.05              # retry backoff base (doubles)
+    stale_after_s: float = 5.0           # last-ok age => STALE
+    lost_after_s: float = 15.0           # last-ok age => LOST
+    fresh_after: int = 2                 # ok scrapes to re-enter FRESH
+    offset_probes: int = 8               # min-RTT clock-offset samples
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ObsWireConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        c = cls(**{k: v for k, v in d.items() if k in known})
+        c.poll_interval_s = float(c.poll_interval_s)
+        c.timeout_s = float(c.timeout_s)
+        c.retries = int(c.retries)
+        c.backoff_s = float(c.backoff_s)
+        c.stale_after_s = float(c.stale_after_s)
+        c.lost_after_s = float(c.lost_after_s)
+        c.fresh_after = int(c.fresh_after)
+        c.offset_probes = int(c.offset_probes)
+        if c.poll_interval_s <= 0 or c.timeout_s <= 0:
+            raise ValueError(
+                f"obs_wire.poll_interval_s and obs_wire.timeout_s must "
+                f"be positive, got {c.poll_interval_s}/{c.timeout_s}")
+        if c.retries < 1 or c.fresh_after < 1 or c.offset_probes < 1:
+            raise ValueError(
+                f"obs_wire.retries, obs_wire.fresh_after and "
+                f"obs_wire.offset_probes must be >= 1, got "
+                f"{c.retries}/{c.fresh_after}/{c.offset_probes}")
+        if c.backoff_s < 0:
+            raise ValueError(
+                f"obs_wire.backoff_s must be >= 0, got {c.backoff_s}")
+        if not 0 < c.stale_after_s <= c.lost_after_s:
+            raise ValueError(
+                f"obs_wire requires 0 < stale_after_s <= lost_after_s, "
+                f"got {c.stale_after_s}/{c.lost_after_s}")
+        return c
+
+    @classmethod
+    def coerce(cls, obj) -> "ObsWireConfig":
+        """Accept None (disabled), a bool, a dict (writing the block is
+        the opt-in, like ``history``), or an ObsWireConfig."""
+        if obj is None:
+            return cls(enabled=False)
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, bool):
+            return cls.from_dict({"enabled": obj}) if obj \
+                else cls(enabled=False)
+        if isinstance(obj, dict):
+            d = dict(obj)
+            d.setdefault("enabled", True)   # passing a block opts in
+            if not d["enabled"]:
+                return cls(enabled=False)
+            return cls.from_dict(d)
+        raise TypeError(
+            f"obs_wire must be a bool, dict or ObsWireConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class PrecisionConfig:
     """ref: deepspeed/runtime/fp16/loss_scaler.py + config fp16/bf16 blocks."""
 
@@ -1604,6 +1686,8 @@ class Config:
         default_factory=IncidentsConfig)
     devprof: DevprofConfig = dataclasses.field(
         default_factory=DevprofConfig)
+    obs_wire: ObsWireConfig = dataclasses.field(
+        default_factory=ObsWireConfig)
     raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     # ---------------------------------------------------------------- parse
@@ -1764,6 +1848,9 @@ class Config:
         if "devprof" in d:
             # coerce, not from_dict: writing the block IS the opt-in
             c.devprof = DevprofConfig.coerce(d["devprof"])
+        if "obs_wire" in d:
+            # coerce, not from_dict: writing the block IS the opt-in
+            c.obs_wire = ObsWireConfig.coerce(d["obs_wire"])
         return c
 
     @classmethod

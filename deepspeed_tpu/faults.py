@@ -75,6 +75,15 @@ module provides both halves of proving that:
               ``latency`` = a slow cold-start (the spawn sleeps
               ``latency_s`` before the factory runs — visible in the
               ``autoscale_cold_start_seconds`` histogram).
+  scrape      the :class:`~deepspeed_tpu.obs_wire.RemoteReplica` scrape
+              loop (one opportunity per HTTP scrape attempt; key = the
+              remote replica id, so ``match=`` targets one).  Mode
+              ``error`` fails the scrape (counted in
+              ``obswire_scrape_errors``, retried with backoff, and — if
+              persistent — walks the replica FRESH→STALE→LOST); mode
+              ``latency`` delays the scrape by ``latency_s`` capped at
+              the configured ``obs_wire.timeout_s`` so an injected
+              stall can never wedge the poll loop.
   ========== ===========================================================
 
 - **Degradation helpers**: :func:`retry_with_backoff` (the bounded
@@ -127,13 +136,14 @@ class FatalStreamError(RuntimeError):
 
 
 SUBSYSTEMS = ("aio_read", "aio_write", "kv_corrupt", "slot",
-              "sync_read", "burst", "replica", "scale", "fabric")
+              "sync_read", "burst", "replica", "scale", "fabric",
+              "scrape")
 MODES = ("error", "latency", "degrade")
 # subsystems whose opportunities carry a key a `match` filter can test
 # (aio ops and bursts are anonymous — a match there would validate
 # fine and silently never fire, so it is rejected at rule build)
 _KEYED_SUBSYSTEMS = ("kv_corrupt", "slot", "sync_read", "replica",
-                     "scale", "fabric")
+                     "scale", "fabric", "scrape")
 
 
 @dataclasses.dataclass

@@ -88,6 +88,8 @@ from deepspeed_tpu.history import (NULL_HISTORY, MetricHistory,
                                    history_rollup)
 from deepspeed_tpu.incidents import NULL_INCIDENTS, IncidentManager
 from deepspeed_tpu.kv_fabric import KVFabric
+from deepspeed_tpu.obs_wire import (WireSchemaError,
+                                    wire_stamp as obs_wire_stamp)
 from deepspeed_tpu.inference.prefix_cache import (matchable_pages,
                                                   page_keys)
 from deepspeed_tpu.inference.serving import (EngineClosed, RequestFailed,
@@ -189,6 +191,47 @@ class Replica:
             self.state = state
             self.state_since = time.perf_counter()
 
+    # ------------------------------------------------- ReplicaSource
+    # (the duck-typed contract shared with obs_wire.RemoteReplica, so
+    # the router's statusz/SLO/history rollups aggregate an in-process
+    # engine and a scraped child through the same calls)
+    def statusz_row(self, now: float) -> Dict[str, Any]:
+        """This replica's row in the fleet ``/statusz`` table."""
+        e = self.engine
+        row = {
+            "replica": self.id,
+            "state": self.state,
+            "role": self.role,
+            "version": str(self.version),
+            "state_age_s": round(now - self.state_since, 3),
+            "queue_depth": len(e.queue),
+            "active_slots": sum(1 for s in e.slots
+                                if s is not None),
+            "assigned": len(self.assigned),
+            "shed": e._n_shed,
+            "failed": e._n_failed,
+            "shed_rate": round(
+                e._n_shed / e._n_submitted, 4)
+            if e._n_submitted else 0.0,
+            "affinity_hits": self.affinity_hits,
+            "digest_pages": len(self.digest),
+            "mesh": (e.mesh_info() if hasattr(e, "mesh_info")
+                     else {"sharded": False, "devices": 1,
+                           "axes": {}, "tp": 1, "ep": 1}),
+            "reasons": self.health_reasons,
+        }
+        if self.stall_until > now:
+            row["stalled_for_s"] = round(self.stall_until - now, 3)
+        return row
+
+    def slo_snapshot(self, now: Optional[float] = None
+                     ) -> Dict[str, Any]:
+        return self.engine.slo_tracker.snapshot(now=now)
+
+    def history_snapshot(self) -> Optional[Dict[str, Any]]:
+        h = self.engine.history
+        return h.snapshot() if h.enabled else None
+
 
 class FleetRouter:
     """Route open-loop traffic across N in-process serving replicas.
@@ -224,6 +267,10 @@ class FleetRouter:
             if rid in self.replicas:
                 raise ValueError(f"duplicate replica id {rid!r}")
             self.replicas[rid] = Replica(rid, eng)
+        # out-of-process replicas attached by scrape URL
+        # (attach_remote); observability-plane only — never routed to
+        self.remotes: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
         r0 = engines[0]
         self.page_size = r0.page_size
         self._affinity = self.cfg.affinity and \
@@ -1285,6 +1332,21 @@ class FleetRouter:
                 # HEALTHY — the hysteresis that stops flapping
                 rep.set_state(DEGRADED)
                 rep.healthy_streak = 0
+        # out-of-process replicas: drive their scrape loops.  A dead
+        # child is absorbed into the staleness machine (FRESH→STALE→
+        # LOST) — never an exception out of the router step.  A
+        # schema-major mismatch IS an exception inside poll(), but a
+        # deployment bug must not wedge the poller either: log loudly
+        # once and pin the remote LOST.
+        for rem in self.remotes.values():
+            try:
+                rem.maybe_poll()
+            except WireSchemaError as e:
+                if rem.state != "LOST":
+                    logger.error("fleet: remote %s speaks an "
+                                 "incompatible wire schema: %s",
+                                 rem.id, e)
+                rem.force_lost(f"wire_schema: {e}")
 
     # -------------------------------------------------------------- step
     def _harvest(self, rep: Replica) -> List[Any]:
@@ -1456,32 +1518,13 @@ class FleetRouter:
         states: Dict[str, int] = {}
         for rep in self.replicas.values():
             states[rep.state] = states.get(rep.state, 0) + 1
-            e = rep.engine
-            n_aff = rep.affinity_hits
-            row = {
-                "replica": rep.id,
-                "state": rep.state,
-                "role": rep.role,
-                "version": str(rep.version),
-                "state_age_s": round(now - rep.state_since, 3),
-                "queue_depth": len(e.queue),
-                "active_slots": sum(1 for s in e.slots
-                                    if s is not None),
-                "assigned": len(rep.assigned),
-                "shed": e._n_shed,
-                "failed": e._n_failed,
-                "shed_rate": round(
-                    e._n_shed / e._n_submitted, 4)
-                if e._n_submitted else 0.0,
-                "affinity_hits": n_aff,
-                "digest_pages": len(rep.digest),
-                "mesh": (e.mesh_info() if hasattr(e, "mesh_info")
-                         else {"sharded": False, "devices": 1,
-                               "axes": {}, "tp": 1, "ep": 1}),
-                "reasons": rep.health_reasons,
-            }
-            if rep.stall_until > now:
-                row["stalled_for_s"] = round(rep.stall_until - now, 3)
+            reps.append(rep.statusz_row(now))
+        # out-of-process replicas ride the same table: their rows come
+        # from the last-known scrape plus the scrape-plane truth
+        # (state/age/errors) — a LOST child stays visible, flagged
+        for rem in self.remotes.values():
+            row = rem.statusz_row()
+            states[row["state"]] = states.get(row["state"], 0) + 1
             reps.append(row)
         routed = self._c_affinity.value + self._c_least_loaded.value
         fleet: Dict[str, Any] = {
@@ -1554,12 +1597,20 @@ class FleetRouter:
         # folded in: the fleet "lifetime" counters never shrink at a
         # failover or a scale-down.  Versions ride along so the rollup
         # carries the per-version view a rolling update watches.
-        snaps = [(rep.engine.slo_tracker.snapshot(now=now), rep.version,
-                  rep.role)
+        snaps = [(rep.slo_snapshot(now=now), rep.version, rep.role)
                  for rep in self.replicas.values()]
         snaps.extend((s, v, None) for s, v in self._retired_slo)
+        # remote replicas fold in through their last-known scraped
+        # statusz["slo"] — exactly the SLOTracker.snapshot() shape, so
+        # fleet_rollup consumes it unchanged (None while never scraped
+        # is filtered by the rollup like a disabled tracker)
+        for rem in self.remotes.values():
+            snaps.append((rem.slo_snapshot(),
+                          (rem.last_statusz or {}).get(
+                              "weights_version"), None))
         status = {
             "schema_version": 1,
+            **obs_wire_stamp(),
             "engine": "FleetRouter",
             "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "uptime_s": round(now - self._t_start, 3),
@@ -1593,9 +1644,48 @@ class FleetRouter:
         reasons = [f"{rep.id}:{rep.state}"
                    for rep in self.replicas.values()
                    if rep.state != HEALTHY]
-        return {"alive": True, "ready": ready, "degraded": degraded,
-                "reasons": reasons, "replicas": states,
-                "in_flight": len(self.requests)}
+        h = {**obs_wire_stamp(),
+             "alive": True, "ready": ready, "degraded": degraded,
+             "reasons": reasons, "replicas": states,
+             "in_flight": len(self.requests)}
+        if self.remotes:
+            h["remotes"] = {rem.id: rem.state
+                            for rem in self.remotes.values()}
+        return h
+
+    # ---------------------------------------------------- remote plane
+    def attach_remote(self, remote=None, *, url: Optional[str] = None,
+                      rid: Optional[str] = None, cfg=None):
+        """Attach an out-of-process replica by scrape URL (or a
+        pre-built :class:`~deepspeed_tpu.obs_wire.RemoteReplica`).
+
+        Observability-plane only: the remote's statusz/SLO/history
+        snapshots fold into the fleet rollups and its staleness state
+        rides the health poll, but no traffic is routed to it — the
+        transport split is a later PR.  The router's tracer is shared
+        so a LOST transition lands in the incident stream."""
+        from deepspeed_tpu.obs_wire import RemoteReplica
+        if remote is None:
+            if url is None:
+                raise ValueError(
+                    "attach_remote needs a RemoteReplica or url=")
+            rid = rid or f"remote{len(self.remotes)}"
+            remote = RemoteReplica(url, rid, cfg=cfg,
+                                   registry=self.registry,
+                                   tracer=self.tracer)
+        if remote.id in self.remotes or remote.id in self.replicas:
+            raise ValueError(f"duplicate replica id {remote.id!r}")
+        if remote.tracer is None:
+            remote.tracer = self.tracer
+        self.remotes[remote.id] = remote
+        return remote
+
+    def detach_remote(self, rid: str):
+        """Drop a remote from the rollups (no-op if absent)."""
+        rem = self.remotes.pop(rid, None)
+        if rem is not None:
+            rem.close()
+        return rem
 
     def historyz(self) -> Dict[str, Any]:
         """The fleet ``/historyz`` document: the router's own ring set
@@ -1605,14 +1695,20 @@ class FleetRouter:
         bucket, percentile series take the MAX — the same discipline
         :func:`~deepspeed_tpu.slo.fleet_rollup` applies to SLO state).
         Host-side bookkeeping only, safe to poll."""
-        rep_snaps = [rep.engine.history.snapshot()
+        rep_snaps = [rep.history_snapshot()
                      for rep in self.replicas.values()
-                     if rep.state != DEAD
-                     and rep.engine.history.enabled]
+                     if rep.state != DEAD]
+        # remote last-known history snapshots ride the same rollup
+        # (history_rollup filters the Nones a never-scraped or
+        # history-disabled remote contributes)
+        rep_snaps.extend(rem.history_snapshot()
+                         for rem in self.remotes.values())
         return {
+            **obs_wire_stamp(),
             "history": self.history.snapshot(),
             "incidents": self.incident_mgr.snapshot(),
-            "replica_rollup": history_rollup(rep_snaps),
+            "replica_rollup": history_rollup(
+                [s for s in rep_snaps if s]),
         }
 
     # --------------------------------------------------------- lifecycle
@@ -1629,6 +1725,8 @@ class FleetRouter:
                 rep.engine.shutdown()
             except Exception:
                 logger.exception("fleet: replica %s shutdown", rep.id)
+        for rem in self.remotes.values():
+            rem.close()
         ex = self._tel_exporter
         if ex is not None:
             try:

@@ -425,7 +425,13 @@ def history_rollup(snapshots) -> Dict[str, Any]:
     sum of replica queue depths) and take the MAX for percentile
     series (the alert question is "how bad is the worst replica").
     Disabled snapshots pass through; annotations concatenate in time
-    order."""
+    order.
+
+    Snapshots need not come from in-process objects: the scrape plane
+    (:mod:`deepspeed_tpu.obs_wire`) feeds this the ``history`` block
+    of a remote replica's ``/historyz`` document — same shape over the
+    wire, and a never-scraped remote's ``None`` filters out here like
+    a disabled ring set."""
     snaps = [s for s in snapshots if s and s.get("enabled")]
     if not snaps:
         return {"enabled": False}

@@ -58,6 +58,10 @@ TRIGGER_PHASES: Dict[str, str] = {
     "rollout_rolled_back": "rollback",
     "autoscale_up_failed": "scale_failure",
     "watchdog_fired": "watchdog",
+    # a scraped out-of-process replica aged past lost_after_s (or
+    # spoke an incompatible wire schema) — emitted once per outage by
+    # obs_wire.RemoteReplica on the router's tracer
+    "remote_lost": "remote_lost",
 }
 
 

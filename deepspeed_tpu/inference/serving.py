@@ -936,6 +936,13 @@ class ServingEngine:
             if self._devprof_on:
                 self._tel_exporter.register_provider("profilez",
                                                      self.profilez)
+            if self._trace_on:
+                # /tracez?since= — incremental flight-recorder drain
+                # for the remote scrape plane (obs_wire)
+                from deepspeed_tpu.obs_wire import tracez_provider
+                self._tel_exporter.register_provider(
+                    "tracez", tracez_provider(
+                        self.tracer.recorder, replica=self.replica_id))
 
     # (the `stats` deprecation shim from PR 2/PR 6 was removed on its
     # announced schedule — read `engine.registry.snapshot()` instead)
@@ -3000,8 +3007,10 @@ class ServingEngine:
         cnt_hits = int(self._c_pc_hits.value)
         cnt_miss = int(self._c_pc_misses.value)
         pt = int(self._c_pc_prompt_tokens.value)
+        from deepspeed_tpu.obs_wire import wire_stamp
         status: Dict[str, Any] = {
             "schema_version": 1,
+            **wire_stamp(),
             "engine": type(self).__name__,
             "replica": self.replica_id,
             "weights_version": _req_key(self.weights_version),
@@ -3071,6 +3080,13 @@ class ServingEngine:
             },
             "incidents": self.incident_mgr.snapshot(),
             "devprof": self.devprof.statusz_block(),
+            # the BOUND port (meaningful when http_port=0 asked for an
+            # ephemeral bind): how a parent process that spawned this
+            # replica learns where to scrape it
+            "telemetry": {
+                "http_port": self._tel_exporter.port
+                if self._tel_exporter is not None else None,
+            },
         }
         if self.comm_placement is not None:
             # quantized TP weight placement (comm.quantized_serving):
@@ -3148,8 +3164,10 @@ class ServingEngine:
         """Liveness/readiness for a fleet supervisor probe.  ``ready``
         goes false after :meth:`shutdown` or once an attached
         watchdog has fired (the HTTP endpoint turns that into a 503)."""
+        from deepspeed_tpu.obs_wire import wire_stamp
         now = time.perf_counter()
         h: Dict[str, Any] = {
+            **wire_stamp(),
             "alive": True,
             "ready": not self._closed,
             "replica": self.replica_id,
@@ -3219,7 +3237,9 @@ class ServingEngine:
         plus recent incident-bundle metadata — the machine-readable
         feed behind ``dstpu_top``'s sparklines and incident ticker.
         Host-side bookkeeping only, safe to poll."""
+        from deepspeed_tpu.obs_wire import wire_stamp
         return {
+            **wire_stamp(),
             "history": self.history.snapshot(),
             "incidents": self.incident_mgr.snapshot(),
         }

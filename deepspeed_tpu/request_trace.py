@@ -327,21 +327,34 @@ def event_to_dict(e: Event) -> Dict[str, Any]:
 
 
 def write_jsonl(events: List[Event], path: str, reason: str = "export",
-                dropped: int = 0) -> None:
-    """Atomic JSONL write: meta header line + one line per event."""
+                dropped: int = 0, meta: Optional[Dict[str, Any]] = None
+                ) -> None:
+    """Atomic JSONL write: meta header line + one line per event.
+    ``meta`` adds fields to the header — the wire plane stamps each
+    per-process segment's replica tag and measured clock offset there,
+    which is where ``trace_report --merge`` reads them back."""
     from deepspeed_tpu.utils.evidence import atomic_write_text
 
     lines = [json.dumps({"flight_recorder": {
         "reason": reason, "pid": os.getpid(),
         "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
-        "events": len(events), "dropped_events": int(dropped)}})]
+        "events": len(events), "dropped_events": int(dropped),
+        **(meta or {})}})]
     lines.extend(json.dumps(event_to_dict(e)) for e in events)
     atomic_write_text("\n".join(lines) + "\n", path)
 
 
+def events_from_dicts(dicts: List[Dict[str, Any]]) -> List[Event]:
+    """Inverse of :func:`event_to_dict`: serialized event dicts (a
+    JSONL export's lines, or a ``/tracez`` segment's ``events`` array)
+    back into tuples."""
+    return [(int(d["t_ns"]), d.get("req"), int(d.get("slot", -1)),
+             d["phase"], d.get("attrs")) for d in dicts]
+
+
 def read_jsonl(path: str) -> List[Event]:
     """Parse a JSONL export back into event tuples (meta lines skip)."""
-    out: List[Event] = []
+    dicts: List[Dict[str, Any]] = []
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -350,10 +363,8 @@ def read_jsonl(path: str) -> List[Event]:
             d = json.loads(line)
             if "flight_recorder" in d:
                 continue
-            out.append((int(d["t_ns"]), d.get("req"),
-                        int(d.get("slot", -1)), d["phase"],
-                        d.get("attrs")))
-    return out
+            dicts.append(d)
+    return events_from_dicts(dicts)
 
 
 # ---------------------------------------------------------- chrome export

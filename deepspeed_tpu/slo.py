@@ -453,7 +453,11 @@ def fleet_rollup(snapshots, versions=None, roles=None) -> Dict[str, Any]:
     replica burning its budget", not the average that would let one
     sick replica hide behind two healthy ones), and ``alert_active``
     ORs.  Disabled snapshots pass through; zero-traffic tiers keep the
-    1.0-attainment contract.
+    1.0-attainment contract.  Snapshots may also arrive over the wire:
+    a remote replica's scraped ``statusz["slo"]`` block
+    (:mod:`deepspeed_tpu.obs_wire`) is exactly this shape, and a
+    never-scraped remote contributes ``None``, filtered like a
+    disabled tracker.
 
     ``versions``: a weight-version label per snapshot (aligned with
     ``snapshots``).  When given and more than one distinct version is

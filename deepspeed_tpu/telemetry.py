@@ -604,14 +604,17 @@ class TelemetryExporter:
         include ``"ready": false`` to force a 503; historyz serves the
         metric-history rings + recent incident metadata); ``requestz``
         takes the request-id string; ``profilez`` takes the optional
-        ``?capture_s=`` string (None for a plain devprof snapshot).
+        ``?capture_s=`` string (None for a plain devprof snapshot);
+        ``tracez`` takes the ``?since=`` cursor string ("0" when
+        absent) and returns an incremental flight-recorder segment.
         Re-registering a name replaces it (the engine owns its
         endpoints)."""
         if name not in ("statusz", "healthz", "requestz", "historyz",
-                        "profilez"):
+                        "profilez", "tracez"):
             raise ValueError(
                 f"unknown introspection provider {name!r} — expected "
-                "statusz, healthz, historyz, profilez or requestz")
+                "statusz, healthz, historyz, profilez, tracez or "
+                "requestz")
         self._providers[name] = fn
 
     # ------------------------------------------------------------- http
@@ -665,6 +668,10 @@ class TelemetryExporter:
                         cs = parse_qs(u.query).get(
                             "capture_s", [None])[0]
                         self._send_json(providers["profilez"](cs))
+                    elif route == "/tracez" and "tracez" in providers:
+                        since = parse_qs(u.query).get(
+                            "since", ["0"])[0]
+                        self._send_json(providers["tracez"](since))
                     elif route == "/requestz" and \
                             "requestz" in providers:
                         rid = parse_qs(u.query).get("id", [None])[0]

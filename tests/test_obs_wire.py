@@ -1,0 +1,534 @@
+"""Observability over the wire (ISSUE 19): the versioned wire schema,
+the RemoteReplica scrape client with its FRESH/STALE/LOST staleness
+machine, cross-process clock correlation + trace merging, and the fleet
+router folding remote replicas into its rollups.
+
+Fast lane: schema/config units, the staleness walk on a fake clock, the
+offset estimator against an injected stamp skew, trace merging, and a
+RemoteReplica scraping a REAL engine's ephemeral-port HTTP exporter
+in-process.  Slow lane: a real subprocess replica (own interpreter, own
+engine) scraped end-to-end, its injected monotonic skew recovered
+within the estimator's error bound, then SIGKILLed — the scraper must
+walk to LOST with the last-known snapshot retained and the poll loop
+must never wedge on the corpse."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu import faults
+from deepspeed_tpu.config import Config, ObsWireConfig
+from deepspeed_tpu.faults import FaultPlan
+from deepspeed_tpu.fleet import fleet_router
+from deepspeed_tpu.inference.serving import serving_engine
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.obs_wire import (FRESH, LOST, OBS_WIRE_SCHEMA,
+                                    OBS_WIRE_SCHEMA_STR, STALE,
+                                    RemoteReplica, WireSchemaError,
+                                    check_wire_schema,
+                                    merge_trace_segments, tracez_provider,
+                                    wire_stamp)
+from deepspeed_tpu.request_trace import (RequestTracer, write_jsonl)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+KW = dict(max_batch=2, page_size=8, num_pages=16, max_seq=32,
+          prefill_bucket=8)
+
+
+@pytest.fixture(scope="module")
+def gpt2_model():
+    cfg = gpt2.GPT2Config.tiny(dim=32, n_layers=2, n_heads=2,
+                               max_seq_len=64)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear_fault_plan()
+    yield
+    faults.clear_fault_plan()
+
+
+def _cfg(**over):
+    base = dict(enabled=True, poll_interval_s=0.01, timeout_s=2.0,
+                retries=2, backoff_s=0.0, stale_after_s=0.3,
+                lost_after_s=0.6, fresh_after=2, offset_probes=4)
+    base.update(over)
+    return ObsWireConfig(**base)
+
+
+# ------------------------------------------------------------- config
+def test_obs_wire_config_validation():
+    c = ObsWireConfig.coerce({"poll_interval_s": 0.5, "retries": 3})
+    assert c.enabled and c.poll_interval_s == 0.5 and c.retries == 3
+    assert ObsWireConfig.coerce(None).enabled is False
+    assert ObsWireConfig.coerce(True).enabled is True
+    for bad in ({"poll_interval_s": 0}, {"timeout_s": -1},
+                {"retries": 0}, {"fresh_after": 0},
+                {"offset_probes": 0}, {"backoff_s": -0.1},
+                {"stale_after_s": 5.0, "lost_after_s": 1.0}):
+        with pytest.raises(ValueError):
+            ObsWireConfig.coerce(bad)
+    c2 = Config.from_dict({"obs_wire": {"stale_after_s": 2.0}})
+    assert c2.obs_wire.enabled and c2.obs_wire.stale_after_s == 2.0
+    assert Config.from_dict({}).obs_wire.enabled is False
+
+
+# ------------------------------------------------------------- schema
+def test_wire_stamp_and_schema_check():
+    d = wire_stamp()
+    assert d["wire_schema"] == OBS_WIRE_SCHEMA_STR
+    assert d["t_wall"] > 0 and d["t_mono_ns"] > 0
+    assert check_wire_schema(d) == OBS_WIRE_SCHEMA
+    # minor drift both ways is fine (additive fields)
+    ok = dict(d, wire_schema=f"{OBS_WIRE_SCHEMA[0]}.99")
+    assert check_wire_schema(ok)[1] == 99
+    # major mismatch refuses loudly, naming both sides
+    with pytest.raises(WireSchemaError, match="999.0"):
+        check_wire_schema(dict(d, wire_schema="999.0"), "/statusz")
+    with pytest.raises(WireSchemaError, match="no wire_schema"):
+        check_wire_schema({"t_wall": 1.0})
+    with pytest.raises(WireSchemaError, match="malformed"):
+        check_wire_schema(dict(d, wire_schema="potato"))
+    with pytest.raises(WireSchemaError):
+        check_wire_schema(None)
+
+
+def test_tracez_provider_incremental_drain():
+    tr = RequestTracer(sample_rate=1.0)
+    tr.event("queued", req="a", slot=0)
+    tr.event("finish", req="a", slot=0)
+    prov = tracez_provider(tr.recorder, replica="r0")
+    doc = prov("0")
+    assert check_wire_schema(doc) == OBS_WIRE_SCHEMA
+    assert doc["replica"] == "r0" and doc["since"] == 0
+    assert [e["phase"] for e in doc["events"]] == ["queued", "finish"]
+    # second drain from the returned cursor ships only the delta
+    cursor = doc["total"]
+    assert prov(str(cursor))["events"] == []
+    tr.event("queued", req="b", slot=1)
+    inc = prov(str(cursor))
+    assert [e["phase"] for e in inc["events"]] == ["queued"]
+    # garbage/absent cursors degrade to a full read, never a raise
+    assert len(prov("potato")["events"]) == 3
+    assert len(prov(None)["events"]) == 3
+
+
+# ------------------------------------------- staleness state machine
+class _FakeRemote(RemoteReplica):
+    """Transport stub: serves canned wire documents or refuses."""
+
+    fail = False
+
+    def _get(self, route, query=""):
+        if self.fail:
+            raise OSError("connection refused (stub)")
+        d = wire_stamp()
+        if route == "/statusz":
+            d.update({"queue": {"depth": 2}, "active_slots": 1,
+                      "uptime_s": 9.0, "weights_version": "v1",
+                      "mesh": {"sharded": False, "devices": 1,
+                               "axes": {}, "tp": 1, "ep": 1}})
+        elif route == "/healthz":
+            d.update({"ready": True, "degraded": False, "reasons": []})
+        elif route == "/historyz":
+            d.update({"history": {"enabled": True, "series": {}}})
+        return d
+
+
+def test_staleness_walk_and_hysteresis():
+    t = [0.0]
+    tr = RequestTracer(sample_rate=1.0)
+    rem = _FakeRemote("http://stub:0", "r9", cfg=_cfg(),
+                      tracer=tr, clock=lambda: t[0])
+    # attach: unknown is STALE, and FRESH needs fresh_after=2 streak
+    assert rem.state == STALE
+    assert rem.poll(t[0]) and rem.state == STALE
+    t[0] += 0.01
+    assert rem.poll(t[0]) and rem.state == FRESH
+    # once FRESH, one recent ok keeps it
+    t[0] += 0.01
+    rem.refresh_state(t[0])
+    assert rem.state == FRESH
+    # silence past stale_after_s degrades WITHOUT a poll
+    t[0] += 0.35
+    assert rem.refresh_state(t[0]) == STALE
+    # outage past lost_after_s: LOST, last snapshot retained, one
+    # remote_lost trace event (incident trigger), not one per poll
+    rem.fail = True
+    t[0] += 0.30
+    rem.poll(t[0])
+    assert rem.state == LOST
+    assert rem.last_statusz["queue"]["depth"] == 2
+    t[0] += 0.05
+    rem.poll(t[0])
+    assert rem.state == LOST
+    _, evs = tr.recorder.events_since(0)
+    lost_evs = [e for e in evs if e[3] == "remote_lost"]
+    assert len(lost_evs) == 1
+    assert lost_evs[0][4]["replica"] == "r9"
+    # recovery re-pays the hysteresis: one good scrape is NOT enough
+    rem.fail = False
+    t[0] += 0.05
+    assert rem.poll(t[0]) and rem.state == LOST
+    t[0] += 0.01
+    assert rem.poll(t[0]) and rem.state == FRESH
+    assert rem.scrape_errors == 2
+    row = rem.statusz_row(t[0])
+    assert row["scrape_state"] == FRESH and row["scrape_errors"] == 2
+
+
+def test_force_lost_pins_until_recovery_streak():
+    t = [0.0]
+    rem = _FakeRemote("http://stub:0", "r8", cfg=_cfg(),
+                      clock=lambda: t[0])
+    rem.poll(t[0])
+    t[0] += 0.01
+    rem.poll(t[0])
+    assert rem.state == FRESH
+    rem.force_lost("wire_schema: major mismatch")
+    assert rem.state == LOST and "wire_schema" in rem.last_error
+    # a recent last_ok must NOT flap it back between polls
+    assert rem.refresh_state(t[0] + 0.01) == LOST
+    assert rem.last_statusz is not None       # snapshot retained
+    t[0] += 0.02
+    rem.poll(t[0])
+    t[0] += 0.01
+    rem.poll(t[0])
+    assert rem.state == FRESH
+
+
+# --------------------------------------------------- clock correlation
+class _SkewRemote(RemoteReplica):
+    SKEW_NS = 40_000_000
+
+    def _get(self, route, query=""):
+        d = wire_stamp()
+        d["t_mono_ns"] += self.SKEW_NS
+        return d
+
+
+def test_offset_estimator_recovers_injected_skew():
+    rem = _SkewRemote("http://stub:0", "rs", cfg=_cfg(offset_probes=8))
+    off, err = rem.estimate_clock_offset()
+    assert err >= 0
+    # in-process round trips: the min-RTT bound plus scheduling slack
+    assert abs(off - _SkewRemote.SKEW_NS) <= err + 2_000_000
+    assert rem.clock_offset_ns == off
+    row = rem.statusz_row()
+    assert row["clock_offset_ns"] == off
+    assert row["clock_offset_err_ns"] == err
+
+
+def _lifecycle(t0, req, off=0):
+    return [(t0 + off, req, 0, "queued", None),
+            (t0 + off + 1000, req, 0, "admitted", None),
+            (t0 + off + 2000, req, 0, "first_token", None),
+            (t0 + off + 3000, req, 0, "finish", None)]
+
+
+def test_merge_trace_segments_monotone_and_tagged():
+    base = 10_000_000
+    off_b = 5_000_000
+    segs = [
+        {"events": _lifecycle(base, "a"), "offset_ns": 0,
+         "err_ns": 100, "replica": "A"},
+        # B's events carry a foreign monotonic origin off_b ahead; the
+        # measured offset must bring them back onto A's axis
+        {"events": _lifecycle(base + 500, "b", off=off_b),
+         "offset_ns": off_b, "err_ns": 200, "replica": "B"},
+    ]
+    ch = merge_trace_segments(segs)
+    ts = [e["ts"] for e in ch["traceEvents"] if "ts" in e]
+    assert ts == sorted(ts)
+    offs = ch["otherData"]["clock_offsets"]
+    assert offs["B"]["offset_ns"] == off_b and offs["B"]["events"] == 4
+    assert ch["otherData"]["merged_segments"] == 2
+    tags = {(e.get("args") or {}).get("replica")
+            for e in ch["traceEvents"]}
+    assert {"A", "B"} <= tags
+    # request spans interleave on the shared axis: b's de-skewed
+    # lifecycle starts 500 ns after a's, not 5 ms later
+    req_b = [e for e in ch["traceEvents"]
+             if e.get("cat") == "request" and e.get("id") == "b"]
+    assert req_b, "request span for b missing from merged trace"
+
+
+def test_trace_report_merge_cli_roundtrip(tmp_path):
+    from tools.trace_report import load_segment, merge_traces
+
+    base = time.monotonic_ns()
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    write_jsonl(_lifecycle(base, "a"), a, meta={
+        "replica": "procA", "clock_offset_ns": 0,
+        "clock_offset_err_ns": 50})
+    write_jsonl(_lifecycle(base + 500, "b", off=7_000_000), b, meta={
+        "replica": "procB", "clock_offset_ns": 7_000_000,
+        "clock_offset_err_ns": 80})
+    evs, meta = load_segment(a)
+    assert len(evs) == 4 and meta["replica"] == "procA"
+    out = str(tmp_path / "merged.json")
+    merged, bd = merge_traces([a, b], out)
+    assert os.path.exists(out)
+    srcs = bd["summary"]["sources"]
+    assert srcs["a.jsonl"]["events"] == 4
+    assert srcs["b.jsonl"]["offset_ns"] == 7_000_000
+    ts = [e["ts"] for e in merged["traceEvents"] if "ts" in e]
+    assert ts == sorted(ts)
+    assert merged["otherData"]["clock_offsets"]["procB"][
+        "offset_ns"] == 7_000_000
+
+
+# ------------------------------------------- real HTTP, in-process end
+def _live_engine(cfg, params, **over):
+    kw = dict(KW, telemetry={"http_port": 0}, tracing=True,
+              slo=True, history=True, replica_id="eng0")
+    kw.update(over)
+    eng = serving_engine(params, cfg, **kw)
+    for i in range(3):
+        eng.submit(i, [3 + i, 5, 7], max_new_tokens=4)
+    eng.run()
+    return eng
+
+
+def test_remote_replica_scrapes_real_engine(gpt2_model, devices):
+    cfg, params = gpt2_model
+    eng = _live_engine(cfg, params)
+    try:
+        url = f"http://127.0.0.1:{eng._tel_exporter.port}"
+        # the engine's own statusz advertises the bound ephemeral port
+        assert eng.statusz()["telemetry"]["http_port"] == \
+            eng._tel_exporter.port
+        rem = RemoteReplica(url, "rA", cfg=_cfg())
+        assert rem.poll() and rem.poll()
+        assert rem.state == FRESH and rem.scrape_errors == 0
+        row = rem.statusz_row()
+        assert row["remote"] is True and row["state"] == "healthy"
+        assert row["version"] != "None"
+        assert row["mesh"]["devices"] >= 1
+        # the scraped SLO block is exactly the fleet_rollup shape
+        snap = rem.slo_snapshot()
+        assert snap["enabled"] is True
+        assert rem.history_snapshot()["enabled"] is True
+        # incremental trace drain over the wire
+        evs, meta = rem.fetch_trace(since=0)
+        phases = {e[3] for e in evs}
+        assert {"queued", "admitted", "first_token",
+                "finish"} <= phases
+        assert meta["replica"] == "eng0"
+        again, _ = rem.fetch_trace()       # cursor advanced: delta only
+        assert len(again) == 0
+        # /metrics round-trip: the Prometheus exposition parses back
+        # and carries the serving family
+        mets = rem.fetch_metrics()
+        assert any("serving_" in k for k in mets)
+        off, err = rem.estimate_clock_offset()
+        # same process, same clock: offset is bounded by the RTT error
+        # plus scheduling slack
+        assert abs(off) <= err + 2_000_000
+    finally:
+        eng.shutdown()
+
+
+def test_scrape_fault_counts_and_never_wedges(gpt2_model, devices):
+    cfg, params = gpt2_model
+    eng = _live_engine(cfg, params)
+    try:
+        url = f"http://127.0.0.1:{eng._tel_exporter.port}"
+        rem = RemoteReplica(url, "rF",
+                            cfg=_cfg(timeout_s=0.2, retries=2))
+        assert rem.poll()
+        # injected scrape errors: absorbed, counted, never raised
+        faults.install_fault_plan(FaultPlan([
+            {"subsystem": "scrape", "mode": "error", "match": "rF",
+             "count": 4}]))
+        t0 = time.monotonic()
+        assert rem.poll() is False
+        assert time.monotonic() - t0 < 2.0     # bounded, not wedged
+        assert rem.scrape_errors == 1 and rem.last_error is not None
+        # injected latency is capped at the request budget
+        faults.clear_fault_plan()
+        faults.install_fault_plan(FaultPlan([
+            {"subsystem": "scrape", "mode": "latency",
+             "latency_s": 30.0, "match": "rF", "count": 1}]))
+        t0 = time.monotonic()
+        rem.poll()
+        assert time.monotonic() - t0 < 2.0
+        faults.clear_fault_plan()
+        assert rem.poll() and rem.scrape_errors == 1
+    finally:
+        eng.shutdown()
+
+
+def test_schema_major_mismatch_rejected_loudly(gpt2_model, devices,
+                                               monkeypatch):
+    cfg, params = gpt2_model
+    eng = _live_engine(cfg, params, slo=False, history=False)
+    try:
+        url = f"http://127.0.0.1:{eng._tel_exporter.port}"
+        rem = RemoteReplica(url, "rS", cfg=_cfg())
+        assert rem.poll()
+        # flip OUR major: the engine now speaks a foreign schema
+        import deepspeed_tpu.obs_wire as ow
+        monkeypatch.setattr(ow, "OBS_WIRE_SCHEMA", (2, 0))
+        with pytest.raises(WireSchemaError, match="major mismatch"):
+            rem.poll()
+        assert rem.scrape_errors == 1
+    finally:
+        eng.shutdown()
+
+
+# --------------------------------------------------------- fleet plane
+def test_fleet_attach_remote_folds_into_rollups(gpt2_model, devices):
+    cfg, params = gpt2_model
+    remote_eng = _live_engine(cfg, params, replica_id="far0")
+    router = fleet_router(params, cfg, fleet={"replicas": 1},
+                          tracing=True, **KW)
+    try:
+        url = f"http://127.0.0.1:{remote_eng._tel_exporter.port}"
+        rem = router.attach_remote(url=url, rid="far0",
+                                   cfg=_cfg())
+        with pytest.raises(ValueError, match="duplicate"):
+            router.attach_remote(url=url, rid="far0")
+        assert rem.poll() and rem.poll()
+        st = router.statusz()
+        assert check_wire_schema(st) == OBS_WIRE_SCHEMA
+        rows = {r["replica"]: r for r in st["fleet"]["replicas"]}
+        assert set(rows) == {"r0", "far0"}
+        assert rows["far0"]["remote"] is True
+        assert rows["far0"]["scrape_state"] == FRESH
+        assert "remote" not in rows["r0"]      # in-process rows unchanged
+        assert st["fleet"]["states"]["healthy"] == 2
+        # remote SLO + history snapshots ride the shared rollups
+        assert st["slo"]["enabled"] is True
+        hz = router.historyz()
+        assert hz["replica_rollup"]["enabled"] is True
+        assert router.healthz()["remotes"] == {"far0": FRESH}
+        # the router registry carries the obswire_ scrape family
+        snap = router.registry.snapshot()
+        assert snap["counters"]["obswire_scrapes"] >= 2
+        assert snap["counters"]["obswire_scrape_errors"] == 0
+        # detach: rollups drop it, close() marks the client done
+        assert router.detach_remote("far0") is rem
+        assert rem.closed
+        assert "far0" not in {r["replica"] for r in
+                              router.statusz()["fleet"]["replicas"]}
+        assert router.detach_remote("far0") is None
+    finally:
+        router.shutdown()
+        remote_eng.shutdown()
+
+
+def test_fleet_without_remotes_is_unchanged(gpt2_model, devices):
+    """Zero-behavioral-change contract: a remoteless router's statusz
+    rows and healthz carry no wire-plane artifacts beyond the additive
+    stamp fields."""
+    cfg, params = gpt2_model
+    router = fleet_router(params, cfg, fleet={"replicas": 2}, **KW)
+    try:
+        st = router.statusz()
+        assert len(st["fleet"]["replicas"]) == 2
+        for row in st["fleet"]["replicas"]:
+            assert "remote" not in row and "scrape_state" not in row
+        h = router.healthz()
+        assert "remotes" not in h
+        assert check_wire_schema(h) == OBS_WIRE_SCHEMA
+    finally:
+        router.shutdown()
+
+
+def test_fleet_poll_health_force_losts_foreign_schema(gpt2_model,
+                                                      devices):
+    """A schema-incompatible remote is pinned LOST by the health poll
+    (loudly, once) instead of crashing the router loop."""
+    cfg, params = gpt2_model
+    router = fleet_router(params, cfg, fleet={"replicas": 1},
+                          tracing=True, **KW)
+
+    class _ForeignRemote(_FakeRemote):
+        def _get(self, route, query=""):
+            raise WireSchemaError("remote speaks 9.0 (stub)")
+
+    try:
+        rem = _ForeignRemote("http://stub:0", "alien", cfg=_cfg())
+        router.attach_remote(rem)
+        router._poll_health(time.monotonic())  # must not raise
+        assert rem.state == LOST
+        assert "wire_schema" in rem.last_error
+        st = router.statusz()
+        rows = {r["replica"]: r for r in st["fleet"]["replicas"]}
+        assert rows["alien"]["scrape_state"] == LOST
+    finally:
+        router.shutdown()
+
+
+# --------------------------------------------------- subprocess truth
+@pytest.mark.slow
+def test_subprocess_replica_scraped_skewed_and_killed(tmp_path):
+    """The wire plane against a REAL child process: scrape to FRESH
+    over real HTTP, recover the injected 120 ms monotonic skew within
+    the estimator's bound, drain + merge its trace, SIGKILL it, and
+    walk to LOST with the last-known snapshot retained — each poll
+    against the corpse returning promptly."""
+    skew_ns = 120_000_000
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    child = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "obswire_child.py"),
+         "--replica", "kid", "--skew-ns", str(skew_ns)],
+        cwd=REPO, env=env, text=True, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+    try:
+        line = child.stdout.readline()
+        assert line, f"child died before handshake (rc={child.poll()})"
+        port = json.loads(line)["port"]
+        rem = RemoteReplica(f"http://127.0.0.1:{port}", "kid",
+                            cfg=_cfg(stale_after_s=0.5,
+                                     lost_after_s=1.0,
+                                     offset_probes=8))
+        deadline = time.monotonic() + 30
+        while rem.state != FRESH and time.monotonic() < deadline:
+            rem.poll()
+            time.sleep(0.05)
+        assert rem.state == FRESH and rem.scrape_errors == 0
+        assert rem.statusz_row()["state"] == "healthy"
+        assert rem.slo_snapshot()["enabled"] is True
+
+        off, err = rem.estimate_clock_offset()
+        assert abs(off - skew_ns) <= err + 20_000_000
+
+        evs, meta = rem.fetch_trace(since=0)
+        assert meta["replica"] == "kid" and len(evs) > 0
+        merged = merge_trace_segments([
+            {"events": evs, "offset_ns": off, "err_ns": err,
+             "replica": "kid"}])
+        ts = [e["ts"] for e in merged["traceEvents"] if "ts" in e]
+        assert ts == sorted(ts)
+
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=10)
+        deadline = time.monotonic() + 10
+        max_poll = 0.0
+        while rem.state != LOST and time.monotonic() < deadline:
+            t0 = time.monotonic()
+            rem.poll()
+            max_poll = max(max_poll, time.monotonic() - t0)
+            time.sleep(0.05)
+        assert rem.state == LOST
+        assert rem.last_statusz is not None    # post-mortem snapshot
+        assert rem.statusz_row()["scrape_state"] == LOST
+        assert max_poll < 5.0                  # never wedges
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=10)

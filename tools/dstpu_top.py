@@ -12,10 +12,21 @@ the config block) and this tool at the same port.
     python tools/dstpu_top.py --url ... --once        # one frame, exit
     python tools/dstpu_top.py --once --json           # raw snapshot
 
-Pure stdlib.  Uses curses when stdout is a tty (clean redraws, q to
-quit); falls back to plain ANSI-clear refresh otherwise (``--plain``
-forces it — pipeable).  ``--once`` renders a single frame and exits,
-which is also what the tests drive.
+``--connect URL[,URL...]`` goes through the obs_wire scrape plane
+instead of plain fetches: each URL gets a RemoteReplica poller
+(timeout/retry/backoff, FRESH→STALE→LOST staleness), frames render
+from the LAST-KNOWN snapshot, and every remote carries a staleness
+badge — a SIGKILLed replica keeps rendering, flagged ``[LOST]``,
+instead of killing the frame.
+
+    python tools/dstpu_top.py --connect http://127.0.0.1:8080
+    python tools/dstpu_top.py --connect http://h1:8080,http://h2:8080
+
+Uses curses when stdout is a tty (clean redraws, q to quit); falls
+back to plain ANSI-clear refresh otherwise (``--plain`` forces it —
+pipeable).  ``--once`` renders a single frame and exits, which is
+also what the tests drive.  Only ``--connect`` imports deepspeed_tpu;
+the ``--url`` path stays pure stdlib.
 """
 
 import argparse
@@ -200,6 +211,13 @@ def render_fleet(status: dict, health: dict | None = None,
         if r.get("stalled_for_s"):
             reasons = (reasons + f" stall {r['stalled_for_s']:.1f}s"
                        ).strip()
+        if r.get("scrape_state"):
+            # out-of-process replica: staleness badge leads the
+            # reasons column so a LOST child is unmissable
+            badge = r["scrape_state"]
+            if r.get("scrape_age_s") is not None:
+                badge += f" {r['scrape_age_s']:.0f}s"
+            reasons = (f"[{badge}] " + reasons).strip()
         rm = r.get("mesh", {})
         mesh_col = ("x".join(f"{a}{s}" for a, s in
                              sorted(rm.get("axes", {}).items()))
@@ -376,6 +394,57 @@ def render(status: dict, health: dict | None = None,
     return L
 
 
+def connect_remotes(urls, cfg=None):
+    """Build one RemoteReplica scrape client per URL (the --connect
+    path).  Imported lazily: --url stays stdlib-only."""
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from deepspeed_tpu.obs_wire import RemoteReplica
+
+    remotes = []
+    for i, u in enumerate(urls):
+        u = u.strip().rstrip("/")
+        if not u:
+            continue
+        remotes.append(RemoteReplica(u, f"remote{i}", cfg=cfg))
+    return remotes
+
+
+def remote_badge(rem) -> str:
+    """One-line scrape-plane header for a remote: staleness badge +
+    scrape accounting."""
+    age = rem.age_s()
+    badge = rem.state + (f" {age:.1f}s" if age is not None else "")
+    line = (f"== {rem.id} [{badge}]  {rem.url}"
+            f"  scrapes {rem.scrapes}  errors {rem.scrape_errors}")
+    if rem.last_error:
+        line += f"  last: {str(rem.last_error)[:40]}"
+    return line
+
+
+def connect_frame(remotes) -> list:
+    """One frame over the scrape plane: poll every remote (failures
+    land in the staleness machine, never raise), then render each
+    remote's last-known statusz/healthz/historyz under its badge."""
+    lines = []
+    n_lost = sum(1 for r in remotes if r.state == "LOST")
+    lines.append(f"obs_wire  remotes {len(remotes)}  lost {n_lost}")
+    for rem in remotes:
+        try:
+            rem.poll()
+        except Exception as e:     # WireSchemaError: pin LOST, render on
+            rem.force_lost(f"{e}")
+        lines.append("")
+        lines.append(remote_badge(rem))
+        if rem.last_statusz is None:
+            lines.append("  (no snapshot yet)")
+            continue
+        lines.extend(render(rem.last_statusz, rem.last_healthz,
+                            rem.last_historyz))
+    return lines
+
+
 def one_frame(base: str):
     status = fetch(base + "/statusz")
     try:
@@ -391,13 +460,19 @@ def one_frame(base: str):
     return status, health, historyz
 
 
-def loop_plain(base: str, interval: float, once: bool) -> int:
+def _frame_lines(base: str) -> list:
+    try:
+        status, health, historyz = one_frame(base)
+        return render(status, health, historyz)
+    except Exception as e:
+        return [f"dstpu_top: {base} unreachable: {e}"]
+
+
+def loop_plain(base: str, interval: float, once: bool,
+               frame_fn=None) -> int:
+    frame_fn = frame_fn or (lambda: _frame_lines(base))
     while True:
-        try:
-            status, health, historyz = one_frame(base)
-            lines = render(status, health, historyz)
-        except Exception as e:
-            lines = [f"dstpu_top: {base} unreachable: {e}"]
+        lines = frame_fn()
         if not once:
             sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
         print("\n".join(lines), flush=True)
@@ -406,18 +481,16 @@ def loop_plain(base: str, interval: float, once: bool) -> int:
         time.sleep(interval)
 
 
-def loop_curses(base: str, interval: float) -> int:
+def loop_curses(base: str, interval: float, frame_fn=None) -> int:
     import curses
+
+    frame_fn = frame_fn or (lambda: _frame_lines(base))
 
     def run(scr):
         curses.curs_set(0)
         scr.nodelay(True)
         while True:
-            try:
-                status, health, historyz = one_frame(base)
-                lines = render(status, health, historyz)
-            except Exception as e:
-                lines = [f"dstpu_top: {base} unreachable: {e}"]
+            lines = frame_fn()
             scr.erase()
             maxy, maxx = scr.getmaxyx()
             for y, line in enumerate(lines[:maxy - 1]):
@@ -441,6 +514,10 @@ def main():
     ap.add_argument("--url", default="http://127.0.0.1:8080",
                     help="engine introspection base URL "
                          "(telemetry.http_port)")
+    ap.add_argument("--connect", default=None, metavar="URL[,URL...]",
+                    help="scrape-plane mode: one RemoteReplica poller "
+                         "per URL, staleness/LOST badges, last-known "
+                         "frames survive a dead replica")
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument("--once", action="store_true",
                     help="render one frame and exit")
@@ -450,6 +527,27 @@ def main():
                     help="with --once: print the raw /statusz JSON")
     args = ap.parse_args()
     base = args.url.rstrip("/")
+    if args.connect:
+        remotes = connect_remotes(args.connect.split(","))
+        if not remotes:
+            print("dstpu_top: --connect got no URLs", file=sys.stderr)
+            return 2
+        if args.json:
+            for rem in remotes:
+                try:
+                    rem.poll()
+                except Exception as e:
+                    rem.force_lost(f"{e}")
+            print(json.dumps(
+                {rem.id: {"url": rem.url, "scrape_state": rem.state,
+                          "statusz": rem.last_statusz}
+                 for rem in remotes}, indent=1, sort_keys=True))
+            return 0
+        frame_fn = lambda: connect_frame(remotes)   # noqa: E731
+        if args.once or args.plain or not sys.stdout.isatty():
+            return loop_plain(base, args.interval, args.once,
+                              frame_fn=frame_fn)
+        return loop_curses(base, args.interval, frame_fn=frame_fn)
     if args.json:
         print(json.dumps(fetch(base + "/statusz"), indent=1,
                          sort_keys=True))

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Child process for the obs_wire truth gate: one tiny gpt2 serving
+replica behind a REAL HTTP introspection server on an ephemeral port.
+
+Spawned by ``tools/obswire_probe.py`` and the wire-plane tests — never
+run it by hand unless debugging.  Protocol:
+
+- builds the engine (telemetry ``http_port=0``, tracing at
+  ``sample_rate=1``, SLO + history on), runs a small traced workload,
+  then prints ONE JSON line ``{"port": N, "pid": P}`` to stdout and
+  flushes — the parent's ready handshake and scrape address.
+- keeps serving HTTP until killed.  SIGTERM exits cleanly (engine
+  shutdown); the probe's staleness test uses SIGKILL on purpose, so
+  cleanup must never be load-bearing.
+- ``--skew-ns N`` shifts the monotonic timestamp this process stamps
+  into every wire document, simulating a remote host whose monotonic
+  clock origin differs — the known injected skew the parent's offset
+  estimator must recover within its error bound.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replica", default="child0")
+    ap.add_argument("--skew-ns", type=int, default=0)
+    ap.add_argument("--requests", type=int, default=4)
+    ap.add_argument("--new-tokens", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+    if args.skew_ns:
+        # simulate a foreign monotonic origin: every wire_stamp (and
+        # therefore every /statusz//healthz//historyz//tracez doc this
+        # process serves) reads skew_ns ahead of the true clock
+        from deepspeed_tpu import obs_wire
+
+        real_stamp = obs_wire.wire_stamp
+
+        def skewed_stamp():
+            d = real_stamp()
+            d["t_mono_ns"] += args.skew_ns
+            return d
+
+        obs_wire.wire_stamp = skewed_stamp
+
+    import numpy as np
+
+    from deepspeed_tpu.inference.serving import serving_engine
+    from deepspeed_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config.tiny(dim=32, n_layers=2, n_heads=2,
+                               max_seq_len=64)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    eng = serving_engine(
+        params, cfg, max_batch=2, page_size=8, num_pages=24,
+        max_seq=32, prefill_bucket=8,
+        telemetry={"http_port": 0},
+        tracing={"sample_rate": 1.0},
+        slo=True, history=True,
+        replica_id=args.replica)
+
+    rng = np.random.default_rng(0)
+    for i in range(args.requests):
+        eng.submit(i, rng.integers(1, cfg.vocab_size, 6).tolist(),
+                   max_new_tokens=args.new_tokens)
+    eng.run()
+
+    def bye(signum, frame):
+        eng.shutdown()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, bye)
+
+    print(json.dumps({"port": eng._tel_exporter.port,
+                      "pid": os.getpid(),
+                      "replica": args.replica}), flush=True)
+    while True:       # serve until killed
+        time.sleep(0.2)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

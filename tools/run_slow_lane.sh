@@ -180,6 +180,16 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_serving.py --cpu \
 timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/comm_bench.py \
   --cpu --json-out "$REPO/COMM_BENCH.json" >/dev/null 2>&1 || true
 
+# obs-wire truth gate: a real child process (own interpreter, own
+# engine, ephemeral-port exporter) scraped over real HTTP — FRESH
+# walk, forged-schema rejection, min-RTT offset recovery vs an
+# injected 250 ms skew, the two-process trace merge, and the
+# SIGKILL→LOST staleness walk with the loop never wedging.  Stamps
+# OBSWIRE_SAMPLE.json; bench_gate pins scrape_errors == 0,
+# schema_ok == 1, merged_trace_monotonic == 1.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/obswire_probe.py \
+  --cpu --json-out "$REPO/OBSWIRE_SAMPLE.json" >/dev/null 2>&1 || true
+
 # static analysis: the four dstpu-lint pass families (hot-path
 # host-sync lint, lock-order/scope, page lifecycle, surface parity
 # incl. the Chrome-trace pairing check against the selftest stamp

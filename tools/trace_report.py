@@ -9,6 +9,16 @@ stream-stall seconds, p50/p95):
     python tools/trace_report.py /tmp/dstpu_flight/flight_*.jsonl
     python tools/trace_report.py serving_trace.json
 
+``--merge a.jsonl b.jsonl`` (or Chrome files) folds N per-process
+segments into ONE monotone Chrome trace: each file's stamped clock
+offset (obs_wire's min-RTT estimate, carried in the JSONL header /
+``otherData``) shifts its events onto the local monotonic axis,
+request spans stitch across replica tags, and the summary gains a
+per-source segment count.
+
+    python tools/trace_report.py --merge r0.jsonl r1.jsonl \\
+        --merge-out merged.chrome.json
+
 ``--selftest`` drives a short traced gpt2 serving workload end to end,
 exports BOTH formats next to ``--json-out``, validates the Chrome
 export (parses back, monotonic ``ts``, matched async begin/end per
@@ -155,6 +165,102 @@ def load_breakdown(path: str) -> dict:
     if dev:
         bd["summary"]["device"] = dev
     return bd
+
+
+# ----------------------------------------------------------------- merge
+def load_segment(path: str):
+    """One trace file as a merge segment: ``(events, meta)`` where
+    events are flight-recorder tuples and meta carries the per-file
+    clock offset / replica tag when the exporter stamped them (JSONL:
+    the ``flight_recorder`` header line; Chrome: ``otherData``)."""
+    from deepspeed_tpu.request_trace import events_from_dicts
+
+    if path.endswith(".jsonl"):
+        meta, dicts = {}, []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                if "flight_recorder" in d:
+                    meta = d["flight_recorder"]
+                    continue
+                dicts.append(d)
+        return events_from_dicts(dicts), meta
+    with open(path) as f:
+        trace = json.load(f)
+    od = trace.get("otherData", {})
+    base = int(od.get("base_monotonic_ns", 0))
+    # reconstruct absolute-monotonic tuples from the chrome ts (µs
+    # from base); async request spans reduce to their begin/end edges
+    events = []
+    names = {"request": None, "queued": "queued", "prefill": "admitted",
+             "decode": "first_token"}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") == "M":
+            continue
+        t_ns = base + int(float(ev.get("ts", 0.0)) * 1000)
+        if ev.get("cat") == "request":
+            rid = ev.get("id")
+            if ev["ph"] == "b" and ev["name"] in names:
+                phase = names[ev["name"]]
+                if phase:
+                    events.append((t_ns, rid, -1, phase,
+                                   ev.get("args")))
+                elif ev["name"] == "request":
+                    events.append((t_ns, rid, -1, "queued",
+                                   None))
+            elif ev["ph"] == "e" and ev["name"] == "request":
+                events.append((t_ns, rid, -1, "finish",
+                               ev.get("args")))
+            elif ev["ph"] == "n":
+                events.append((t_ns, rid, -1, ev["name"],
+                               ev.get("args")))
+        else:
+            events.append((t_ns, None, -1, ev.get("name", "?"),
+                           ev.get("args")))
+    # dedup the double-begin the reconstruction above can produce for
+    # the queued edge (request + queued open at the same ts)
+    seen = set()
+    uniq = []
+    for e in sorted(events, key=lambda e: e[0]):
+        k = (e[0], str(e[1]), e[3])
+        if k in seen:
+            continue
+        seen.add(k)
+        uniq.append(e)
+    return uniq, od
+
+
+def merge_traces(paths, out_path: str):
+    """Fold N per-process exports into ONE monotone Chrome trace,
+    applying each file's stamped clock offset (obs_wire's min-RTT
+    estimate) so all segments share the local monotonic axis."""
+    from deepspeed_tpu.obs_wire import merge_trace_segments
+
+    segments = []
+    sources = {}
+    for i, path in enumerate(paths):
+        events, meta = load_segment(path)
+        tag = str(meta.get("replica")
+                  or meta.get("pid") or f"seg{i}")
+        segments.append({
+            "events": events,
+            "offset_ns": int(meta.get("clock_offset_ns") or 0),
+            "err_ns": int(meta.get("clock_offset_err_ns") or 0),
+            "replica": tag,
+        })
+        sources[os.path.basename(path)] = {
+            "replica": tag, "events": len(events),
+            "offset_ns": int(meta.get("clock_offset_ns") or 0)}
+    merged = merge_trace_segments(segments)
+    validate_chrome(merged)
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    bd = breakdown_from_chrome(merged)
+    bd["summary"]["sources"] = sources
+    return merged, bd
 
 
 # -------------------------------------------------------------- printing
@@ -345,6 +451,14 @@ def main():
     ap.add_argument("trace", nargs="?",
                     help="flight-recorder export to report on "
                          "(.jsonl structured log or .json Chrome trace)")
+    ap.add_argument("--merge", nargs="+", metavar="TRACE",
+                    help="merge N per-process exports (.jsonl or "
+                         "Chrome) into one monotone Chrome trace, "
+                         "applying per-file clock offsets from the "
+                         "trace meta; report on the merged view")
+    ap.add_argument("--merge-out", default="merged_trace.chrome.json",
+                    help="where --merge writes the merged Chrome "
+                         "trace")
     ap.add_argument("--selftest", action="store_true",
                     help="drive a short traced gpt2 serving workload, "
                          "validate the exports, stamp TRACE_SAMPLE.json")
@@ -360,8 +474,18 @@ def main():
 
     if args.selftest:
         sys.exit(selftest(args))
+    if args.merge:
+        merged, bd = merge_traces(args.merge, args.merge_out)
+        print(f"# merged {len(args.merge)} segments -> "
+              f"{args.merge_out} "
+              f"({len(merged['traceEvents'])} events, monotone)")
+        for src, rec in bd["summary"]["sources"].items():
+            print(f"#   {src}: {rec['events']} events "
+                  f"[{rec['replica']}] offset {rec['offset_ns']}ns")
+        print_report(bd, limit=args.limit)
+        return
     if not args.trace:
-        ap.error("give a trace file or --selftest")
+        ap.error("give a trace file, --merge, or --selftest")
     print_report(load_breakdown(args.trace), limit=args.limit)
 
 
