@@ -553,6 +553,293 @@ def disagg_main(args) -> int:
     return 0 if ok else 1
 
 
+# the migration child spec: a dim-64 model with prefix cache + spill
+# tier + a child-local transit fabric, so exported chains carry real
+# int8-quantizable pages across the wire
+PROC_MIG_SPEC = {
+    "model": {"family": "gpt2", "dim": 64, "n_layers": 2,
+              "n_heads": 4, "max_seq_len": 128},
+    "engine": {"max_batch": 2, "page_size": 8, "num_pages": 24,
+               "max_seq": 64, "prefill_bucket": 8,
+               "prefix_cache": True,
+               "kv_tier": {"host_pool_bytes": 64 << 20}},
+    "fabric": {"capacity_bytes": 64 << 20},
+    "seed": 0,
+}
+
+
+def procs_main(args) -> int:
+    """--procs: the out-of-process fleet A/Bs (ISSUE 20); stamps
+    PROC_FLEET_BENCH.json.  Three measurements:
+
+    (a) **throughput, in-proc vs out-of-proc**: the same closed batch
+        served by a classic in-process 3-replica fleet and by three
+        child PROCESSES behind the shm wire — the ratio prices the
+        wire (process isolation buys SIGKILL-survivable failover and
+        per-replica address spaces; the A/B keeps the cost honest),
+        with token identity REQUIRED between the arms;
+    (b) **affinity-miss migration latency, shm vs tcp vs off**: a
+        drained owner's warm chains migrate over each real transport
+        to the cold survivor — per-kind p50 miss latency, pages and
+        bytes moved, with cross-arm token identity (off = re-prefill
+        = ground truth);
+    (c) **SIGKILL recovery**: a real kill mid-generation on the
+        out-of-process fleet; recovery_s measured from the signal,
+        salvage partition recorded, completed tokens still identical
+        to the in-process arm."""
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    # the children pin this flag (tools/replica_child.py): the
+    # in-process arm must draw identical init params
+    jax.config.update("jax_threefry_partitionable", True)
+
+    import signal as _signal
+
+    import numpy as np
+
+    from deepspeed_tpu.fleet import fleet_router
+    from deepspeed_tpu.inference.serving import RequestFailed
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.proc_fleet import (DEFAULT_CHILD_SPEC,
+                                          proc_fleet_router)
+    from deepspeed_tpu.utils.evidence import atomic_write_json
+
+    t_start = time.perf_counter()
+    spec = DEFAULT_CHILD_SPEC
+    cfg = gpt2.GPT2Config.tiny(**{k: v for k, v in
+                                  spec["model"].items()
+                                  if k != "family"})
+    params = gpt2.init_params(jax.random.PRNGKey(spec["seed"]), cfg)
+    rng = np.random.default_rng(args.seed + 47)
+    prompts = [rng.integers(1, cfg.vocab_size, 6).tolist()
+               for _ in range(24)]
+
+    def gen_tokens(fin, ids):
+        n = 0
+        for i, rid in enumerate(ids):
+            v = fin.get(rid)
+            if isinstance(v, list):
+                n += len(v) - len(prompts[i])
+        return n
+
+    # ------------- (a) throughput: in-proc fleet vs process fleet
+    def ab_arm(router, tag):
+        router.submit(f"{tag}-warm", prompts[0], max_new_tokens=4)
+        router.run()
+        router.drain_finished()
+        ids = [f"{tag}{i:02d}" for i in range(len(prompts))]
+        t0 = time.perf_counter()
+        for rid, p in zip(ids, prompts):
+            router.submit(rid, p, max_new_tokens=MAX_NEW)
+        while router.has_work:
+            router.step()
+            if time.perf_counter() - t0 > WALL_CAP_S:
+                break
+        el = time.perf_counter() - t0
+        fin = dict(router.finished)
+        toks = gen_tokens(fin, ids)
+        return {"completed": sum(1 for r in ids
+                                 if isinstance(fin.get(r), list)),
+                "generated_tokens": toks,
+                "tokens_per_s": round(toks / max(el, 1e-9), 2),
+                "elapsed_s": round(el, 3),
+                "leaks": len(router.check_leaks()),
+                "orphans": len(router.orphaned())}, fin, ids
+
+    router = fleet_router(params, cfg, fleet={"replicas": 3},
+                          seed=args.seed, **spec["engine"])
+    row_in, fin_in, ids_in = ab_arm(router, "i")
+    router.shutdown()
+
+    prouter = proc_fleet_router(spec, proc_fleet={"replicas": 3})
+    try:
+        row_out, fin_out, ids_out = ab_arm(prouter, "p")
+        ab_mismatch = sum(
+            1 for a, b in zip(ids_in, ids_out)
+            if isinstance(fin_in.get(a), list)
+            and isinstance(fin_out.get(b), list)
+            and list(fin_in[a]) != list(fin_out[b]))
+        throughput = {
+            "requests": len(prompts),
+            "inproc": row_in,
+            "outproc": row_out,
+            "wire_cost_ratio": round(
+                row_in["tokens_per_s"]
+                / max(row_out["tokens_per_s"], 1e-9), 3),
+            "mismatched_requests": ab_mismatch,
+        }
+        print(json.dumps({"throughput": throughput}), flush=True)
+
+        # ------------- (c) SIGKILL recovery on the same process fleet
+        prouter.drain_finished()
+        fids = [f"f{i:02d}" for i in range(len(prompts))]
+        for rid, p in zip(fids, prompts):
+            prouter.submit(rid, p, max_new_tokens=MAX_NEW)
+        t_kill = None
+        salvaged = set()
+        recovery_s = None
+        t0 = time.perf_counter()
+        while prouter.has_work:
+            prouter.step()
+            if t_kill is None:
+                # right after the first harvest: queued + in-flight
+                # work dies with the address space
+                t_kill = prouter.kill_child("r1", _signal.SIGKILL)
+            fo = prouter.last_failover
+            if not salvaged and fo is not None and \
+                    fo.get("replica") == "r1":
+                salvaged = set(fo["resubmitted"])
+            if t_kill is not None and recovery_s is None and \
+                    fo is not None and fo.get("replica") == "r1" \
+                    and all(k in prouter.finished for k in salvaged):
+                recovery_s = time.perf_counter() - t_kill
+            if time.perf_counter() - t0 > WALL_CAP_S:
+                break
+        if recovery_s is None and t_kill is not None:
+            recovery_s = time.perf_counter() - t_kill
+        ffin = dict(prouter.finished)
+        fo = prouter.last_failover or {}
+        fo_mismatch = sum(
+            1 for a, b in zip(ids_in, fids)
+            if isinstance(fin_in.get(a), list)
+            and isinstance(ffin.get(b), list)
+            and list(fin_in[a]) != list(ffin[b]))
+        failover = {
+            "killed_replica": "r1",
+            "recovery_s": round(recovery_s, 3)
+            if recovery_s is not None else None,
+            "completed": sum(1 for r in fids
+                             if isinstance(ffin.get(r), list)),
+            "failed_typed": sum(1 for r in fids
+                                if isinstance(ffin.get(r),
+                                              RequestFailed)),
+            "resubmitted": len(fo.get("resubmitted", [])),
+            "mismatched_requests": fo_mismatch,
+            "leaks": len(prouter.check_leaks()),
+            "orphans": len(prouter.orphaned()),
+        }
+        print(json.dumps({"failover": failover}), flush=True)
+    finally:
+        prouter.shutdown()
+
+    # ------------- (b) migration latency over each transport
+    mig_rng = np.random.default_rng(args.seed + 53)
+    mcfg = gpt2.GPT2Config.tiny(
+        **{k: v for k, v in PROC_MIG_SPEC["model"].items()
+           if k != "family"})
+    prefixes = [mig_rng.integers(1, mcfg.vocab_size, 40).tolist()
+                for _ in range(4)]
+    miss_prompts = [pref
+                    + mig_rng.integers(1, mcfg.vocab_size, 3).tolist()
+                    for pref in prefixes]
+
+    def mig_arm(kind, with_fabric=True):
+        router = proc_fleet_router(
+            PROC_MIG_SPEC,
+            transport={"kind": kind},
+            proc_fleet={"replicas": 2},
+            fleet={"replicas": 2, "affinity": True,
+                   "digest_refresh_steps": 1},
+            fabric=True if with_fabric else None)
+        try:
+            for i, pref in enumerate(prefixes):
+                router.submit(f"w{i}", pref, max_new_tokens=4)
+                router.run()
+            router.refresh_digests()
+            warm = next((r for r in router.replicas.values()
+                         if r.digest), None)
+            if warm is not None:
+                router.drain(warm.id)
+            lats = []
+            fin = {}
+            for i, p in enumerate(miss_prompts):
+                t0 = time.perf_counter()
+                router.submit(f"m{i}", p, max_new_tokens=MAX_NEW)
+                router.run()
+                lats.append(time.perf_counter() - t0)
+                fin[f"m{i}"] = router.finished.get(f"m{i}")
+            fab = (router.statusz()["fleet"].get("fabric") or {})
+            lats.sort()
+            return {"kind": kind if with_fabric else "off",
+                    "n_miss": len(lats),
+                    "latency_p50_s": round(lats[len(lats) // 2], 4),
+                    "migrations": fab.get("migrations", 0),
+                    "migration_pages": fab.get("migration_pages", 0),
+                    "bytes_moved": fab.get("bytes_moved", 0),
+                    "leaks": len(router.check_leaks()),
+                    "orphans": len(router.orphaned())}, fin
+        finally:
+            router.shutdown()
+
+    row_shm, fin_shm = mig_arm("shm")
+    print(json.dumps({"migration_shm": row_shm}), flush=True)
+    row_tcp, fin_tcp = mig_arm("tcp")
+    print(json.dumps({"migration_tcp": row_tcp}), flush=True)
+    row_off, fin_off = mig_arm("shm", with_fabric=False)
+    print(json.dumps({"migration_off": row_off}), flush=True)
+    mig_mismatch = sum(
+        1 for k in fin_off
+        if not (isinstance(fin_off[k], list)
+                and list(fin_off[k]) == list(fin_shm.get(k) or [])
+                and list(fin_off[k]) == list(fin_tcp.get(k) or [])))
+    migration = {
+        "prefix_tokens": len(prefixes[0]),
+        "requests": len(miss_prompts),
+        "shm": row_shm,
+        "tcp": row_tcp,
+        "off": row_off,
+        "shm_vs_tcp": round(
+            row_tcp["latency_p50_s"]
+            / max(row_shm["latency_p50_s"], 1e-9), 3),
+        "mismatched_requests": mig_mismatch,
+        "leak_count": row_shm["leaks"] + row_tcp["leaks"]
+        + row_off["leaks"],
+    }
+
+    ok = (throughput["mismatched_requests"] == 0
+          and failover["mismatched_requests"] == 0
+          and migration["mismatched_requests"] == 0
+          and row_in["leaks"] == 0 and row_out["leaks"] == 0
+          and failover["leaks"] == 0
+          and migration["leak_count"] == 0
+          and row_in["orphans"] == 0 and row_out["orphans"] == 0
+          and failover["orphans"] == 0
+          and row_shm["orphans"] == 0 and row_tcp["orphans"] == 0
+          and failover["recovery_s"] is not None
+          and failover["recovery_s"] < 60.0
+          and row_shm["migrations"] >= 1
+          and row_tcp["migrations"] >= 1
+          and row_shm["bytes_moved"] > 0
+          and row_tcp["bytes_moved"] > 0)
+    out = {
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(),
+        "model": "gpt2-tiny",
+        "seed": args.seed,
+        "replicas": 3,
+        "ok": ok,
+        "throughput": throughput,
+        "failover": failover,
+        "migration": migration,
+        "mismatched_requests":
+            throughput["mismatched_requests"]
+            + failover["mismatched_requests"]
+            + migration["mismatched_requests"],
+        "leak_count": row_in["leaks"] + row_out["leaks"]
+        + failover["leaks"] + migration["leak_count"],
+        "orphaned_requests": row_in["orphans"] + row_out["orphans"]
+        + failover["orphans"] + row_shm["orphans"]
+        + row_tcp["orphans"] + row_off["orphans"],
+        "recovery_s": failover["recovery_s"],
+        "duration_s": round(time.perf_counter() - t_start, 2),
+    }
+    atomic_write_json(out, args.json_out)
+    print("→", args.json_out)
+    return 0 if ok else 1
+
+
 def drive_open_loop(router, arrivals, make_prompt, *, kill=None,
                     bucket_s: float = 0.5):
     """Submit arrivals on their schedule while stepping the fleet;
@@ -679,17 +966,25 @@ def main():
     ap.add_argument("--wave-hi", type=float, default=10.0,
                     help="--elastic: sine-wave crest arrival rate "
                          "(req/s)")
+    ap.add_argument("--procs", action="store_true",
+                    help="run the out-of-process fleet A/Bs "
+                         "(in-proc vs child processes, shm vs tcp "
+                         "migration, SIGKILL recovery); stamps "
+                         "PROC_FLEET_BENCH.json by default")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.json_out is None:
         args.json_out = os.path.join(
             REPO, "ELASTIC_BENCH.json" if args.elastic
             else "DISAGG_BENCH.json" if args.disagg
+            else "PROC_FLEET_BENCH.json" if args.procs
             else "FLEET_BENCH.json")
     if args.elastic:
         return elastic_main(args)
     if args.disagg:
         return disagg_main(args)
+    if args.procs:
+        return procs_main(args)
 
     import jax
 

@@ -58,6 +58,8 @@ CONFIG_BLOCKS = {
     "DevprofConfig": "devprof",
     "MeshConfig": "mesh",
     "ObsWireConfig": "obs_wire",
+    "TransportConfig": "transport",
+    "ProcFleetConfig": "proc_fleet",
 }
 
 # metric families the citation scan is anchored to: a doc token is only
@@ -67,7 +69,7 @@ METRIC_FAMILIES = (
     "serving_", "prefix_cache_", "spec_", "kv_tier_", "slo_",
     "fleet_", "autoscale_", "zi_", "pstream_", "aio_",
     "tier_reader_", "comm_", "infinity_", "history_", "incident_",
-    "devprof_", "obswire_",
+    "devprof_", "obswire_", "transport_",
 )
 # bench-evidence JSON namespaces and row labels that share a family
 # prefix but are not registry metrics (cited next to the metrics in

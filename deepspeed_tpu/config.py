@@ -1515,6 +1515,147 @@ class ObsWireConfig:
 
 
 @dataclasses.dataclass
+class TransportConfig:
+    """Process-boundary transport block (no reference analogue; see
+    :mod:`deepspeed_tpu.transport`).
+
+    Selects and sizes the byte mover under one parent<->child
+    peer-pair.  ``kind``: ``"shm"`` (file-backed mmap ring pair,
+    same-host only), ``"tcp"`` (length-prefixed stream, the general
+    path), or ``"auto"`` — shm when the peer is known same-host, tcp
+    otherwise.  ``slot_bytes``/``ring_slots`` size each shm ring
+    (per-frame capacity is ``ring_slots * (slot_bytes - 24)``; a
+    larger frame errors rather than wedging).  ``io_timeout_s``
+    bounds one send/recv; ``rpc_timeout_s`` bounds one full
+    request/reply round trip.  ``connect_attempts``/``backoff_s``
+    drive :func:`~deepspeed_tpu.faults.retry_with_backoff` around
+    dialing and re-dialing a TCP peer.
+    """
+
+    kind: str = "auto"                   # shm | tcp | auto
+    slot_bytes: int = 1 << 14            # shm slot size (incl. 24B hdr)
+    ring_slots: int = 64                 # slots per shm direction
+    io_timeout_s: float = 5.0            # one send/recv bound
+    rpc_timeout_s: float = 10.0          # one request/reply bound
+    connect_attempts: int = 5            # TCP dial/redial attempts
+    backoff_s: float = 0.05              # redial backoff base (doubles)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TransportConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        c = cls(**{k: v for k, v in d.items() if k in known})
+        c.kind = str(c.kind)
+        c.slot_bytes = int(c.slot_bytes)
+        c.ring_slots = int(c.ring_slots)
+        c.io_timeout_s = float(c.io_timeout_s)
+        c.rpc_timeout_s = float(c.rpc_timeout_s)
+        c.connect_attempts = int(c.connect_attempts)
+        c.backoff_s = float(c.backoff_s)
+        if c.kind not in ("shm", "tcp", "auto"):
+            raise ValueError(
+                f"transport.kind must be shm|tcp|auto, got {c.kind!r}")
+        if c.slot_bytes < 64:
+            raise ValueError(
+                f"transport.slot_bytes must be >= 64, got {c.slot_bytes}")
+        if c.ring_slots < 2:
+            raise ValueError(
+                f"transport.ring_slots must be >= 2, got {c.ring_slots}")
+        if c.io_timeout_s <= 0 or c.rpc_timeout_s <= 0:
+            raise ValueError(
+                f"transport.io_timeout_s and transport.rpc_timeout_s "
+                f"must be positive, got "
+                f"{c.io_timeout_s}/{c.rpc_timeout_s}")
+        if c.connect_attempts < 1:
+            raise ValueError(
+                f"transport.connect_attempts must be >= 1, got "
+                f"{c.connect_attempts}")
+        if c.backoff_s < 0:
+            raise ValueError(
+                f"transport.backoff_s must be >= 0, got {c.backoff_s}")
+        return c
+
+    @classmethod
+    def coerce(cls, obj) -> "TransportConfig":
+        """Accept None (defaults), a dict, or a TransportConfig — the
+        block tunes an always-on plane, so there is no enabled flag."""
+        if obj is None:
+            return cls()
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, dict):
+            return cls.from_dict(obj)
+        raise TypeError(
+            f"transport must be a dict or TransportConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
+class ProcFleetConfig:
+    """Out-of-process fleet block (no reference analogue; see
+    :mod:`deepspeed_tpu.proc_fleet`).
+
+    Governs how :func:`~deepspeed_tpu.proc_fleet.proc_fleet_router`
+    spawns and supervises child replica processes.  ``replicas``
+    counts children; ``spawn_timeout_s`` bounds one child's
+    build-engine-and-handshake window; ``health_cache_s`` is the
+    staleness bound on the proxy's cached child health (an expired
+    cache turns the next ``healthz()`` into a real RPC — the SIGKILL
+    detection cadence); ``poll_timeout_s`` bounds one router-step
+    poll RPC; ``shutdown_grace_s`` is how long SIGTERM gets before
+    SIGKILL at teardown.  ``attach_scrape`` additionally attaches
+    each child's HTTP wire surface as a :class:`~deepspeed_tpu.
+    obs_wire.RemoteReplica` so the PR 19 scrape plane (staleness
+    walk, trace merge) observes the same processes the data plane
+    drives.
+    """
+
+    replicas: int = 2
+    spawn_timeout_s: float = 120.0
+    health_cache_s: float = 0.25
+    poll_timeout_s: float = 10.0
+    shutdown_grace_s: float = 5.0
+    attach_scrape: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ProcFleetConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        c = cls(**{k: v for k, v in d.items() if k in known})
+        c.replicas = int(c.replicas)
+        c.spawn_timeout_s = float(c.spawn_timeout_s)
+        c.health_cache_s = float(c.health_cache_s)
+        c.poll_timeout_s = float(c.poll_timeout_s)
+        c.shutdown_grace_s = float(c.shutdown_grace_s)
+        c.attach_scrape = bool(c.attach_scrape)
+        if c.replicas < 1:
+            raise ValueError(
+                f"proc_fleet.replicas must be >= 1, got {c.replicas}")
+        if c.spawn_timeout_s <= 0 or c.poll_timeout_s <= 0:
+            raise ValueError(
+                f"proc_fleet.spawn_timeout_s and "
+                f"proc_fleet.poll_timeout_s must be positive, got "
+                f"{c.spawn_timeout_s}/{c.poll_timeout_s}")
+        if c.health_cache_s < 0 or c.shutdown_grace_s < 0:
+            raise ValueError(
+                f"proc_fleet.health_cache_s and "
+                f"proc_fleet.shutdown_grace_s must be >= 0, got "
+                f"{c.health_cache_s}/{c.shutdown_grace_s}")
+        return c
+
+    @classmethod
+    def coerce(cls, obj) -> "ProcFleetConfig":
+        """Accept None (defaults), a dict, or a ProcFleetConfig."""
+        if obj is None:
+            return cls()
+        if isinstance(obj, cls):
+            return obj
+        if isinstance(obj, dict):
+            return cls.from_dict(obj)
+        raise TypeError(
+            f"proc_fleet must be a dict or ProcFleetConfig, got "
+            f"{type(obj).__name__}")
+
+
+@dataclasses.dataclass
 class PrecisionConfig:
     """ref: deepspeed/runtime/fp16/loss_scaler.py + config fp16/bf16 blocks."""
 

@@ -84,6 +84,20 @@ module provides both halves of proving that:
               ``latency`` delays the scrape by ``latency_s`` capped at
               the configured ``obs_wire.timeout_s`` so an injected
               stall can never wedge the poll loop.
+  transport   the :class:`~deepspeed_tpu.transport.Channel` data plane
+              (one opportunity per send/recv/frame; key =
+              ``send:<peer>``, ``recv:<peer>`` or ``corrupt:<peer>``,
+              so ``match=`` scopes a rule to one leg of one
+              peer-pair).  On ``send:``/``recv:`` a latency rule
+              sleeps (wire jitter) and an error rule raises
+              :class:`~deepspeed_tpu.transport.TransportError` — the
+              reconnect/backoff path.  On ``corrupt:`` an error rule
+              flips one byte of the encoded frame AFTER its crc32 was
+              stamped, so the receiving side's ``decode_frame`` must
+              reject it as :class:`~deepspeed_tpu.transport.
+              TransportCorrupt` (and a corrupted migrated page that
+              somehow slipped a layer further still dies at the
+              importer's promotion-time checksum).
   ========== ===========================================================
 
 - **Degradation helpers**: :func:`retry_with_backoff` (the bounded
@@ -137,13 +151,13 @@ class FatalStreamError(RuntimeError):
 
 SUBSYSTEMS = ("aio_read", "aio_write", "kv_corrupt", "slot",
               "sync_read", "burst", "replica", "scale", "fabric",
-              "scrape")
+              "scrape", "transport")
 MODES = ("error", "latency", "degrade")
 # subsystems whose opportunities carry a key a `match` filter can test
 # (aio ops and bursts are anonymous — a match there would validate
 # fine and silently never fire, so it is rejected at rule build)
 _KEYED_SUBSYSTEMS = ("kv_corrupt", "slot", "sync_read", "replica",
-                     "scale", "fabric", "scrape")
+                     "scale", "fabric", "scrape", "transport")
 
 
 @dataclasses.dataclass

@@ -484,7 +484,7 @@ def test_subprocess_replica_scraped_skewed_and_killed(tmp_path):
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     child = subprocess.Popen(
-        [sys.executable, os.path.join(REPO, "tools", "obswire_child.py"),
+        [sys.executable, os.path.join(REPO, "tools", "replica_child.py"),
          "--replica", "kid", "--skew-ns", str(skew_ns)],
         cwd=REPO, env=env, text=True, stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL)

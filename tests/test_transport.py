@@ -1,0 +1,571 @@
+"""Process-boundary transport (ISSUE 20): the shm ring / TCP wire
+under the KV fabric, the ``transport`` fault subsystem, TierEntry
+serialization across the frame codec (int8-quantized cold pages
+included), and the out-of-process fleet proxy.
+
+Fast lane: rings, sockets and channels exercised in-process (real
+mmap files, real sockets, loopback threads where a live peer is
+needed) plus wire-migrated admissions between two in-process engines
+compared token-for-token against the direct-fabric oracle.  The
+subprocess fleet (spawn, SIGKILL failover) is slow-marked — the
+chaos soak and PROC_SOAK.json gate it in depth on the slow lane.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu import faults
+from deepspeed_tpu import transport as tx
+from deepspeed_tpu.config import ProcFleetConfig, TransportConfig
+from deepspeed_tpu.faults import FaultPlan, FaultRule
+from deepspeed_tpu.inference.kv_tier import (dequantize_page,
+                                             encode_entry)
+from deepspeed_tpu.inference.prefix_cache import page_keys
+from deepspeed_tpu.inference.serving import serving_engine
+from deepspeed_tpu.kv_fabric import KVFabric
+from deepspeed_tpu.models import gpt2
+from deepspeed_tpu.telemetry import MetricsRegistry
+
+KW = dict(max_batch=2, page_size=8, num_pages=24, max_seq=64,
+          prefill_bucket=8)
+TIER = {"host_pool_bytes": 64 << 20}
+
+
+@pytest.fixture(scope="module")
+def gpt2_model():
+    cfg = gpt2.GPT2Config.tiny(dim=64, n_layers=2, n_heads=4,
+                               max_seq_len=128)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear_fault_plan()
+    yield
+    faults.clear_fault_plan()
+
+
+# ----------------------------------------------------------- config
+def test_transport_config_validation():
+    c = TransportConfig.coerce({"kind": "tcp", "slot_bytes": 4096})
+    assert c.kind == "tcp" and c.slot_bytes == 4096
+    assert TransportConfig.coerce(None).kind == "auto"
+    with pytest.raises(ValueError):
+        TransportConfig.coerce({"kind": "carrier_pigeon"})
+    with pytest.raises(ValueError):
+        TransportConfig.coerce({"slot_bytes": 8})
+    with pytest.raises(ValueError):
+        TransportConfig.coerce({"ring_slots": 1})
+    with pytest.raises(ValueError):
+        TransportConfig.coerce({"io_timeout_s": 0})
+    with pytest.raises(TypeError):
+        TransportConfig.coerce("shm")
+
+
+def test_proc_fleet_config_validation():
+    c = ProcFleetConfig.coerce({"replicas": 3})
+    assert c.replicas == 3
+    with pytest.raises(ValueError):
+        ProcFleetConfig.coerce({"replicas": 0})
+    with pytest.raises(ValueError):
+        ProcFleetConfig.coerce({"poll_timeout_s": -1})
+
+
+def test_transport_fault_rule_validation():
+    FaultRule(subsystem="transport", mode="error", match="send:r1")
+    FaultRule(subsystem="transport", mode="latency", latency_s=0.01)
+    with pytest.raises(ValueError):
+        FaultRule(subsystem="transport", mode="degrade")
+
+
+# ------------------------------------------------------ frame codec
+def test_frame_roundtrip_with_blobs():
+    import ml_dtypes
+    a = np.arange(24, dtype=ml_dtypes.bfloat16).reshape(2, 12)
+    b = np.arange(10, dtype=np.int8)
+    c = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+    buf = tx.encode_frame({"op": "x", "rid": 7}, [a, b, c])
+    msg, blobs = tx.decode_frame(buf)
+    assert msg == {"op": "x", "rid": 7}
+    assert blobs[0].dtype == a.dtype and np.array_equal(blobs[0], a)
+    assert np.array_equal(blobs[1], b)
+    assert np.array_equal(blobs[2], c)
+
+
+def test_frame_corruption_detected():
+    buf = tx.encode_frame({"op": "x"}, [np.arange(64, dtype=np.int32)])
+    for pos in (5, 13, len(buf) - 1):       # crc, header, payload
+        bad = bytearray(buf)
+        bad[pos] ^= 0x40
+        with pytest.raises(tx.TransportCorrupt):
+            tx.decode_frame(bytes(bad))
+    with pytest.raises(tx.TransportCorrupt):
+        tx.decode_frame(buf[:7])            # truncated
+
+
+def _entry(key=b"k" * 8, quantize=False, seed=0, shape=(2, 4, 8, 16)):
+    rng = np.random.default_rng(seed)
+    k = rng.normal(size=shape).astype(np.float32)
+    v = rng.normal(size=shape).astype(np.float32)
+    return encode_entry(key, k, v, quantize=quantize,
+                        page_dtype=np.float32), (k, v)
+
+
+@pytest.mark.parametrize("quantize", [False, True])
+def test_tier_entry_wire_roundtrip(quantize):
+    """TierEntry -> frame -> TierEntry carries buffers, geometry and
+    the demote-time checksums verbatim — the quantized (int8 codes +
+    f32 scales) layout included — so the importer's promotion-time
+    verify works unchanged on a migrated page."""
+    e, (k, v) = _entry(quantize=quantize)
+    meta, blobs = tx.entry_to_wire(e)
+    buf = tx.encode_frame({"entries": [meta]}, blobs)
+    msg, rblobs = tx.decode_frame(buf)
+    (got,) = tx.entries_from_frame(msg, rblobs)
+    assert got.key == e.key
+    assert got.quantized == quantize
+    assert got.buffers == e.buffers
+    assert got.checksums == e.checksums
+    assert got.nbytes == e.nbytes
+    assert len(got.data) == len(e.data)
+    for mine, orig in zip(got.data, e.data):
+        assert mine.dtype == orig.dtype
+        assert np.array_equal(mine, orig)
+    if quantize:
+        # the int8 codec survives the wire bit-exactly: dequantizing
+        # the shipped codes/scales matches dequantizing the originals
+        kq, ks = got.data[0], got.data[1]
+        assert kq.dtype == np.int8
+        np.testing.assert_array_equal(
+            dequantize_page(kq, ks, np.float32),
+            dequantize_page(e.data[0], e.data[1], np.float32))
+
+
+def test_entries_frame_packs_multiple():
+    e1, _ = _entry(key=b"a" * 8, seed=1)
+    e2, _ = _entry(key=b"b" * 8, seed=2, quantize=True)
+    msg, blobs = tx.entries_to_frame([e1, e2], {"op": "admit"})
+    got = tx.entries_from_frame(*tx.decode_frame(
+        tx.encode_frame(msg, blobs)))
+    assert [g.key for g in got] == [e1.key, e2.key]
+    assert [g.quantized for g in got] == [False, True]
+
+
+# -------------------------------------------------------- shm ring
+def test_shm_ring_wraparound(tmp_path):
+    """Frames larger than one slot fragment; many sends wrap the ring
+    several times; every payload survives byte-exact."""
+    path = str(tmp_path / "wrap.ring")
+    tx.ShmRing.create(path, slot_bytes=96, n_slots=8).close()
+    prod = tx.ShmRing.attach(path, "producer")
+    cons = tx.ShmRing.attach(path, "consumer")
+    rng = np.random.default_rng(0)
+    for i in range(200):
+        msg = bytes(rng.integers(0, 256, i % 311 + 1, dtype=np.uint8))
+        prod.send_bytes(msg)
+        assert cons.recv_bytes(timeout_s=1.0) == msg
+    assert prod._head == cons._tail > 8      # wrapped many times
+    prod.close()
+    cons.close(unlink=True)
+
+
+def test_shm_ring_backpressure_timeout(tmp_path):
+    """A full ring parks the producer (bounded), never overwrites; a
+    drained ring accepts again."""
+    path = str(tmp_path / "full.ring")
+    tx.ShmRing.create(path, slot_bytes=88, n_slots=4).close()
+    prod = tx.ShmRing.attach(path, "producer")
+    cons = tx.ShmRing.attach(path, "consumer")
+    for _ in range(4):
+        prod.send_bytes(b"x" * 40)
+    t0 = time.monotonic()
+    with pytest.raises(tx.TransportError, match="backpressure"):
+        prod.send_bytes(b"x" * 40, timeout_s=0.15)
+    assert time.monotonic() - t0 >= 0.12
+    assert cons.recv_bytes(timeout_s=1.0) == b"x" * 40
+    prod.send_bytes(b"y" * 40, timeout_s=1.0)   # room again
+    prod.close()
+    cons.close(unlink=True)
+
+
+def test_shm_ring_torn_frame_rejected(tmp_path):
+    """A payload byte flipped after publication (the torn-write /
+    bit-rot model) fails the per-fragment crc; the cursor advances so
+    the NEXT frame still delivers."""
+    path = str(tmp_path / "torn.ring")
+    tx.ShmRing.create(path, slot_bytes=96, n_slots=8).close()
+    prod = tx.ShmRing.attach(path, "producer")
+    cons = tx.ShmRing.attach(path, "consumer")
+    prod.send_bytes(b"precious payload " * 10)
+    base = 64 + ((prod._head - 1) % prod.n_slots) * prod.slot_bytes
+    prod.mm[base + 40] ^= 0xFF
+    with pytest.raises(tx.TransportCorrupt):
+        cons.recv_bytes(timeout_s=1.0)
+    prod.send_bytes(b"next frame")
+    assert cons.recv_bytes(timeout_s=1.0) == b"next frame"
+    # a torn SEQUENCE word (slot never fully published) also rejects
+    prod.send_bytes(b"seq victim")
+    base = 64 + ((prod._head - 1) % prod.n_slots) * prod.slot_bytes
+    import struct
+    struct.pack_into("<Q", prod.mm, base, 999999)
+    with pytest.raises(tx.TransportCorrupt, match="torn"):
+        cons.recv_bytes(timeout_s=1.0)
+    prod.close()
+    cons.close(unlink=True)
+
+
+def test_shm_ring_oversized_frame_rejected(tmp_path):
+    path = str(tmp_path / "big.ring")
+    tx.ShmRing.create(path, slot_bytes=88, n_slots=4).close()
+    prod = tx.ShmRing.attach(path, "producer")
+    with pytest.raises(tx.TransportError, match="slots"):
+        prod.send_bytes(b"x" * 4096)
+    prod.close(unlink=True)
+
+
+def test_shm_roles_enforced(tmp_path):
+    path = str(tmp_path / "role.ring")
+    tx.ShmRing.create(path, slot_bytes=96, n_slots=4).close()
+    prod = tx.ShmRing.attach(path, "producer")
+    cons = tx.ShmRing.attach(path, "consumer")
+    with pytest.raises(tx.TransportError):
+        prod.recv_bytes(timeout_s=0.0)
+    with pytest.raises(tx.TransportError):
+        cons.send_bytes(b"x")
+    prod.close()
+    cons.close(unlink=True)
+
+
+# ------------------------------------------------------------- tcp
+def test_tcp_roundtrip_and_peer_close():
+    lst = tx.TcpListener()
+    cli = tx.connect_tcp("127.0.0.1", lst.port)
+    srv = lst.accept(timeout_s=5.0)
+    cli.send_bytes(b"ping" * 500)
+    assert srv.recv_bytes(timeout_s=1.0) == b"ping" * 500
+    srv.send_bytes(b"pong")
+    assert cli.recv_bytes(timeout_s=1.0) == b"pong"
+    assert cli.recv_bytes(timeout_s=0.05) is None   # nothing pending
+    srv.close()
+    with pytest.raises(tx.TransportClosed):
+        for _ in range(50):                 # close may race the FIN
+            cli.recv_bytes(timeout_s=0.1)
+    cli.close()
+    lst.close()
+
+
+def test_tcp_reconnect_with_backoff():
+    """A dropped TCP peer redials through retry_with_backoff: the
+    channel's reconnect callable re-establishes the endpoint and the
+    send completes; the reconnect is counted."""
+    lst = tx.TcpListener()
+    accepted = []
+
+    def server():
+        while True:
+            try:
+                ep = lst.accept(timeout_s=5.0)
+            except OSError:     # includes TransportError + closed fd
+                return
+            accepted.append(ep)
+
+    th = threading.Thread(target=server, daemon=True)
+    th.start()
+    reg = MetricsRegistry(namespace="t")
+    chan = tx.Channel(
+        tx.connect_tcp("127.0.0.1", lst.port), peer="srv",
+        registry=reg,
+        reconnect=lambda: tx.connect_tcp("127.0.0.1", lst.port,
+                                         attempts=5, backoff_s=0.02))
+    chan.send({"op": "a"})
+    time.sleep(0.1)
+    # hard-drop the established connection server-side AND client-side
+    accepted[0].close()
+    chan.endpoint.close()
+    chan.send({"op": "b"})                  # must redial, not raise
+    deadline = time.monotonic() + 5
+    while len(accepted) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    got = accepted[1].recv_bytes(timeout_s=2.0)
+    msg, _ = tx.decode_frame(got)
+    assert msg["op"] == "b"
+    assert chan._c_reconnects.value >= 1
+    assert reg.snapshot()["counters"]["transport_reconnects"] >= 1
+    lst.close()
+
+
+# ------------------------------------------- channel + fault rules
+def _loopback_pair(tmp_path, name="chan"):
+    c2s, s2c = tx.create_shm_pair(str(tmp_path), name)
+    client = tx.Channel(tx.attach_shm_pair(c2s, s2c, "client"),
+                        peer="child")
+    server = tx.Channel(tx.attach_shm_pair(c2s, s2c, "server"),
+                        peer="parent")
+    return client, server
+
+
+def test_channel_rpc_roundtrip(tmp_path):
+    client, server = _loopback_pair(tmp_path)
+
+    def serve():
+        for _ in range(2):
+            msg, blobs = server.recv(timeout_s=5.0)
+            server.send({"_seq": msg["_seq"], "echo": msg["op"],
+                         "n": len(blobs)}, blobs)
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+    rep, blobs = client.request(
+        {"op": "hello"}, [np.arange(6, dtype=np.int32)], timeout_s=5.0)
+    assert rep["echo"] == "hello" and rep["n"] == 1
+    assert np.array_equal(blobs[0], np.arange(6, dtype=np.int32))
+    rep, _ = client.request({"op": "again"}, timeout_s=5.0)
+    assert rep["echo"] == "again"
+    th.join(timeout=5)
+
+
+def test_channel_corrupt_fault_detected_and_counted(tmp_path):
+    """The ``corrupt:<peer>`` transport rule flips a frame byte after
+    the crc was stamped: the receiving side must reject the frame as
+    TransportCorrupt and count it."""
+    reg = MetricsRegistry(namespace="t2")
+    c2s, s2c = tx.create_shm_pair(str(tmp_path), "cf")
+    client = tx.Channel(tx.attach_shm_pair(c2s, s2c, "client"),
+                        peer="child")
+    server = tx.Channel(tx.attach_shm_pair(c2s, s2c, "server"),
+                        peer="parent", registry=reg)
+    plan = FaultPlan([{"subsystem": "transport", "mode": "error",
+                       "match": "corrupt:child", "count": 1}])
+    faults.install_fault_plan(plan)
+    try:
+        client.send({"op": "poisoned"})
+        with pytest.raises(tx.TransportCorrupt):
+            server.recv(timeout_s=2.0)
+        assert server._c_corrupt.value == 1
+        client.send({"op": "clean"})        # count=1: rule exhausted
+        msg, _ = server.recv(timeout_s=2.0)
+        assert msg["op"] == "clean"
+    finally:
+        faults.clear_fault_plan(plan)
+
+
+def test_channel_send_error_and_latency_rules(tmp_path):
+    client, _server = _loopback_pair(tmp_path, "sf")
+    plan = FaultPlan([
+        {"subsystem": "transport", "mode": "error",
+         "match": "send:child", "count": 1},
+        {"subsystem": "transport", "mode": "latency",
+         "latency_s": 0.08, "match": "send:child", "count": 1,
+         "after": 1},
+    ])
+    faults.install_fault_plan(plan)
+    try:
+        with pytest.raises(tx.TransportError, match="injected"):
+            client.send({"op": "x"})
+        t0 = time.monotonic()
+        client.send({"op": "slow"})
+        assert time.monotonic() - t0 >= 0.06
+    finally:
+        faults.clear_fault_plan(plan)
+
+
+# ----------------------- migrated admission over the wire vs oracle
+def _warm_and_export(params, cfg, prompt, fabric, max_new=6):
+    eng = serving_engine(params, cfg, prefix_cache=True,
+                         kv_tier=dict(TIER), **KW)
+    eng.attach_fabric(fabric)
+    eng.submit("w", prompt, max_new_tokens=max_new)
+    eng.run()
+    keys = page_keys(prompt, eng.page_size)
+    n = eng.export_pages(keys, fabric=fabric)
+    return eng, keys[:n]
+
+
+def _ship_entries(fab_src, fab_dst, keys, endpoint_pair):
+    """Move serialized entries across a REAL transport endpoint pair
+    (the in-process analogue of the child export -> router publish
+    leg): encode -> send -> recv -> decode -> publish."""
+    send_chan, recv_chan = endpoint_pair
+    entries = [fab_src.entries[k] for k in keys]
+    msg, blobs = tx.entries_to_frame(entries, {"op": "admit"})
+    send_chan.send(msg, blobs)
+    rmsg, rblobs = recv_chan.recv(timeout_s=5.0)
+    for e in tx.entries_from_frame(rmsg, rblobs):
+        fab_dst.publish(e.key, e)
+
+
+@pytest.mark.parametrize("kind", ["shm", "tcp"])
+def test_migrated_admission_token_identity_over_wire(
+        gpt2_model, tmp_path, kind):
+    """The acceptance identity at the page level: a chain exported on
+    one engine, shipped over a REAL transport (shm ring or TCP
+    socket), and admitted on a cold engine serves the same-prefix
+    prompt token-identically to the in-process fabric oracle — and
+    bit-identically to a never-migrated engine."""
+    cfg, params = gpt2_model
+    rng = np.random.default_rng(21)
+    pref = rng.integers(1, cfg.vocab_size, 40).tolist()
+    prompt = pref + rng.integers(1, cfg.vocab_size, 3).tolist()
+
+    # oracle A: no fabric at all
+    plain = serving_engine(params, cfg, prefix_cache=True,
+                           kv_tier=dict(TIER), **KW)
+    plain.submit("p", prompt, max_new_tokens=6)
+    want = plain.run()["p"]
+    plain.shutdown()
+
+    # oracle B: the in-process fabric path (publish/fetch same object)
+    fab_o = KVFabric(True)
+    src_o, keys = _warm_and_export(params, cfg, pref, fab_o)
+    dst_o = serving_engine(params, cfg, prefix_cache=True,
+                           kv_tier=dict(TIER), **KW)
+    dst_o.attach_fabric(fab_o)
+    assert dst_o.admit_fabric(keys) == len(keys) > 0
+    dst_o.submit("m", prompt, max_new_tokens=6)
+    oracle_tokens = dst_o.run()["m"]
+    assert oracle_tokens == want
+    src_o.shutdown()
+    dst_o.shutdown()
+
+    # the wire path: same export, entries cross a real endpoint pair
+    if kind == "shm":
+        c2s, s2c = tx.create_shm_pair(str(tmp_path), "mig",
+                                      slot_bytes=1 << 15, n_slots=128)
+        pair = (tx.Channel(tx.attach_shm_pair(c2s, s2c, "client"),
+                           peer="dst"),
+                tx.Channel(tx.attach_shm_pair(c2s, s2c, "server"),
+                           peer="src"))
+    else:
+        lst = tx.TcpListener()
+        cli = tx.connect_tcp("127.0.0.1", lst.port)
+        srv = lst.accept(timeout_s=5.0)
+        pair = (tx.Channel(cli, peer="dst"),
+                tx.Channel(srv, peer="src"))
+    fab_src, fab_dst = KVFabric(True), KVFabric(True)
+    src, keys = _warm_and_export(params, cfg, pref, fab_src)
+    _ship_entries(fab_src, fab_dst, keys, pair)
+    dst = serving_engine(params, cfg, prefix_cache=True,
+                         kv_tier=dict(TIER), **KW)
+    dst.attach_fabric(fab_dst)
+    assert dst.admit_fabric(keys) == len(keys) > 0
+    dst.submit("m", prompt, max_new_tokens=6)
+    assert dst.run()["m"] == oracle_tokens == want
+    assert dst.check_leaks() == []
+    src.shutdown()
+    dst.shutdown()
+
+
+def test_wire_corrupted_page_dies_at_promotion(gpt2_model, tmp_path):
+    """Defense in depth: corrupt a page's payload AFTER decode (as if
+    a wire-layer bug slipped a bad buffer past the frame crc) — the
+    admitting engine's promotion-time checksum rejects it and the
+    request re-prefills to the same tokens."""
+    cfg, params = gpt2_model
+    rng = np.random.default_rng(22)
+    pref = rng.integers(1, cfg.vocab_size, 40).tolist()
+    prompt = pref + rng.integers(1, cfg.vocab_size, 3).tolist()
+    plain = serving_engine(params, cfg, prefix_cache=True,
+                           kv_tier=dict(TIER), **KW)
+    plain.submit("p", prompt, max_new_tokens=6)
+    want = plain.run()["p"]
+    plain.shutdown()
+
+    fab_src, fab_dst = KVFabric(True), KVFabric(True)
+    src, keys = _warm_and_export(params, cfg, pref, fab_src)
+    for k in keys:
+        e = fab_src.entries[k]
+        meta, blobs = tx.entry_to_wire(e)
+        got = tx.entry_from_wire(meta, blobs)
+        got.data[0].flat[0] += 1            # post-decode corruption
+        fab_dst.publish(got.key, got)
+    dst = serving_engine(params, cfg, prefix_cache=True,
+                         kv_tier=dict(TIER), **KW)
+    dst.attach_fabric(fab_dst)
+    dst.admit_fabric(keys)
+    dst.submit("m", prompt, max_new_tokens=6)
+    assert dst.run()["m"] == want           # recompute, never garbage
+    cnt = dst.registry.snapshot()["counters"]
+    assert cnt.get("kv_tier_checksum_failures", 0) > 0
+    assert dst.check_leaks() == []
+    src.shutdown()
+    dst.shutdown()
+
+
+# ------------------------------------------ subprocess fleet (slow)
+@pytest.mark.slow
+def test_proc_fleet_identity_and_sigkill_failover():
+    """Spawn REAL child replica processes, drive the standard router
+    over them, and SIGKILL one mid-generation: completed tokens match
+    the in-process oracle, the partition is typed (no silent drops,
+    no double generation), leaks and orphans are zero, and the
+    replica_dead event lands in the shared trace."""
+    from deepspeed_tpu.proc_fleet import (DEFAULT_CHILD_SPEC,
+                                          proc_fleet_router)
+    spec = DEFAULT_CHILD_SPEC
+    m = {k: v for k, v in spec["model"].items() if k != "family"}
+    cfg = gpt2.GPT2Config.tiny(**m)
+    params = gpt2.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, cfg.vocab_size, 6).tolist()
+               for _ in range(8)]
+
+    oracle_eng = serving_engine(params, cfg, **spec["engine"])
+    for i, p in enumerate(prompts):
+        oracle_eng.submit(i, p, max_new_tokens=10)
+    oracle = oracle_eng.run()
+    oracle_eng.shutdown()
+
+    router = proc_fleet_router(
+        spec, proc_fleet={"replicas": 3},
+        tracing={"sample_rate": 1.0}, fleet={"retry_budget": 2})
+    try:
+        for i, p in enumerate(prompts):
+            router.submit(i, p, max_new_tokens=10)
+        steps = 0
+        killed = False
+        while router.has_work:
+            router.step()
+            steps += 1
+            if not killed and steps >= 3:
+                router.kill_child("r1", signal.SIGKILL)
+                killed = True
+            assert steps < 100_000
+        res = router.finished
+        assert set(res) == set(range(len(prompts)))
+        from deepspeed_tpu.inference.serving import (RequestFailed,
+                                                     RequestShed)
+        completed = {k: v for k, v in res.items()
+                     if isinstance(v, list)}
+        failed = {k: v for k, v in res.items()
+                  if isinstance(v, RequestFailed)}
+        shed = {k for k, v in res.items() if isinstance(v, RequestShed)}
+        # token identity for every completed request
+        assert all(list(v) == list(oracle[k])
+                   for k, v in completed.items())
+        # typed partition, nothing silently dropped
+        assert set(completed) | set(failed) | shed == set(res)
+        assert router.orphaned() == []
+        assert router.last_failover is not None
+        assert router.last_failover["replica"] == "r1"
+        # never-double-generate: a typed failure means last-known
+        # progress > 0 OR salvage could not prove zero progress
+        for v in failed.values():
+            assert v.reason == "replica_failed"
+        # survivors leak-free; the dead child's pages died with it
+        for rep in router.replicas.values():
+            assert rep.engine.check_leaks() == []
+        ring = router.tracer.recorder.events()
+        assert sum(1 for e in ring if e[3] == "replica_dead") == 1
+    finally:
+        router.shutdown()
+    # no orphan processes: every child pid is reaped
+    for rep in router.replicas.values():
+        assert rep.engine.proc.poll() is not None

@@ -950,6 +950,233 @@ def elastic_main(args) -> int:
     return 0 if ok else 1
 
 
+PROC_FAULT_RULES = [
+    # one corrupted parent->r0 frame: the CHILD's ring consumer
+    # rejects it by crc (the frame never decodes to wrong bytes) and
+    # the proxy's idempotent rpc retry resends — correctness never
+    # rides the wire.  Armed past the warmup sends so the one rpc
+    # timeout it costs lands mid-soak, not on a first-compile step
+    {"subsystem": "transport", "mode": "error", "match": "corrupt:r0",
+     "count": 1, "after": 30},
+    # recv-side latency spikes on r2's channel (the wire slows, the
+    # stream stays ordered)
+    {"subsystem": "transport", "mode": "latency", "match": "recv:r2",
+     "latency_s": 0.01, "count": 5},
+    # one injected recv failure on r2, absorbed by the rpc retry
+    {"subsystem": "transport", "mode": "error", "match": "recv:r2",
+     "count": 1},
+]
+
+
+def procs_main(args) -> int:
+    """--procs: the out-of-process fleet soak (ISSUE 20 acceptance).
+    Three REAL child replica processes serve behind the wire while the
+    scripted schedule corrupts and delays transport frames, and the
+    soak delivers an ACTUAL SIGKILL to one child mid-generation.
+    Asserts: every completed request token-identical to a single
+    in-process oracle, typed partition (nothing silently dropped,
+    nothing generated twice), zero leaks on the survivors and zero
+    orphaned requests, bounded recovery measured from the kill
+    SIGNAL, exactly one replica_failover incident bundle, and no
+    orphan child processes after shutdown.  Stamps PROC_SOAK.json,
+    gated by tools/bench_gate.py."""
+    import signal as _signal
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    # the children pin this flag (tools/replica_child.py); the oracle
+    # must draw the same init params or every token comparison is
+    # cross-model noise
+    jax.config.update("jax_threefry_partitionable", True)
+
+    import numpy as np
+
+    from deepspeed_tpu.inference.serving import (RequestFailed,
+                                                 RequestShed,
+                                                 serving_engine)
+    from deepspeed_tpu.models import gpt2
+    from deepspeed_tpu.proc_fleet import (DEFAULT_CHILD_SPEC,
+                                          proc_fleet_router)
+    from deepspeed_tpu.utils.evidence import atomic_write_json
+
+    t_start = time.perf_counter()
+    spec = DEFAULT_CHILD_SPEC
+    cfg = gpt2.GPT2Config.tiny(**{k: v for k, v in
+                                  spec["model"].items()
+                                  if k != "family"})
+    params = gpt2.init_params(jax.random.PRNGKey(spec["seed"]), cfg)
+    rng = np.random.default_rng(args.seed + 31)
+    # enough tokens that the fleet is still mid-generation when the
+    # kill lands: the children step their engines autonomously
+    # between polls, so a short workload can drain before the router
+    # ever observes the death
+    max_new = 12
+    prompts = [rng.integers(1, cfg.vocab_size, 6).tolist()
+               for _ in range(24)]
+
+    # ---- single in-process fault-free oracle (identical params: the
+    # children rebuild from the same (model, seed) spec)
+    oracle_eng = serving_engine(params, cfg, **spec["engine"])
+    for i, p in enumerate(prompts):
+        oracle_eng.submit(f"o{i}", p, max_new_tokens=max_new)
+    oracle_out = oracle_eng.run()
+    oracle = {f"r{i:02d}": oracle_out[f"o{i}"]
+              for i in range(len(prompts))}
+    oracle_eng.shutdown()
+
+    inc_dir = tempfile.mkdtemp(prefix="dstpu-proc-incidents-")
+    # poll_timeout_s stays at its 10 s default: a child's FIRST steps
+    # pay XLA compiles, and a tighter rpc bound reads a compiling
+    # child as a dead one on a slow box
+    router = proc_fleet_router(
+        spec,
+        proc_fleet={"replicas": 3},
+        fleet={"replicas": 3, "retry_budget": 2,
+               "digest_refresh_steps": 2},
+        tracing={"ring_capacity": 65536},
+        faults={"seed": args.seed, "rules": PROC_FAULT_RULES},
+        history=dict(HISTORY_BLOCK),
+        incidents=incidents_block(inc_dir))
+
+    spawn_s = time.perf_counter() - t_start
+    t_kill = None
+    salvaged = set()
+    recovery_s = None
+    hang = False
+    try:
+        for i, p in enumerate(prompts):
+            router.submit(f"r{i:02d}", p, max_new_tokens=max_new)
+        steps = 0
+        while router.has_work:
+            router.step()
+            steps += 1
+            if t_kill is None and steps == 1:
+                # a REAL SIGKILL mid-generation, right after the first
+                # harvest: no drain, no goodbye frame — the address
+                # space just vanishes with requests queued and in
+                # flight on r1
+                t_kill = router.kill_child("r1", _signal.SIGKILL)
+            fo_now = router.last_failover
+            if not salvaged and fo_now is not None and \
+                    fo_now.get("replica") == "r1":
+                salvaged = set(fo_now["resubmitted"])
+            if t_kill is not None and recovery_s is None and \
+                    fo_now is not None and \
+                    fo_now.get("replica") == "r1" and \
+                    all(k in router.finished for k in salvaged):
+                recovery_s = time.perf_counter() - t_kill
+            if steps > STEP_CAP or \
+                    time.perf_counter() - t_start > WALL_CAP_S:
+                hang = True
+                break
+        if recovery_s is None and t_kill is not None:
+            recovery_s = time.perf_counter() - t_kill
+
+        # ---- reconcile
+        finished = dict(router.finished)
+        completed = {k: v for k, v in finished.items()
+                     if isinstance(v, list)}
+        failed = {k: v for k, v in finished.items()
+                  if isinstance(v, RequestFailed)}
+        shed = {k: v for k, v in finished.items()
+                if isinstance(v, RequestShed)}
+        mismatched = [k for k, v in completed.items()
+                      if list(v) != list(oracle[k])]
+        leaks = router.check_leaks()
+        orphaned = router.orphaned()
+        cnt = router.registry.snapshot()["counters"]
+        fo = router.last_failover or {}
+        ring = router.tracer.recorder.events()
+        # wire accounting: every channel lives in THIS process, so the
+        # injected schedule must be visible in the per-replica
+        # transport families (the child-side corrupt detection happens
+        # in the child; the router sees the injection + the retry)
+        wire = {}
+        for rep in router.replicas.values():
+            c = rep.engine.registry.snapshot()["counters"]
+            for k, v in c.items():
+                if k.startswith("transport_"):
+                    wire[k] = wire.get(k, 0) + int(v)
+        inc = incidents_summary(router.incident_mgr)
+        fo_bundles = inc["by_class"].get("replica_failover", 0)
+        fo_bundle = load_bundle(router.incident_mgr,
+                                "replica_failover")
+        plan_snap = router._fault_plan.snapshot()
+        checks = {
+            "typed_results_partition":
+                len(finished) == len(prompts) and
+                len(completed) + len(failed) + len(shed)
+                == len(prompts),
+            "failover_happened":
+                fo.get("replica") == "r1" and
+                int(cnt.get("fleet_failovers", 0)) == 1,
+            "never_double_generate":
+                set(fo.get("resubmitted", [])).isdisjoint(
+                    fo.get("failed_typed", [])),
+            "trace_replica_dead":
+                sum(1 for e in ring if e[3] == "replica_dead") == 1,
+            "failover_bundle":
+                fo_bundles == 1 and
+                bundle_well_formed(fo_bundle, "replica_dead"),
+            "wire_faults_injected":
+                wire.get("transport_injected_faults", 0) >= 2 and
+                plan_snap["injected"] >= 2,
+            "wire_moved_bytes":
+                wire.get("transport_tx_frames", 0) > 0 and
+                wire.get("transport_rx_bytes", 0) > 0,
+        }
+        replica_states = {rid: rep.state
+                          for rid, rep in router.replicas.items()}
+    finally:
+        procs = [rep.engine.proc
+                 for rep in router.replicas.values()]
+        router.shutdown()
+    reaped = all(p.poll() is not None for p in procs)
+    checks["no_orphan_processes"] = reaped
+    ok = (not mismatched and not hang and not leaks and not orphaned
+          and all(checks.values())
+          and recovery_s is not None and recovery_s < 60.0)
+    stamp = {
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "backend": jax.default_backend(),
+        "model": "gpt2-tiny",
+        "seed": args.seed,
+        "replicas": 3,
+        "transport": "shm",
+        "ok": ok,
+        "submitted": len(prompts),
+        "completed": len(completed),
+        "failed": len(failed),
+        "shed": len(shed),
+        "resubmitted": len(fo.get("resubmitted", [])),
+        "failed_typed": len(fo.get("failed_typed", [])),
+        "mismatched_requests": len(mismatched),
+        "mismatched_ids": mismatched[:8],
+        "hang": int(hang),
+        "leak_count": len(leaks),
+        "orphaned_requests": len(orphaned),
+        "orphan_processes": int(not reaped),
+        "recovery_s": round(recovery_s, 3)
+        if recovery_s is not None else None,
+        "spawn_s": round(spawn_s, 2),
+        "accounting_ok": int(all(checks.values())),
+        "accounting": checks,
+        "replica_states": replica_states,
+        "wire": wire,
+        "incidents": inc,
+        "injected": plan_snap,
+        "duration_s": round(time.perf_counter() - t_start, 2),
+    }
+    atomic_write_json(stamp, args.json_out)
+    print(json.dumps({k: v for k, v in stamp.items()
+                      if k not in ("injected", "wire", "incidents")},
+                     indent=1, sort_keys=True))
+    print("→", args.json_out)
+    return 0 if ok else 1
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true",
@@ -972,6 +1199,12 @@ def main():
                          "faults + mid-handoff decode-replica kill + "
                          "prefill-pool drain); stamps "
                          "DISAGG_SOAK.json by default")
+    ap.add_argument("--procs", action="store_true",
+                    help="run the out-of-process fleet soak (3 child "
+                         "replica processes over the shm wire, "
+                         "scripted transport corrupt/latency faults, "
+                         "a real mid-generation SIGKILL); stamps "
+                         "PROC_SOAK.json by default")
     ap.add_argument("--json-out", default=None)
     args = ap.parse_args()
     if args.json_out is None:
@@ -979,6 +1212,7 @@ def main():
             REPO, "ELASTIC_SOAK.json" if args.elastic
             else "DISAGG_SOAK.json" if args.disagg
             else "FLEET_SOAK.json" if args.fleet
+            else "PROC_SOAK.json" if args.procs
             else "CHAOS_SOAK.json")
     if args.elastic:
         return elastic_main(args)
@@ -986,6 +1220,8 @@ def main():
         return disagg_main(args)
     if args.fleet:
         return fleet_main(args)
+    if args.procs:
+        return procs_main(args)
 
     import jax
 

@@ -2,7 +2,7 @@
 """Obs-wire truth gate: a REAL child process, scraped over REAL HTTP.
 
 Everything the wire plane claims, demonstrated against a subprocess
-replica (tools/obswire_child.py — its own interpreter, its own engine,
+replica (tools/replica_child.py — its own interpreter, its own engine,
 its own ephemeral-port exporter), not an in-process mock:
 
 - scrape: RemoteReplica polls the child's /statusz + /healthz +
@@ -40,11 +40,12 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-CHILD = os.path.join(REPO, "tools", "obswire_child.py")
+CHILD = os.path.join(REPO, "tools", "replica_child.py")
 
 
 def spawn_child(replica: str, skew_ns: int = 0):
-    """Start one obswire_child and wait for its ready handshake.
+    """Start one replica_child (observability mode) and wait for
+    its ready handshake.
     Returns (Popen, port)."""
     env = dict(os.environ)
     # the child builds its own 1-device CPU backend: scrub any runner
@@ -60,7 +61,7 @@ def spawn_child(replica: str, skew_ns: int = 0):
     line = p.stdout.readline()      # blocks until the engine is up;
     if not line:                    # the slow lane's outer timeout caps it
         raise RuntimeError(
-            f"obswire_child {replica!r} died before the handshake "
+            f"replica_child {replica!r} died before the handshake "
             f"(rc={p.poll()})")
     return p, json.loads(line)["port"]
 

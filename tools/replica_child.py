@@ -1,0 +1,395 @@
+#!/usr/bin/env python
+"""One serving replica in its own OS process: engine + wire plane +
+transport endpoint.
+
+The generalization of the old obswire_child harness — ONE child
+entrypoint for every subprocess replica:
+
+- **observability mode** (default, what tools/obswire_probe.py
+  spawns): build a tiny engine behind a REAL ephemeral-port HTTP
+  introspection server, run a small traced workload, print the
+  ready handshake, serve until killed.
+- **fleet mode** (``--transport shm|tcp``, what
+  :mod:`deepspeed_tpu.proc_fleet` spawns): additionally serve the
+  engine's submit/poll/migrate/handoff verbs over a
+  :class:`~deepspeed_tpu.transport.Channel` so a router in another
+  process can drive it.  The engine spec arrives as a JSON blob
+  (``--engine-json``) so children rebuild IDENTICAL params from
+  ``(model config, seed)`` — same-host replicas are token-identical
+  to an in-process oracle by construction.
+
+Protocol: prints ONE JSON line ``{"port": N, "pid": P, "replica":
+R, "tcp_port": T|null, "caps": {...}}`` to stdout once the engine is
+up — the parent's ready handshake.  SIGTERM drains cleanly (stop
+admitting, finish in-flight, engine shutdown); SIGKILL is the
+failover path and needs no cooperation from this process — cleanup
+is never load-bearing.  ``--skew-ns N`` shifts this process's
+monotonic wire stamps (the obswire clock-correlation probe).
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_SPEC = {
+    "model": {"family": "gpt2", "dim": 32, "n_layers": 2,
+              "n_heads": 2, "max_seq_len": 64},
+    "engine": {"max_batch": 2, "page_size": 8, "num_pages": 24,
+               "max_seq": 32, "prefill_bucket": 8,
+               "slo": True, "history": True},
+    "seed": 0,
+}
+
+
+def build_engine(spec, replica):
+    """Deterministic engine construction from a JSON spec: the same
+    (model config, seed) yields bit-identical params in every process
+    on this host, which is what makes cross-process token-identity
+    checks meaningful."""
+    import jax
+
+    from deepspeed_tpu.inference.serving import serving_engine
+    from deepspeed_tpu.models import gpt2
+
+    m = dict(spec.get("model", {}))
+    fam = m.pop("family", "gpt2")
+    if fam != "gpt2":
+        raise SystemExit(
+            f"replica_child: unsupported engine family {fam!r} "
+            "(the subprocess harness builds tiny gpt2 replicas)")
+    cfg = gpt2.GPT2Config.tiny(**m)
+    params = gpt2.init_params(
+        jax.random.PRNGKey(int(spec.get("seed", 0))), cfg)
+    kw = dict(spec.get("engine", {}))
+    kw.setdefault("telemetry", {"http_port": 0})
+    kw.setdefault("tracing", {"sample_rate": 1.0})
+    eng = serving_engine(params, cfg, replica_id=replica, **kw)
+    fab = None
+    if spec.get("fabric"):
+        # child-local TRANSIT fabric: export_pages stages entries here
+        # before they cross the wire; admit publishes arrivals here so
+        # admit_fabric's existing checksum-verified promotion path
+        # consumes them unchanged
+        from deepspeed_tpu.kv_fabric import KVFabric
+        fab = KVFabric(spec["fabric"], registry=eng.registry)
+        eng.attach_fabric(fab)
+    return cfg, eng, fab
+
+
+class ReplicaServer:
+    """The child side of the proc-fleet protocol: a single-threaded
+    serve loop that alternates transport handling with engine steps.
+    Finished results land in an ack-retained outbox — a lost or
+    corrupted poll reply re-delivers them on the next poll, so a
+    result that exists is never lost to the wire."""
+
+    def __init__(self, eng, fab, chan):
+        self.eng, self.fab, self.chan = eng, fab, chan
+        self.outbox = []            # [ [idx, result dict] ... ]
+        self.next_idx = 0
+        self.submitted = set()      # rpc dedup (retried submits)
+        self.closing = False
+        self._last_digest = None
+        self._digest_v = 0
+
+    # ------------------------------------------------------- encoding
+    def _pump_finished(self):
+        from deepspeed_tpu.inference.serving import (RequestFailed,
+                                                     RequestShed)
+        eng = self.eng
+        for rid in list(eng.finished.keys()):
+            res = eng.finished.pop(rid)
+            if isinstance(res, RequestShed):
+                enc = {"rid": rid, "kind": "shed",
+                       "reason": res.reason, "tier": res.tier}
+            elif isinstance(res, RequestFailed):
+                enc = {"rid": rid, "kind": "failed",
+                       "reason": res.reason, "error": res.error,
+                       "tier": res.tier,
+                       "generated": int(res.generated)}
+            else:
+                enc = {"rid": rid, "kind": "ok",
+                       "tokens": [int(t) for t in res]}
+            self.outbox.append([self.next_idx, enc])
+            self.next_idx += 1
+
+    def _progress(self):
+        # req_ids ride as JSON VALUES (lists of pairs), never as JSON
+        # object keys — an int id must come back an int
+        return {
+            "queued": [r.req_id for r in self.eng.queue],
+            "active": [[s.req.req_id, len(s.generated)]
+                       for s in self.eng.slots if s is not None],
+        }
+
+    def _digest_delta(self):
+        d = {k.hex(): v for k, v in self.eng.warm_digest().items()}
+        if d == self._last_digest:
+            return None
+        self._last_digest = d
+        self._digest_v += 1
+        return d
+
+    # ------------------------------------------------------- handlers
+    def handle(self, msg, blobs):
+        """Dispatch one request; returns (reply_msg, reply_blobs).
+        Every op is idempotent under RPC retry: duplicate submits
+        dedup, a re-polled outbox re-delivers, a second take_queued /
+        abandon just finds nothing left."""
+        from deepspeed_tpu import transport as tx
+        from deepspeed_tpu.inference.serving import EngineClosed
+        eng = self.eng
+        op = msg.get("op")
+        if op == "submit":
+            rid = msg["req_id"]
+            key = repr(rid)
+            if key in self.submitted:
+                return {"ok": True, "dup": True}, ()
+            arrival = time.perf_counter() - float(msg.get("age_s", 0.0))
+            try:
+                shed = eng.submit(
+                    rid, msg["tokens"],
+                    max_new_tokens=int(msg.get("max_new_tokens", 32)),
+                    temperature=float(msg.get("temperature", 0.0)),
+                    tier=msg.get("tier"), arrival=arrival)
+            except EngineClosed:
+                return {"closed": True}, ()
+            except ValueError as e:
+                return {"error": str(e)}, ()
+            if shed is not None:
+                eng.finished.pop(rid, None)
+                return {"shed": {"reason": shed.reason,
+                                 "tier": shed.tier}}, ()
+            self.submitted.add(key)
+            return {"ok": True}, ()
+        if op == "poll":
+            ack = int(msg.get("ack", -1))
+            self.outbox = [e for e in self.outbox if e[0] > ack]
+            self._pump_finished()
+            rep = {
+                "results": self.outbox,
+                "progress": self._progress(),
+                "has_work": bool(eng.has_work),
+                "healthz": eng.healthz(),
+                "slo": eng.slo_tracker.snapshot(),
+                "counters": {"n_shed": eng._n_shed,
+                             "n_failed": eng._n_failed,
+                             "n_submitted": eng._n_submitted},
+            }
+            d = self._digest_delta()
+            if d is not None:
+                rep["digest"] = d
+            rep["digest_v"] = self._digest_v
+            return rep, ()
+        if op == "take_queued":
+            taken = eng.take_queued()
+            return {"queued": [r.req_id for r in taken]}, ()
+        if op == "abandon":
+            outs = eng.abandon_inflight()
+            return {"inflight": [[r.req_id, int(g)]
+                                 for r, g in outs]}, ()
+        if op == "export":
+            keys = [bytes.fromhex(k) for k in msg["keys"]]
+            if self.fab is None:
+                return {"error": "no fabric on this child", "n": 0}, ()
+            try:
+                n = eng.export_pages(keys, fabric=self.fab)
+            except Exception as e:
+                return {"error": str(e), "n": 0}, ()
+            entries = [self.fab.entries[k] for k in keys[:n]
+                       if k in self.fab.entries]
+            rep, rblobs = tx.entries_to_frame(entries, {"n": n})
+            return rep, rblobs
+        if op == "admit":
+            if self.fab is None:
+                return {"error": "no fabric on this child",
+                        "admitted": 0}, ()
+            entries = tx.entries_from_frame(msg, blobs)
+            for e in entries:
+                try:
+                    self.fab.publish(e.key, e)
+                except Exception:
+                    break
+            keys = [bytes.fromhex(k) for k in msg["keys"]]
+            deadline = time.perf_counter() + float(
+                msg.get("budget_s", 5.0))
+            n = eng.admit_fabric(keys, deadline=deadline)
+            locs = []
+            for k in keys[:n]:
+                if k in eng.allocator.index:
+                    locs.append([k.hex(), "hbm"])
+                else:
+                    locs.append([k.hex(),
+                                 eng._kv_pool.location(k) or "host"])
+            return {"admitted": n, "locations": locs}, ()
+        if op == "healthz":
+            return eng.healthz(), ()
+        if op == "check_leaks":
+            return {"leaks": eng.check_leaks()}, ()
+        if op == "warm_digest":
+            return {"digest": {k.hex(): v for k, v in
+                               eng.warm_digest().items()}}, ()
+        if op == "shutdown":
+            self.closing = True
+            return {"ok": True}, ()
+        return {"error": f"unknown op {op!r}"}, ()
+
+    # ------------------------------------------------------ serve loop
+    def serve(self, drain_grace_s: float = 10.0):
+        from deepspeed_tpu import transport as tx
+        from deepspeed_tpu.utils.logging import logger
+        drain_deadline = None
+        while True:
+            if self.closing and drain_deadline is None:
+                drain_deadline = time.monotonic() + drain_grace_s
+            if self.closing and (not self.eng.has_work
+                                 or time.monotonic() > drain_deadline):
+                break
+            timeout = 0.0 if self.eng.has_work else 0.02
+            try:
+                got = self.chan.recv(timeout_s=timeout)
+            except tx.TransportCorrupt:
+                continue        # drop the frame; the caller's RPC
+                                # retry re-sends it
+            except tx.TransportError:
+                break           # parent gone — no reason to linger
+            if got is not None:
+                msg, blobs = got
+                try:
+                    rep, rblobs = self.handle(msg, blobs)
+                except Exception as e:
+                    logger.exception("replica_child: op failed")
+                    rep, rblobs = {"error": repr(e)}, ()
+                if "_seq" in msg:
+                    rep["_seq"] = msg["_seq"]
+                try:
+                    self.chan.send(rep, rblobs)
+                except tx.TransportError:
+                    break
+            if self.eng.has_work:
+                try:
+                    self.eng.step()
+                except Exception:
+                    logger.exception("replica_child: engine step")
+                    break
+                self._pump_finished()
+            elif got is None and os.getppid() == 1:
+                break           # orphaned by a dead parent: exit
+        try:
+            self.eng.shutdown()
+        except Exception:
+            pass
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replica", default="child0")
+    ap.add_argument("--skew-ns", type=int, default=0)
+    ap.add_argument("--requests", type=int, default=4,
+                    help="preload workload size (observability mode)")
+    ap.add_argument("--new-tokens", type=int, default=8)
+    ap.add_argument("--engine-json", default=None,
+                    help="engine spec blob; default = the obswire "
+                         "probe's tiny gpt2")
+    ap.add_argument("--transport", default="none",
+                    choices=("none", "tcp", "shm"))
+    ap.add_argument("--shm-c2s", default=None)
+    ap.add_argument("--shm-s2c", default=None)
+    ap.add_argument("--accept-timeout-s", type=float, default=60.0)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+    if args.skew_ns:
+        # simulate a foreign monotonic origin: every wire_stamp (and
+        # therefore every /statusz//healthz//historyz//tracez doc this
+        # process serves) reads skew_ns ahead of the true clock
+        from deepspeed_tpu import obs_wire
+
+        real_stamp = obs_wire.wire_stamp
+
+        def skewed_stamp():
+            d = real_stamp()
+            d["t_mono_ns"] += args.skew_ns
+            return d
+
+        obs_wire.wire_stamp = skewed_stamp
+
+    spec = (json.loads(args.engine_json)
+            if args.engine_json else DEFAULT_SPEC)
+    cfg, eng, fab = build_engine(spec, args.replica)
+
+    if args.requests:
+        import numpy as np
+        rng = np.random.default_rng(0)
+        for i in range(args.requests):
+            eng.submit(i, rng.integers(1, cfg.vocab_size, 6).tolist(),
+                       max_new_tokens=args.new_tokens)
+        eng.run()
+
+    listener = None
+    if args.transport == "tcp":
+        from deepspeed_tpu.transport import TcpListener
+        listener = TcpListener()
+
+    caps = {
+        "kvt_on": bool(getattr(eng, "_kvt_on", False)),
+        "pc_on": bool(getattr(eng, "_pc_on", False)),
+        "eos": getattr(eng, "eos", None),
+        "page_size": int(eng.page_size),
+        "weights_version": getattr(eng, "weights_version", None),
+        "max_seq": int(eng.max_seq),
+        "vocab_size": int(cfg.vocab_size),
+    }
+    print(json.dumps({"port": eng._tel_exporter.port,
+                      "pid": os.getpid(),
+                      "replica": args.replica,
+                      "tcp_port": listener.port if listener else None,
+                      "caps": caps}), flush=True)
+
+    if args.transport == "none":
+        # observability mode: HTTP is the only plane — serve until
+        # killed, SIGTERM shuts the engine down cleanly
+        def bye(signum, frame):
+            eng.shutdown()
+            sys.exit(0)
+
+        signal.signal(signal.SIGTERM, bye)
+        while True:
+            time.sleep(0.2)
+
+    from deepspeed_tpu import transport as tx
+
+    if args.transport == "tcp":
+        endpoint = listener.accept(timeout_s=args.accept_timeout_s)
+    else:
+        if not (args.shm_c2s and args.shm_s2c):
+            raise SystemExit(
+                "replica_child: --transport shm needs --shm-c2s and "
+                "--shm-s2c ring paths")
+        endpoint = tx.attach_shm_pair(args.shm_c2s, args.shm_s2c,
+                                      "server")
+    chan = tx.Channel(endpoint, peer="parent", registry=eng.registry)
+    server = ReplicaServer(eng, fab, chan)
+
+    def drain(signum, frame):
+        # SIGTERM = planned drain: stop admitting, let in-flight work
+        # finish inside the serve loop's grace window, then shut down
+        server.closing = True
+
+    signal.signal(signal.SIGTERM, drain)
+    server.serve()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,6 +141,24 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py \
 timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_fleet.py --cpu \
   --disagg --json-out "$REPO/DISAGG_BENCH.json" >/dev/null 2>&1 || true
 
+# out-of-process fleet soak: three REAL child processes behind the
+# shm/TCP transport, a seeded wire-fault schedule (injected corruption
+# caught by the frame crc, recv latency/error rules) and an actual
+# SIGKILL mid-generation — harvest-first salvage, typed never-double-
+# generate partition, token identity vs an in-process oracle, zero
+# leaks/orphans/orphan-processes, bounded recovery.  Stamps
+# PROC_SOAK.json, gated by bench_gate.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/chaos_soak.py \
+  --cpu --procs --json-out "$REPO/PROC_SOAK.json" >/dev/null 2>&1 || true
+
+# out-of-process fleet bench: the in-process vs out-of-process
+# throughput A/B (wire_cost_ratio), SIGKILL failover recovery on the
+# proc fleet, and the shm-vs-tcp-vs-off KV-fabric migration A/B with
+# cross-arm token identity.  Stamps PROC_FLEET_BENCH.json, gated by
+# bench_gate.
+timeout -k 10 600 env JAX_PLATFORMS=cpu python bench_fleet.py --cpu \
+  --procs --json-out "$REPO/PROC_FLEET_BENCH.json" >/dev/null 2>&1 || true
+
 # tensor-parallel serving A/B: the same traffic on a 1-device engine
 # vs a 2-device model-axis mesh (virtual host CPUs) — decode tokens/s,
 # TTFT, and the token-identity gate (tp_ab.mismatched_requests must
